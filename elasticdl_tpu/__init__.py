@@ -1,0 +1,19 @@
+"""elasticdl_tpu — a TPU-native elastic distributed training framework.
+
+Re-designed from scratch with the capabilities of ElasticDL
+(reference: william-wang/elasticdl, upstream sql-machine-learning/elasticdl):
+
+- dynamic data sharding via a master-hosted task queue (control plane kept,
+  reference: elasticdl/python/master/task_dispatcher.py),
+- elastic worker membership with mesh re-formation instead of Horovod
+  re-rendezvous (reference: elasticdl/python/master/rendezvous_server.py),
+- model state in device HBM, sharded by a `jax.sharding.Mesh`, instead of a
+  parameter-server tier (reference: elasticdl/pkg/ps/*.go),
+- the train step as a single `jax.jit`-compiled XLA program with `optax`
+  optimizers instead of TF2-eager + server-side optimizer application
+  (reference: elasticdl/python/worker/worker.py).
+"""
+
+from elasticdl_tpu.version import __version__
+
+__all__ = ["__version__"]

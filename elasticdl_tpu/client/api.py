@@ -1,0 +1,44 @@
+"""Client verb implementations.
+
+Reference parity: elasticdl_client/api.py (train/evaluate/predict submit a
+master pod; zoo manages the model-zoo image). Local mode runs master+workers
+as processes on this host; k8s mode renders manifests for a TPU slice.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import JobType
+
+
+def _not_ready(what: str) -> int:
+    print(
+        f"{what}: the master/worker runtime is not wired into the CLI yet "
+        "(see elasticdl_tpu/master, elasticdl_tpu/worker).",
+        file=sys.stderr,
+    )
+    return 3
+
+
+def train(cfg: JobConfig) -> int:
+    cfg.validate()
+    return _not_ready("train")
+
+
+def evaluate(cfg: JobConfig) -> int:
+    cfg = cfg.replace(job_type=JobType.EVALUATION_ONLY)
+    cfg.validate()
+    return _not_ready("evaluate")
+
+
+def predict(cfg: JobConfig) -> int:
+    cfg = cfg.replace(job_type=JobType.PREDICTION_ONLY)
+    cfg.validate()
+    return _not_ready("predict")
+
+
+def zoo(argv: List[str]) -> int:
+    return _not_ready("zoo")

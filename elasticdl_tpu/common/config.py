@@ -1,0 +1,221 @@
+"""Typed job configuration with argv round-trip.
+
+Reference parity: elasticdl/python/common/args.py. The reference's config plane
+works by parsing argparse flags in the client, then *re-serializing the parsed
+namespace back into argv* for the master pod's command line, which does the same
+for workers. That propagation trick is simple and debuggable, so we keep it —
+but as one typed dataclass (`JobConfig`) with `to_argv()` / `from_argv()`
+instead of hand-maintained parallel argparse groups.
+
+Roles (client / master / worker) share this single schema; each reads the
+fields it needs. Freeform `--model_params` / `--data_reader_params` key=value
+strings pass user parameters through to model-zoo code, matching the
+reference's behavior.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional
+
+from elasticdl_tpu.common.constants import DEFAULT_MASTER_PORT, JobType
+
+
+def parse_kv_params(s: str) -> Dict[str, Any]:
+    """Parse 'a=1;b=hello;c=0.5' into a dict with literal-ish coercion.
+
+    Reference parity: the reference's `--model_params` / `--envs` freeform
+    key=value passthrough (elasticdl/python/common/args.py).
+    """
+    out: Dict[str, Any] = {}
+    if not s:
+        return out
+    for item in s.split(";"):
+        item = item.strip()
+        if not item:
+            continue
+        if "=" not in item:
+            raise ValueError(f"Malformed key=value item: {item!r}")
+        k, v = item.split("=", 1)
+        k, v = k.strip(), v.strip()
+        for caster in (int, float):
+            try:
+                out[k] = caster(v)
+                break
+            except ValueError:
+                continue
+        else:
+            if v.lower() in ("true", "false"):
+                out[k] = v.lower() == "true"
+            else:
+                out[k] = v
+    return out
+
+
+def format_kv_params(d: Dict[str, Any]) -> str:
+    return ";".join(f"{k}={v}" for k, v in d.items())
+
+
+@dataclass
+class JobConfig:
+    """Everything a training/evaluation/prediction job needs, in one place."""
+
+    # --- identity ---
+    job_name: str = "edl-job"
+    job_type: str = JobType.TRAINING_WITH_EVALUATION
+
+    # --- model-zoo contract (reference: --model_zoo / --model_def) ---
+    model_zoo: str = "model_zoo"
+    model_def: str = ""           # dotted path: "mnist.mnist_cnn.custom_model"
+    model_params: Dict[str, Any] = field(default_factory=dict)
+    # Optional per-function overrides (reference: --loss=..., --optimizer=...)
+    loss: str = ""
+    optimizer: str = ""
+    dataset_fn: str = ""
+    eval_metrics_fn: str = ""
+    prediction_outputs_processor: str = ""
+
+    # --- data ---
+    training_data: str = ""
+    validation_data: str = ""
+    prediction_data: str = ""
+    data_reader: str = ""          # "" = infer from path; "recordio"|"csv"|...
+    data_reader_params: Dict[str, Any] = field(default_factory=dict)
+    records_per_task: int = 4096
+    num_epochs: int = 1
+    minibatch_size: int = 64
+    shuffle: bool = True
+    shuffle_seed: int = 0
+
+    # --- evaluation ---
+    evaluation_steps: int = 0      # 0 = evaluate at epoch end only
+    evaluation_start_delay_steps: int = 0
+
+    # --- checkpointing (reference: --checkpoint_steps etc.) ---
+    checkpoint_dir: str = ""
+    checkpoint_steps: int = 0
+    keep_checkpoint_max: int = 3
+    output: str = ""               # final model export dir
+
+    # --- cluster shape / elasticity ---
+    num_workers: int = 1
+    num_minibatches_per_task: int = 0   # 0 = derive from records_per_task
+    max_task_retries: int = 3
+    relaunch_max: int = 3               # reference: --relaunch_pod_max_num
+    task_timeout_s: float = 600.0
+    worker_heartbeat_s: float = 10.0
+
+    # --- mesh / parallelism (TPU-native; no reference analog) ---
+    mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"
+    remat: bool = False            # jax.checkpoint the forward pass
+
+    # --- addresses / runtime ---
+    master_addr: str = f"localhost:{DEFAULT_MASTER_PORT}"
+    coordinator_addr: str = ""     # jax.distributed coordination service
+    use_tpu: bool = True
+    log_level: str = "INFO"
+
+    # --- k8s submission (client-side; reference: --image_name etc.) ---
+    image_name: str = ""
+    namespace: str = "default"
+    master_resource_request: str = "cpu=1,memory=2048Mi"
+    worker_resource_request: str = "cpu=4,memory=8192Mi"
+    tpu_type: str = ""             # e.g. "v5e-32"
+    volume: str = ""
+    image_pull_policy: str = "IfNotPresent"
+    restart_policy: str = "Never"
+    envs: Dict[str, Any] = field(default_factory=dict)
+
+    # ------------------------------------------------------------------ #
+
+    def validate(self) -> None:
+        if not self.model_def:
+            raise ValueError("model_def is required (e.g. mnist.mnist_cnn.custom_model)")
+        if self.minibatch_size <= 0:
+            raise ValueError("minibatch_size must be positive")
+        if self.num_workers <= 0:
+            raise ValueError("num_workers must be positive")
+
+    # --- argv round-trip ------------------------------------------------ #
+
+    _DICT_FIELDS = ("model_params", "data_reader_params", "envs")
+
+    def to_argv(self) -> List[str]:
+        """Serialize to a flat argv, skipping fields at their default value."""
+        argv: List[str] = []
+        defaults = JobConfig()
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if v == getattr(defaults, f.name):
+                continue
+            flag = "--" + f.name
+            if f.name in self._DICT_FIELDS:
+                argv += [flag, format_kv_params(v)]
+            elif isinstance(v, bool):
+                argv += [flag, "true" if v else "false"]
+            else:
+                argv += [flag, str(v)]
+        return argv
+
+    @classmethod
+    def from_argv(cls, argv: List[str]) -> "JobConfig":
+        parser = cls.build_parser()
+        ns, unknown = parser.parse_known_args(argv)
+        if unknown:
+            raise ValueError(f"Unknown flags: {unknown}")
+        return cls.from_namespace(ns)
+
+    @classmethod
+    def build_parser(cls, parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+        parser = parser or argparse.ArgumentParser("elasticdl-tpu")
+        defaults = cls()
+        for f in fields(cls):
+            flag = "--" + f.name
+            default = getattr(defaults, f.name)
+            if f.name in cls._DICT_FIELDS:
+                parser.add_argument(flag, type=str, default=format_kv_params(default))
+            elif isinstance(default, bool):
+                parser.add_argument(
+                    flag, type=lambda s: s.lower() in ("true", "1", "yes"), default=default
+                )
+            else:
+                parser.add_argument(flag, type=type(default), default=default)
+        return parser
+
+    @classmethod
+    def from_namespace(cls, ns: argparse.Namespace) -> "JobConfig":
+        kwargs: Dict[str, Any] = {}
+        for f in fields(cls):
+            v = getattr(ns, f.name)
+            if f.name in cls._DICT_FIELDS and isinstance(v, str):
+                v = parse_kv_params(v)
+            kwargs[f.name] = v
+        cfg = cls(**kwargs)
+        return cfg
+
+    def replace(self, **kw: Any) -> "JobConfig":
+        return dataclasses.replace(self, **kw)
+
+    def mesh_axes_sizes(self, n_devices: int) -> Dict[str, int]:
+        """Resolve `mesh_shape` against an actual device count."""
+        if not self.mesh_shape:
+            return {"data": n_devices}
+        parts = [int(p) for p in self.mesh_shape.split(",")]
+        if len(parts) == 1:
+            sizes = {"data": parts[0]}
+        elif len(parts) == 2:
+            sizes = {"data": parts[0], "model": parts[1]}
+        else:
+            raise ValueError(f"mesh_shape must have 1 or 2 dims, got {self.mesh_shape!r}")
+        total = 1
+        for s in sizes.values():
+            total *= s
+        if total != n_devices:
+            raise ValueError(
+                f"mesh_shape {self.mesh_shape!r} needs {total} devices, have {n_devices}"
+            )
+        return sizes

@@ -1,0 +1,70 @@
+"""Framework-wide constants.
+
+Reference parity: elasticdl/python/common/constants.py (GRPC message sizes,
+pod/label names, checkpoint dir layout).
+"""
+
+
+class GRPC:
+    # Embedding pulls and dense model pushes can be large; match the
+    # reference's practice of raising the default 4 MB gRPC cap.
+    MAX_SEND_MESSAGE_LENGTH = 256 * 1024 * 1024
+    MAX_RECEIVE_MESSAGE_LENGTH = 256 * 1024 * 1024
+    OPTIONS = [
+        ("grpc.max_send_message_length", MAX_SEND_MESSAGE_LENGTH),
+        ("grpc.max_receive_message_length", MAX_RECEIVE_MESSAGE_LENGTH),
+    ]
+
+
+class TaskType:
+    """Task types leased by the master to workers.
+
+    Reference parity: elasticdl.proto's TaskType enum
+    (TRAINING / EVALUATION / PREDICTION / SAVE_MODEL / WAIT).
+    """
+
+    TRAINING = "training"
+    EVALUATION = "evaluation"
+    PREDICTION = "prediction"
+    SAVE_MODEL = "save_model"
+    WAIT = "wait"
+
+
+class JobType:
+    TRAINING_ONLY = "training_only"
+    TRAINING_WITH_EVALUATION = "training_with_evaluation"
+    EVALUATION_ONLY = "evaluation_only"
+    PREDICTION_ONLY = "prediction_only"
+
+
+class PodStatus:
+    """Lifecycle states of a managed worker instance.
+
+    Mirrors the k8s pod phases the reference's instance manager watches
+    (reference: elasticdl/python/master/k8s_instance_manager.py).
+    """
+
+    PENDING = "pending"
+    RUNNING = "running"
+    SUCCEEDED = "succeeded"
+    FAILED = "failed"
+    DELETED = "deleted"
+
+
+class WorkerEnv:
+    """Environment variables the launcher sets on each worker process."""
+
+    WORKER_ID = "EDL_WORKER_ID"
+    MASTER_ADDR = "EDL_MASTER_ADDR"
+    NUM_WORKERS = "EDL_NUM_WORKERS"
+    COORDINATOR_ADDR = "EDL_COORDINATOR_ADDR"
+
+
+class MeshAxis:
+    """Canonical mesh axis names for every sharding in the framework."""
+
+    DATA = "data"   # batch dimension; DP gradient psum rides this axis
+    MODEL = "model"  # embedding-table rows / any model-parallel dim
+
+
+DEFAULT_MASTER_PORT = 50001

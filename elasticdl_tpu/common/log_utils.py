@@ -1,0 +1,28 @@
+"""Uniform logger factory.
+
+Reference parity: elasticdl/python/common/log_utils.py.
+"""
+
+import logging
+import os
+import sys
+
+_FORMAT = (
+    "[%(asctime)s] [%(levelname)s] "
+    "[%(name)s:%(lineno)d] %(message)s"
+)
+
+_configured = False
+
+
+def default_logger(name: str = "elasticdl_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT))
+        root = logging.getLogger("elasticdl_tpu")
+        root.addHandler(handler)
+        root.setLevel(os.environ.get("EDL_LOG_LEVEL", "INFO").upper())
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(name)

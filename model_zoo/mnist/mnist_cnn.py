@@ -1,0 +1,69 @@
+"""MNIST CNN — parity config #1 (BASELINE.md: "MNIST CNN, single-worker allreduce").
+
+Reference parity: model_zoo/mnist/mnist_functional_api.py and
+mnist_subclass.py in the reference model zoo (Keras CNN: 2 conv + 2 dense).
+Rebuilt as a flax.linen module; compute in bfloat16 for the MXU, params fp32.
+"""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.training import metrics as metrics_lib
+
+
+class MnistCNN(nn.Module):
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        # x: (B, 28, 28, 1) float32 in [0, 1]
+        x = x.astype(self.compute_dtype)
+        x = nn.Conv(32, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.25, deterministic=not training)(x)
+        x = nn.Dense(128, dtype=self.compute_dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not training)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x
+
+
+def custom_model(**kwargs):
+    return MnistCNN(
+        num_classes=int(kwargs.get("num_classes", 10)),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+    )
+
+
+def loss(labels, outputs):
+    # per-example; the framework applies the padding mask and takes the mean
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, jnp.asarray(labels, jnp.int32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    return optax.sgd(float(kwargs.get("learning_rate", 0.01)), momentum=0.9)
+
+
+def dataset_fn(mode, metadata):
+    """Parse one raw record: 1 label byte + 784 pixel bytes (uint8)."""
+
+    def parse(record: bytes):
+        buf = np.frombuffer(record, dtype=np.uint8)
+        label = buf[0].astype(np.int32)
+        image = (buf[1:785].astype(np.float32) / 255.0).reshape(28, 28, 1)
+        return image, label
+
+    return parse
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics_lib.Accuracy()}

@@ -1,0 +1,49 @@
+"""Test harness: force a hermetic 8-device virtual CPU mesh.
+
+SURVEY.md §4: multi-device logic is unit-tested on a virtual CPU mesh
+(`--xla_force_host_platform_device_count=8`), matching the reference's
+"whole control plane in one process" test strategy.
+
+This sandbox routes JAX to one real TPU chip through a tunnel
+(JAX_PLATFORMS=axon set at interpreter start), so plain env overrides are
+too late — the platform config is frozen during sitecustomize. We force the
+platform back to cpu via jax.config and drop the tunnel backend factory so
+tests never touch (or block on) the TPU tunnel.
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb  # noqa: E402
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from elasticdl_tpu.parallel.mesh import build_mesh
+
+    return build_mesh()
+
+
+@pytest.fixture(scope="session")
+def mesh_4x2():
+    from elasticdl_tpu.parallel.mesh import build_mesh
+
+    return build_mesh({"data": 4, "model": 2})

@@ -1,0 +1,45 @@
+"""Config argv round-trip — the propagation pattern SURVEY.md §5 calls
+load-bearing (reference: elasticdl/python/common/args.py)."""
+
+from elasticdl_tpu.common.config import JobConfig, parse_kv_params
+
+
+def test_argv_round_trip():
+    cfg = JobConfig(
+        job_name="t1",
+        model_def="mnist.mnist_cnn.custom_model",
+        model_params={"learning_rate": 0.05, "num_classes": 10},
+        minibatch_size=128,
+        num_workers=4,
+        mesh_shape="4,2",
+        checkpoint_steps=100,
+        shuffle=False,
+    )
+    argv = cfg.to_argv()
+    cfg2 = JobConfig.from_argv(argv)
+    assert cfg2 == cfg
+
+
+def test_defaults_not_serialized():
+    cfg = JobConfig(model_def="m.n.f")
+    argv = cfg.to_argv()
+    assert argv == ["--model_def", "m.n.f"]
+
+
+def test_kv_params():
+    d = parse_kv_params("lr=0.1;layers=3;name=foo;flag=true")
+    assert d == {"lr": 0.1, "layers": 3, "name": "foo", "flag": True}
+
+
+def test_mesh_axes_sizes():
+    cfg = JobConfig(model_def="m.n.f")
+    assert cfg.mesh_axes_sizes(8) == {"data": 8}
+    cfg2 = cfg.replace(mesh_shape="4,2")
+    assert cfg2.mesh_axes_sizes(8) == {"data": 4, "model": 2}
+
+
+def test_validate_rejects_missing_model_def():
+    import pytest
+
+    with pytest.raises(ValueError):
+        JobConfig().validate()

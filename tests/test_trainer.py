@@ -1,0 +1,122 @@
+"""Trainer on the 8-device CPU mesh: loss decreases, eval metrics work,
+padding mask honored. Mirrors the reference's worker-trainer unit tests
+(reference: elasticdl/python/tests/worker_test.py) without a cluster."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.training.model_spec import ModelSpec
+from elasticdl_tpu.training.trainer import Trainer
+
+
+def make_spec(**model_params):
+    cfg = JobConfig(
+        model_zoo="model_zoo",
+        model_def="mnist.mnist_cnn.custom_model",
+        model_params=model_params,
+    )
+    return ModelSpec.from_config(cfg)
+
+
+def synthetic_batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    # images whose mean encodes the class: learnable by a CNN quickly
+    labels = rng.randint(0, 10, size=(n,)).astype(np.int32)
+    images = rng.rand(n, 28, 28, 1).astype(np.float32) * 0.1
+    images += labels[:, None, None, None].astype(np.float32) / 10.0
+    return {"features": images, "labels": labels, "mask": np.ones((n,), np.float32)}
+
+
+@pytest.fixture(scope="module")
+def trainer(mesh8):
+    spec = make_spec(learning_rate=0.01)
+    return Trainer(spec, mesh8, seed=0)
+
+
+@pytest.fixture()
+def state0(trainer):
+    # function-scoped: train_step donates the state's buffers, so a shared
+    # state would be consumed by the first test that trains on it
+    return trainer.init_state(synthetic_batch())
+
+
+def test_loss_decreases(trainer, state0):
+    state = state0
+    losses = []
+    for i in range(40):
+        state, logs = trainer.train_step(state, synthetic_batch(seed=i % 4))
+        losses.append(float(logs["loss"]))
+    assert state.model_version == 40
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_eval_metrics(trainer, state0):
+    ms = trainer.new_metric_states()
+    for i in range(3):
+        ms = trainer.eval_step(state0, synthetic_batch(seed=100 + i), ms)
+    res = trainer.metric_results(ms)
+    assert "accuracy" in res and "loss" in res
+    assert 0.0 <= res["accuracy"] <= 1.0
+
+
+def test_mask_excludes_padded_rows(trainer, state0):
+    b = synthetic_batch(n=8, seed=3)
+    # poison the padded rows; with mask=0 they must not affect metrics
+    b_masked = {
+        "features": b["features"].copy(),
+        "labels": b["labels"].copy(),
+        "mask": np.array([1, 1, 1, 1, 0, 0, 0, 0], np.float32),
+    }
+    b_masked["labels"][4:] = (b_masked["labels"][4:] + 5) % 10
+
+    b_half = {
+        "features": b["features"][:4].repeat(2, axis=0),
+        "labels": b["labels"][:4].repeat(2, axis=0),
+        "mask": np.ones((8,), np.float32),
+    }
+    ms1 = trainer.eval_step(state0, b_masked, trainer.new_metric_states())
+    r1 = trainer.metric_results(ms1)
+
+    ms2 = trainer.new_metric_states()
+    b_first4 = {
+        "features": b["features"][:4].repeat(2, axis=0)[:8],
+        "labels": b["labels"][:4].repeat(2, axis=0)[:8],
+        "mask": np.array([1, 0, 1, 0, 1, 0, 1, 0], np.float32),
+    }
+    del b_half
+    ms2 = trainer.eval_step(state0, b_first4, ms2)
+    r2 = trainer.metric_results(ms2)
+    # both see exactly examples 0..3 once (up to ordering) → same loss
+    assert np.isclose(r1["loss"], r2["loss"], rtol=1e-3), (r1, r2)
+
+
+def test_predict_step(trainer, state0):
+    out = trainer.predict_step(state0, synthetic_batch(n=16))
+    assert out.shape == (16, 10)
+
+
+def test_batch_is_sharded_over_data_axis(trainer, state0, mesh8):
+    import jax
+    from elasticdl_tpu.parallel.mesh import shard_batch
+
+    b = shard_batch(mesh8, synthetic_batch(n=32))
+    shards = b["features"].sharding.num_devices if hasattr(b["features"], "sharding") else 1
+    assert shards == 8
+
+
+def test_metrics_merge_across_workers(trainer, state0):
+    from elasticdl_tpu.training import metrics as M
+
+    ms_a = trainer.eval_step(state0, synthetic_batch(seed=7), trainer.new_metric_states())
+    ms_b = trainer.eval_step(state0, synthetic_batch(seed=8), trainer.new_metric_states())
+    merged = M.merge_states(
+        {k: np.asarray(v) for k, v in ms_a.items()},
+        {k: np.asarray(v) for k, v in ms_b.items()},
+    )
+    both = trainer.eval_step(
+        state0, synthetic_batch(seed=8),
+        trainer.eval_step(state0, synthetic_batch(seed=7), trainer.new_metric_states()),
+    )
+    for k in merged:
+        assert np.allclose(merged[k], np.asarray(both[k]), rtol=1e-4), k
