@@ -1,0 +1,57 @@
+"""User-facing layers — THE key abstraction being `Embedding`.
+
+Reference parity: `elasticdl.layers.Embedding`
+(elasticdl/python/elasticdl/layers/embedding.py) — a Keras layer that pulls
+only the touched rows from the parameter-server tier per batch and pushes
+per-id sparse gradients back. Here the table is a mesh-sharded `jax.Array`
+param living in HBM; lookup + gradient scatter-add are ICI collectives inside
+the jitted step (see elasticdl_tpu/ops/embedding.py). The layer is
+mesh-agnostic: its partitioning metadata names every ambient mesh axis at
+init time, so the same model runs on a 1-D ("data",) or 2-D ("data","model")
+mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from elasticdl_tpu.ops import embedding as emb_ops
+
+
+class Embedding(nn.Module):
+    """Mesh-sharded embedding with optional bag combiner.
+
+    input_dim: vocabulary size (rows are padded to emb_ops.VOCAB_ALIGN so any
+      mesh up to that many shards divides the table evenly).
+    output_dim: embedding dimension.
+    combiner: None → (..., L, D); 'sum'|'mean'|'sqrtn' → (..., D) over the
+      last id axis, with negative ids treated as padding slots.
+    mode: 'manual' (explicit shard_map collectives) or 'auto' (XLA GSPMD).
+    """
+
+    input_dim: int
+    output_dim: int
+    combiner: Optional[str] = None
+    mode: str = "manual"
+    embeddings_initializer: Callable = nn.initializers.uniform(scale=0.05)
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, ids: jax.Array, weights: Optional[jax.Array] = None):
+        rows = emb_ops.padded_vocab(self.input_dim)
+        axes = emb_ops.table_partition_axes()
+        table = self.param(
+            "table",
+            nn.with_partitioning(
+                self.embeddings_initializer, (axes if axes else None, None)
+            ),
+            (rows, self.output_dim),
+            self.param_dtype,
+        )
+        ids = jnp.asarray(ids, jnp.int32)
+        vectors = emb_ops.embedding_lookup(table, ids, mode=self.mode)
+        return emb_ops.combine(vectors, self.combiner, ids, weights)

@@ -1,0 +1,162 @@
+"""Feature preprocessing — the elasticdl_preprocessing equivalent.
+
+Reference parity: elasticdl_preprocessing/layers/*.py (Hashing, IndexLookup,
+Discretization, Normalizer, ConcatenateWithOffset, ToSparse/ToRagged) used by
+the census/deepfm zoo models.
+
+TPU-first split: XLA cannot process strings, so preprocessing is split into
+- HOST side (runs in the data pipeline, numpy): string hashing/lookup,
+  ragged→padded-dense conversion;
+- DEVICE side (jit-friendly jnp ops, usable inside models): integer hashing,
+  bucketization, normalization, id-space concatenation with offsets.
+The reference ran everything in the TF graph; here the host half runs once in
+the input pipeline where it belongs, and the device half fuses into the step.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------- #
+# Device-side (jit-friendly)
+
+
+def hash_bucket(ids, num_bins: int):
+    """Deterministic integer hash → [0, num_bins). Fibonacci/Knuth
+    multiplicative hashing — one multiply + shift, VPU-friendly.
+
+    Reference parity: Hashing layer (hash trick for unbounded vocabularies;
+    the same trick that bounds the PS embedding table's key space).
+    """
+    x = jnp.asarray(ids, jnp.uint32)
+    x ^= x >> 16
+    x = x * jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x = x * jnp.uint32(0xC2B2AE35)
+    x ^= x >> 16
+    return (x % jnp.uint32(num_bins)).astype(jnp.int32)
+
+
+def bucketize(values, boundaries: Sequence[float]):
+    """Discretization: continuous → bucket id in [0, len(boundaries)]."""
+    b = jnp.asarray(np.asarray(boundaries, np.float32))
+    return jnp.searchsorted(b, jnp.asarray(values, jnp.float32), side="right").astype(
+        jnp.int32
+    )
+
+
+def normalize(values, mean, std):
+    """Standard-score normalization with fixed statistics."""
+    return (jnp.asarray(values, jnp.float32) - mean) / jnp.maximum(std, 1e-12)
+
+
+def log_normalize(values):
+    """log(1+x) squashing — the standard Criteo dense-feature transform."""
+    v = jnp.asarray(values, jnp.float32)
+    return jnp.log1p(jnp.maximum(v, 0.0))
+
+
+def concat_with_offset(id_groups: Sequence[jax.Array], sizes: Sequence[int]):
+    """Concatenate per-feature id spaces into one shared table's id space.
+
+    Reference parity: ConcatenateWithOffset — feature f's ids shift by
+    sum(sizes[:f]) so one sharded table serves all features. Negative
+    (padding) ids stay negative. Returns ids shaped (..., sum of group widths).
+    """
+    if len(id_groups) != len(sizes):
+        raise ValueError("id_groups and sizes must align")
+    out = []
+    offset = 0
+    for ids, size in zip(id_groups, sizes):
+        ids = jnp.asarray(ids, jnp.int32)
+        out.append(jnp.where(ids >= 0, ids + offset, ids))
+        offset += int(size)
+    return jnp.concatenate([o.reshape(o.shape[0], -1) for o in out], axis=-1)
+
+
+def int_lookup(values, vocab: Sequence[int], num_oov: int = 1):
+    """Device-side IndexLookup over a static integer vocabulary.
+
+    Maps vocab[i] → num_oov + i; everything else hashes into [0, num_oov).
+    """
+    v = np.sort(np.asarray(vocab, np.int32))
+    sorted_vocab = jnp.asarray(v)
+    x = jnp.asarray(values, jnp.int32)
+    pos = jnp.searchsorted(sorted_vocab, x)
+    pos_c = jnp.clip(pos, 0, len(v) - 1)
+    found = sorted_vocab[pos_c] == x
+    oov = (
+        hash_bucket(x.astype(jnp.int32), num_oov)
+        if num_oov > 0
+        else jnp.zeros_like(pos_c, jnp.int32)
+    )
+    return jnp.where(found, pos_c.astype(jnp.int32) + num_oov, oov)
+
+
+# ---------------------------------------------------------------------- #
+# Host-side (numpy, runs in the data pipeline)
+
+
+def hash_strings(values, num_bins: int) -> np.ndarray:
+    """Deterministic string→bucket hashing (crc32; stable across processes,
+    unlike Python's salted hash())."""
+    flat = np.asarray(values).reshape(-1)
+    out = np.empty(flat.shape[0], np.int32)
+    for i, s in enumerate(flat):
+        if isinstance(s, bytes):
+            b = s
+        else:
+            b = str(s).encode("utf-8")
+        out[i] = zlib.crc32(b) % num_bins
+    return out.reshape(np.asarray(values).shape)
+
+
+class StringLookup:
+    """Host-side IndexLookup for string vocabularies.
+
+    vocab[i] → num_oov + i; unknown strings hash into [0, num_oov).
+    """
+
+    def __init__(self, vocab: Sequence[str], num_oov: int = 1):
+        self.num_oov = num_oov
+        self.table: Dict[str, int] = {
+            (v if isinstance(v, str) else v.decode("utf-8")): i + num_oov
+            for i, v in enumerate(vocab)
+        }
+        self.vocab_size = len(self.table) + num_oov
+
+    def __call__(self, values) -> np.ndarray:
+        flat = np.asarray(values).reshape(-1)
+        out = np.empty(flat.shape[0], np.int32)
+        for i, s in enumerate(flat):
+            key = s.decode("utf-8") if isinstance(s, bytes) else str(s)
+            hit = self.table.get(key)
+            if hit is None:
+                hit = (
+                    zlib.crc32(key.encode("utf-8")) % self.num_oov
+                    if self.num_oov > 0
+                    else 0
+                )
+            out[i] = hit
+        return out.reshape(np.asarray(values).shape)
+
+
+def pad_to_dense(
+    rows: List[Sequence[int]], max_len: int, pad_value: int = -1
+) -> np.ndarray:
+    """Ragged id lists → (N, max_len) padded-dense int32 with sentinel pads.
+
+    Reference parity: ToSparse/SparseTensor bag inputs. XLA needs static
+    shapes, so ragged bags become fixed-width rows; negative ids are treated
+    as padding by Embedding/combine.
+    """
+    out = np.full((len(rows), max_len), pad_value, np.int32)
+    for i, r in enumerate(rows):
+        r = list(r)[:max_len]
+        out[i, : len(r)] = r
+    return out

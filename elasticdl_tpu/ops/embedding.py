@@ -1,0 +1,147 @@
+"""Mesh-sharded embedding lookup — the HBM replacement for the parameter
+server's embedding tables.
+
+Reference parity: the reference stores embedding tables as per-PS-pod hash
+maps (elasticdl/pkg/ps/embedding.go), shards rows by `id % ps_num`
+(elasticdl/python/worker/ps_client.py), and pays two gRPC round-trips per
+minibatch to pull vectors and push sparse gradients
+(elasticdl/python/worker/worker.py → pull_embedding_vectors/push_gradients).
+
+Rebuilt TPU-native: the table is ONE `jax.Array` whose rows are sharded
+contiguously over every mesh axis. Lookup and gradient scatter-add happen
+*inside* the jitted train step, so "pull" and "push" become ICI collectives:
+
+  manual mode (shard_map):
+    all_gather(ids over data axis)         # tiny int32 traffic
+    local dense gather on each row shard   # MXU-friendly, static shapes
+    psum_scatter(partials over data axis)  # returns each device its batch rows
+    psum(over model axis)                  # combine row-shard contributions
+  backward is the exact transpose (autodiff through shard_map): all_gather of
+  output grads + local scatter-add into the row shard.
+
+  auto mode: `jnp.take` on the sharded table; XLA's SPMD partitioner inserts
+  an equivalent collective schedule. Kept as the fallback/baseline; `manual`
+  makes the schedule explicit and predictable.
+
+Lazy row materialization (reference: EmbeddingTable lazy-init on first pull)
+is replaced by full-table initialization at state-creation time, shard-wise on
+each device — XLA wants static shapes, and hashed/mod vocab (see
+preprocessing.hashing) bounds the table like the reference's Hashing layer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+
+# Table rows are padded to a multiple of this so every device of any mesh up
+# to this many chips gets an equal shard (shard_map needs even shards).
+VOCAB_ALIGN = 256
+
+
+def padded_vocab(vocab_size: int, align: int = VOCAB_ALIGN) -> int:
+    return ((vocab_size + align - 1) // align) * align
+
+
+def ambient_axes() -> Tuple[str, ...]:
+    """Mesh axis names of the ambient `jax.set_mesh` context ('' if none)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    return tuple(mesh.axis_names)
+
+
+def table_partition_axes(axes: Optional[Sequence[str]] = None) -> Tuple[str, ...]:
+    """Axes that shard embedding rows: every ambient mesh axis, in order."""
+    if axes is not None:
+        return tuple(axes)
+    return ambient_axes()
+
+
+def embedding_lookup(
+    table: jax.Array,
+    ids: jax.Array,
+    mode: str = "manual",
+) -> jax.Array:
+    """Gather rows of a mesh-sharded `table` for a batch of `ids`.
+
+    table: (V, D) sharded P((all mesh axes), None); ids: int32 (B, ...) sharded
+    P(data, None...). Returns (B, ..., D) with ids' batch sharding.
+    Out-of-range ids return zero vectors (used for padding sentinels).
+    """
+    axes = ambient_axes()
+    in_range = (ids >= 0) & (ids < table.shape[0])
+    safe_ids = jnp.where(in_range, ids, 0)
+
+    if mode == "auto" or not axes:
+        out = jnp.take(table, safe_ids, axis=0)
+        return jnp.where(in_range[..., None], out, 0.0)
+
+    if mode != "manual":
+        raise ValueError(f"unknown embedding lookup mode {mode!r}")
+
+    data_ax = MeshAxis.DATA if MeshAxis.DATA in axes else axes[0]
+    other_axes = tuple(a for a in axes if a != data_ax)
+    mesh = jax.sharding.get_abstract_mesh()
+    n_shards = 1
+    for a in axes:
+        n_shards *= mesh.shape[a]
+    if table.shape[0] % n_shards:
+        raise ValueError(
+            f"manual embedding lookup needs table rows ({table.shape[0]}) "
+            f"divisible by total shards ({n_shards}); pad the vocab "
+            f"(see padded_vocab / VOCAB_ALIGN)"
+        )
+
+    ids2d = safe_ids.reshape(safe_ids.shape[0], -1)  # (B, L)
+
+    def shard_fn(table_shard, ids_local):
+        # table_shard: (V/n, D); ids_local: (B/d, L)
+        all_ids = jax.lax.all_gather(ids_local, data_ax, tiled=True)  # (B, L)
+        shard = jax.lax.axis_index(axes)  # linear index over all axes, row-major
+        offset = shard * table_shard.shape[0]
+        local = all_ids - offset
+        owned = (local >= 0) & (local < table_shard.shape[0])
+        part = jnp.where(
+            owned[..., None], table_shard[jnp.where(owned, local, 0)], 0.0
+        )  # (B, L, D)
+        out = jax.lax.psum_scatter(
+            part, data_ax, scatter_dimension=0, tiled=True
+        )  # (B/d, L, D)
+        if other_axes:
+            out = jax.lax.psum(out, other_axes)
+        return out
+
+    out = jax.shard_map(
+        shard_fn,
+        in_specs=(P(axes, None), P(data_ax, None)),
+        out_specs=P(data_ax, None, None),
+    )(table, ids2d)
+    out = out.reshape(*safe_ids.shape, table.shape[1])
+    return jnp.where(in_range[..., None], out, 0.0)
+
+
+def combine(vectors: jax.Array, combiner: Optional[str], ids: jax.Array,
+            weights: Optional[jax.Array] = None) -> jax.Array:
+    """Bag-combine (B, L, D) lookups over L (reference: the Embedding layer's
+    `combiner` for sparse bag inputs). Pad slots are marked by negative ids.
+
+    combiner: None → (B, L, D); 'sum'|'mean'|'sqrtn' → (B, D).
+    """
+    if combiner is None:
+        return vectors
+    valid = (ids >= 0).astype(vectors.dtype)
+    w = valid if weights is None else weights.astype(vectors.dtype) * valid
+    weighted = vectors * w[..., None]
+    s = jnp.sum(weighted, axis=-2)
+    if combiner == "sum":
+        return s
+    denom = jnp.sum(w, axis=-1, keepdims=True)
+    if combiner == "mean":
+        return s / jnp.maximum(denom, 1e-9)
+    if combiner == "sqrtn":
+        return s / jnp.sqrt(jnp.maximum(denom, 1e-9))
+    raise ValueError(f"unknown combiner {combiner!r}")

@@ -27,7 +27,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 from flax import struct
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.parallel import mesh as mesh_lib
@@ -121,30 +121,42 @@ class Trainer:
         def _variables(rng):
             return model.init({"params": rng, "dropout": rng}, features, training=False)
 
-        abstract = jax.eval_shape(_variables, root_key)
-        specs = nn.get_partition_spec(abstract)
-        param_shardings = jax.tree_util.tree_map(
-            lambda s: NamedSharding(self.mesh, s),
-            specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+        with jax.set_mesh(self.mesh):
+            # Derive shardings from flax partitioning metadata. Optimizer
+            # slots (Adam mu/nu, …) must shard exactly like their params —
+            # the PS slot tables of the reference (elasticdl/pkg/ps/
+            # embedding.go Adam slot tables) sharded with the rows. optax
+            # tree ops preserve nn.Partitioned boxes, so running tx.init on
+            # the *boxed* abstract params yields boxed slots whose specs we
+            # can read; GSPMD propagation alone leaves them replicated.
+            def _abstract(rng):
+                variables = _variables(rng)
+                return variables, tx.init(variables["params"])
 
-        def _create(rng):
-            variables = nn.meta.unbox(_variables(rng))
-            variables = jax.tree_util.tree_map(
-                jax.lax.with_sharding_constraint, variables, param_shardings
-            )
-            params = variables.pop("params")
-            opt_state = tx.init(params)
-            return TrainState(
-                step=jnp.zeros((), jnp.int32),
-                params=params,
-                opt_state=opt_state,
-                extra_vars=variables,
-                rng=rng,
-            )
+            abstract, abstract_opt = jax.eval_shape(_abstract, root_key)
+            param_shardings = nn.get_sharding(abstract, self.mesh)
+            opt_shardings = nn.get_sharding(abstract_opt, self.mesh)
 
-        state = jax.jit(_create)(root_key)
+            def _create(rng):
+                variables = nn.meta.unbox(_variables(rng))
+                variables = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint, variables, param_shardings
+                )
+                params = variables.pop("params")
+                opt_state = jax.tree_util.tree_map(
+                    jax.lax.with_sharding_constraint,
+                    tx.init(params),
+                    opt_shardings,
+                )
+                return TrainState(
+                    step=jnp.zeros((), jnp.int32),
+                    params=params,
+                    opt_state=opt_state,
+                    extra_vars=variables,
+                    rng=rng,
+                )
+
+            state = jax.jit(_create)(root_key)
         n = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
         logger.info("Initialized model %s: %.3fM params", self.spec.module_name, n / 1e6)
         return state
@@ -238,7 +250,8 @@ class Trainer:
         if self._train_step is None:
             self._train_step = self._build_train_step()
         batch = mesh_lib.shard_batch(self.mesh, batch)
-        return self._train_step(state, batch)
+        with jax.set_mesh(self.mesh):
+            return self._train_step(state, batch)
 
     def new_metric_states(self) -> Dict[str, np.ndarray]:
         states = metrics_lib.init_states(self.metrics)
@@ -249,13 +262,15 @@ class Trainer:
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
         batch = mesh_lib.shard_batch(self.mesh, batch)
-        return self._eval_step(state, batch, metric_states)
+        with jax.set_mesh(self.mesh):
+            return self._eval_step(state, batch, metric_states)
 
     def predict_step(self, state: TrainState, batch):
         if self._predict_step is None:
             self._predict_step = self._build_predict_step()
         batch = mesh_lib.shard_batch(self.mesh, batch)
-        return self._predict_step(state, batch)
+        with jax.set_mesh(self.mesh):
+            return self._predict_step(state, batch)
 
     def metric_results(self, metric_states) -> Dict[str, float]:
         states = {k: np.asarray(jax.device_get(v)) for k, v in metric_states.items()}

@@ -1,0 +1,143 @@
+"""Census-income Wide & Deep — parity config #3 (BASELINE.md: "Census
+Wide&Deep, PS-style sharded embeddings").
+
+Reference parity: the reference's census zoo model (model_zoo/census_*,
+built from feature columns + elasticdl_preprocessing layers). Rebuilt with
+the TPU-first preprocessing split: string columns are hashed/looked-up on the
+HOST in dataset_fn (XLA has no strings); the model receives
+  "dense": (B, 5)  normalized numerics (age, education_num, capital_gain,
+           capital_loss, hours_per_week)
+  "cat":   (B, 9)  int32 ids, one per categorical column (one shared id
+           space, offset per column — ConcatenateWithOffset)
+Wide = one linear weight per id (an output_dim-1 sharded Embedding, exactly
+the PS-tier wide column of the reference); Deep = D-dim embeddings + MLP.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.api.layers import Embedding
+from elasticdl_tpu.api import preprocessing as pp
+from elasticdl_tpu.training import metrics as metrics_lib
+
+# (name, hash buckets) per categorical column; one shared, offset id space.
+CAT_COLUMNS = (
+    ("workclass", 64),
+    ("education", 64),
+    ("marital_status", 32),
+    ("occupation", 128),
+    ("relationship", 32),
+    ("race", 16),
+    ("sex", 8),
+    ("native_country", 128),
+    ("age_bucket", 16),
+)
+DENSE_COLUMNS = ("age", "education_num", "capital_gain", "capital_loss", "hours_per_week")
+# Means/stds of the UCI adult training split (fixed normalization statistics).
+DENSE_STATS = {
+    "age": (38.6, 13.6),
+    "education_num": (10.1, 2.6),
+    "capital_gain": (1078.0, 7385.0),
+    "capital_loss": (87.3, 403.0),
+    "hours_per_week": (40.4, 12.3),
+}
+AGE_BOUNDARIES = (18, 25, 30, 35, 40, 45, 50, 55, 60, 65)
+TOTAL_VOCAB = sum(size for _, size in CAT_COLUMNS)
+
+
+class WideDeep(nn.Module):
+    embedding_dim: int = 8
+    hidden: Tuple[int, ...] = (128, 64)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    embedding_mode: str = "manual"
+
+    @nn.compact
+    def __call__(self, feats, training: bool = False):
+        ids, dense = feats["cat"], feats["dense"]
+        wide = Embedding(TOTAL_VOCAB, 1, mode=self.embedding_mode, name="wide")(ids)
+        wide_logit = jnp.sum(wide[..., 0], axis=1)
+
+        emb = Embedding(
+            TOTAL_VOCAB, self.embedding_dim, mode=self.embedding_mode, name="deep"
+        )(ids)                                                   # (B, C, D)
+        x = jnp.concatenate([emb.reshape(emb.shape[0], -1), dense], axis=-1)
+        x = x.astype(self.compute_dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, dtype=self.compute_dtype, name=f"deep_{i}")(x)
+            x = nn.relu(x)
+        deep_logit = nn.Dense(1, dtype=jnp.float32, name="deep_out")(x).reshape(-1)
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        return wide_logit + deep_logit + bias[0]
+
+
+def custom_model(**kwargs):
+    return WideDeep(
+        embedding_dim=int(kwargs.get("embedding_dim", 8)),
+        hidden=tuple(int(h) for h in str(kwargs.get("hidden", "128,64")).split(",")),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+        embedding_mode=str(kwargs.get("embedding_mode", "manual")),
+    )
+
+
+def loss(labels, outputs):
+    return optax.sigmoid_binary_cross_entropy(
+        outputs, jnp.asarray(labels, jnp.float32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))
+
+
+# CSV column order of the UCI adult dataset.
+_CSV_COLUMNS = (
+    "age", "workclass", "fnlwgt", "education", "education_num",
+    "marital_status", "occupation", "relationship", "race", "sex",
+    "capital_gain", "capital_loss", "hours_per_week", "native_country", "label",
+)
+
+
+def dataset_fn(mode, metadata):
+    """Parse one adult-census CSV line into the model's feature dict.
+
+    Host-side preprocessing: string hashing (crc32), age bucketization,
+    fixed-stat normalization, per-column id offsets.
+    """
+    col_offset = {}
+    off = 0
+    for name, size in CAT_COLUMNS:
+        col_offset[name] = (off, size)
+        off += size
+
+    def parse(record: bytes):
+        parts = [p.strip() for p in record.decode("utf-8").rstrip("\n").split(",")]
+        row = dict(zip(_CSV_COLUMNS, parts))
+        label = np.int32(1 if ">50K" in row.get("label", "") else 0)
+
+        dense = np.array(
+            [
+                (float(row.get(c, 0) or 0) - DENSE_STATS[c][0]) / DENSE_STATS[c][1]
+                for c in DENSE_COLUMNS
+            ],
+            np.float32,
+        )
+        ids = []
+        for name, size in CAT_COLUMNS:
+            base, _ = col_offset[name]
+            if name == "age_bucket":
+                age = float(row.get("age", 0) or 0)
+                bucket = int(np.searchsorted(AGE_BOUNDARIES, age, side="right"))
+            else:
+                bucket = int(pp.hash_strings([row.get(name, "")], size)[0])
+            ids.append(base + bucket)
+        return {"dense": dense, "cat": np.array(ids, np.int32)}, label
+
+    return parse
+
+
+def eval_metrics_fn():
+    return {"auc": metrics_lib.AUC(), "accuracy": metrics_lib.Accuracy()}

@@ -1,0 +1,119 @@
+"""DeepFM on Criteo-style data — parity config #4 and the bench flagship
+(BASELINE.md north star: Criteo-1TB DeepFM to AUC 0.80 on v5e-32).
+
+Reference parity: the reference's deepfm zoo model (model_zoo/deepfm/*,
+using elasticdl.layers.Embedding against the PS tier with async SGD).
+Rebuilt sync-DP (SURVEY.md §7 documents the semantic change): one shared
+mesh-sharded embedding table for all 26 categorical fields (ids offset per
+field), FM first+second order, and a bfloat16 DNN tower on the MXU.
+
+Input features:
+  "dense": (B, 13) float32 raw counts (log1p applied on device)
+  "cat":   (B, 26) int32 raw categorical values (hashed on device into
+           per-field buckets — the Hashing-layer trick that bounds the table)
+Labels: (B,) {0,1} click. Output: (B,) logits.
+"""
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.api.layers import Embedding
+from elasticdl_tpu.api import preprocessing as pp
+from elasticdl_tpu.training import metrics as metrics_lib
+
+NUM_DENSE = 13
+NUM_CAT = 26
+
+
+class DeepFM(nn.Module):
+    field_vocab: int = 100_000        # hash buckets per categorical field
+    embedding_dim: int = 16
+    hidden: Tuple[int, ...] = (400, 400)
+    dropout: float = 0.0
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    embedding_mode: str = "manual"
+
+    @nn.compact
+    def __call__(self, feats, training: bool = False):
+        dense = pp.log_normalize(feats["dense"])                  # (B, 13)
+        hashed = pp.hash_bucket(feats["cat"], self.field_vocab)   # (B, 26)
+        offsets = jnp.arange(NUM_CAT, dtype=jnp.int32) * self.field_vocab
+        ids = hashed + offsets[None, :]                           # shared id space
+        vocab = NUM_CAT * self.field_vocab
+
+        emb = Embedding(
+            vocab, self.embedding_dim, mode=self.embedding_mode, name="fm_embedding"
+        )(ids)                                                    # (B, 26, D)
+        lin = Embedding(vocab, 1, mode=self.embedding_mode, name="fm_linear")(ids)
+
+        # FM second order: 0.5 * ((Σ_f v_f)^2 − Σ_f v_f^2), summed over D
+        sum_v = jnp.sum(emb, axis=1)
+        fm2 = 0.5 * jnp.sum(sum_v * sum_v - jnp.sum(emb * emb, axis=1), axis=-1)
+
+        first_order = jnp.sum(lin[..., 0], axis=1) + nn.Dense(
+            1, dtype=jnp.float32, name="dense_linear"
+        )(dense).reshape(-1)
+
+        x = jnp.concatenate(
+            [emb.reshape(emb.shape[0], -1), dense], axis=-1
+        ).astype(self.compute_dtype)
+        for i, h in enumerate(self.hidden):
+            x = nn.Dense(h, dtype=self.compute_dtype, name=f"dnn_{i}")(x)
+            x = nn.relu(x)
+            if self.dropout > 0:
+                x = nn.Dropout(self.dropout, deterministic=not training)(x)
+        dnn_out = nn.Dense(1, dtype=jnp.float32, name="dnn_out")(x).reshape(-1)
+
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        return first_order + fm2.astype(jnp.float32) + dnn_out + bias[0]
+
+
+def custom_model(**kwargs):
+    return DeepFM(
+        field_vocab=int(kwargs.get("field_vocab", 100_000)),
+        embedding_dim=int(kwargs.get("embedding_dim", 16)),
+        hidden=tuple(
+            int(h) for h in str(kwargs.get("hidden", "400,400")).split(",")
+        ),
+        dropout=float(kwargs.get("dropout", 0.0)),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+        embedding_mode=str(kwargs.get("embedding_mode", "manual")),
+    )
+
+
+def loss(labels, outputs):
+    return optax.sigmoid_binary_cross_entropy(
+        outputs, jnp.asarray(labels, jnp.float32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))
+
+
+def dataset_fn(mode, metadata):
+    """Parse a Criteo TSV line: label \\t 13 ints \\t 26 hex categoricals."""
+
+    def parse(record: bytes):
+        parts = record.decode("utf-8", errors="replace").rstrip("\n").split("\t")
+        label = np.int32(int(parts[0]) if parts[0] else 0)
+        dense = np.array(
+            [float(p) if p else 0.0 for p in parts[1 : 1 + NUM_DENSE]], np.float32
+        )
+        cat = np.array(
+            [int(p, 16) & 0x7FFFFFFF if p else 0 for p in parts[1 + NUM_DENSE :][:NUM_CAT]],
+            np.int32,
+        )
+        if cat.shape[0] < NUM_CAT:
+            cat = np.pad(cat, (0, NUM_CAT - cat.shape[0]))
+        return {"dense": dense, "cat": cat}, label
+
+    return parse
+
+
+def eval_metrics_fn():
+    return {"auc": metrics_lib.AUC(), "accuracy": metrics_lib.Accuracy()}

@@ -1,0 +1,103 @@
+"""xDeepFM — parity config #4b (reference model_zoo xdeepfm variant).
+
+DeepFM plus a Compressed Interaction Network (CIN): explicit high-order
+feature interactions computed as einsums — exactly the shape of work the MXU
+is built for (batched matmuls over (field, dim) planes), in bfloat16.
+"""
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from model_zoo.deepfm.deepfm import (
+    DeepFM,
+    NUM_CAT,
+    dataset_fn,  # noqa: F401  (same Criteo record format)
+    eval_metrics_fn,  # noqa: F401
+    loss,  # noqa: F401
+)
+
+
+class CIN(nn.Module):
+    layer_sizes: Tuple[int, ...] = (128, 128)
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x0):
+        # x0: (B, F, D)
+        x0 = x0.astype(self.compute_dtype)
+        xk = x0
+        outs = []
+        for i, h in enumerate(self.layer_sizes):
+            # outer interaction: (B, Hk, F, D)
+            z = jnp.einsum("bhd,bfd->bhfd", xk, x0)
+            z = z.reshape(z.shape[0], -1, z.shape[-1])       # (B, Hk*F, D)
+            w = self.param(
+                f"w{i}",
+                nn.initializers.glorot_uniform(),
+                (h, z.shape[1]),
+                jnp.float32,
+            ).astype(self.compute_dtype)
+            xk = jnp.einsum("on,bnd->bod", w, z)             # (B, h, D)
+            outs.append(jnp.sum(xk, axis=-1))                # (B, h)
+        return jnp.concatenate(outs, axis=-1)
+
+
+class XDeepFM(nn.Module):
+    base: DeepFM
+    cin_sizes: Tuple[int, ...] = (128, 128)
+
+    @nn.compact
+    def __call__(self, feats, training: bool = False):
+        from elasticdl_tpu.api import preprocessing as pp
+        from elasticdl_tpu.api.layers import Embedding
+
+        base = self.base
+        dense = pp.log_normalize(feats["dense"])
+        hashed = pp.hash_bucket(feats["cat"], base.field_vocab)
+        offsets = jnp.arange(NUM_CAT, dtype=jnp.int32) * base.field_vocab
+        ids = hashed + offsets[None, :]
+        vocab = NUM_CAT * base.field_vocab
+
+        emb = Embedding(
+            vocab, base.embedding_dim, mode=base.embedding_mode, name="embedding"
+        )(ids)
+        lin = Embedding(vocab, 1, mode=base.embedding_mode, name="linear")(ids)
+
+        first = jnp.sum(lin[..., 0], axis=1) + nn.Dense(
+            1, dtype=jnp.float32, name="dense_linear"
+        )(dense).reshape(-1)
+
+        cin_out = CIN(self.cin_sizes, base.compute_dtype)(emb)
+        cin_logit = nn.Dense(1, dtype=jnp.float32, name="cin_out")(
+            cin_out.astype(jnp.float32)
+        ).reshape(-1)
+
+        x = jnp.concatenate([emb.reshape(emb.shape[0], -1), dense], axis=-1).astype(
+            base.compute_dtype
+        )
+        for i, h in enumerate(base.hidden):
+            x = nn.Dense(h, dtype=base.compute_dtype, name=f"dnn_{i}")(x)
+            x = nn.relu(x)
+        dnn_logit = nn.Dense(1, dtype=jnp.float32, name="dnn_out")(x).reshape(-1)
+
+        bias = self.param("bias", nn.initializers.zeros, (1,), jnp.float32)
+        return first + cin_logit + dnn_logit + bias[0]
+
+
+def custom_model(**kwargs):
+    base = DeepFM(
+        field_vocab=int(kwargs.get("field_vocab", 100_000)),
+        embedding_dim=int(kwargs.get("embedding_dim", 16)),
+        hidden=tuple(int(h) for h in str(kwargs.get("hidden", "400,400")).split(",")),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+        embedding_mode=str(kwargs.get("embedding_mode", "manual")),
+    )
+    cin = tuple(int(h) for h in str(kwargs.get("cin_sizes", "128,128")).split(","))
+    return XDeepFM(base=base, cin_sizes=cin)
+
+
+def optimizer(**kwargs):
+    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))

@@ -1,0 +1,140 @@
+"""Sharded embedding engine: manual shard_map path vs auto (GSPMD) path vs a
+dense reference, forward and backward, on 1-D and 2-D meshes.
+
+Mirrors the reference's embedding tests (reference:
+elasticdl/python/tests/embedding_table_test.py, embedding_layer_test.py) —
+row lookup, padding ids, combiners, sparse-gradient correctness — but the
+"PS shard" here is a mesh row-shard.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from elasticdl_tpu.ops import embedding as emb_ops
+from elasticdl_tpu.api.layers import Embedding
+
+
+def make_table(mesh, V=512, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    table = rng.randn(V, D).astype(np.float32)
+    sharded = jax.device_put(
+        table, NamedSharding(mesh, P(tuple(mesh.axis_names), None))
+    )
+    return table, sharded
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh8", "mesh_4x2"])
+@pytest.mark.parametrize("mode", ["manual", "auto"])
+def test_lookup_matches_dense(mesh_name, mode, request):
+    mesh = request.getfixturevalue(mesh_name)
+    table_np, table = make_table(mesh)
+    ids_np = np.random.RandomState(1).randint(0, 512, (16, 5)).astype(np.int32)
+    ids = jax.device_put(ids_np, NamedSharding(mesh, P("data", None)))
+
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda t, i: emb_ops.embedding_lookup(t, i, mode=mode))(table, ids)
+    np.testing.assert_allclose(np.asarray(out), table_np[ids_np], rtol=1e-6)
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh8", "mesh_4x2"])
+def test_gradients_match_dense(mesh_name, request):
+    mesh = request.getfixturevalue(mesh_name)
+    table_np, table = make_table(mesh, V=256, D=8)
+    ids_np = np.random.RandomState(2).randint(0, 256, (16, 3)).astype(np.int32)
+    ids = jax.device_put(ids_np, NamedSharding(mesh, P("data", None)))
+    w_np = np.random.RandomState(3).randn(16, 3, 8).astype(np.float32)
+
+    def loss(t, mode):
+        return jnp.sum(emb_ops.embedding_lookup(t, ids, mode=mode) * w_np)
+
+    with jax.set_mesh(mesh):
+        g_manual = jax.jit(jax.grad(lambda t: loss(t, "manual")))(table)
+        g_auto = jax.jit(jax.grad(lambda t: loss(t, "auto")))(table)
+
+    expected = np.zeros_like(table_np)
+    for b in range(16):
+        for l in range(3):
+            expected[ids_np[b, l]] += w_np[b, l]
+    np.testing.assert_allclose(np.asarray(g_manual), expected, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(g_auto), expected, rtol=1e-5)
+    # gradient keeps the table's row sharding (no host round-trip)
+    assert g_manual.sharding.spec[0] == tuple(mesh.axis_names) or len(mesh.axis_names) == 1
+
+
+def test_padding_ids_give_zero(mesh8):
+    _, table = make_table(mesh8, V=256, D=8)
+    ids_np = np.full((8, 4), -1, np.int32)
+    ids_np[:, 0] = 3
+    with jax.set_mesh(mesh8):
+        out = jax.jit(lambda t, i: emb_ops.embedding_lookup(t, i))(table, jnp.asarray(ids_np))
+    out = np.asarray(out)
+    assert np.all(out[:, 1:] == 0)
+    assert np.any(out[:, 0] != 0)
+
+
+def test_combiners():
+    vecs = jnp.asarray(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    ids = jnp.asarray([[1, 2, -1], [5, -1, -1]], jnp.int32)
+    s = emb_ops.combine(vecs, "sum", ids)
+    m = emb_ops.combine(vecs, "mean", ids)
+    expected_sum0 = np.asarray(vecs)[0, 0] + np.asarray(vecs)[0, 1]
+    np.testing.assert_allclose(np.asarray(s)[0], expected_sum0)
+    np.testing.assert_allclose(np.asarray(m)[0], expected_sum0 / 2)
+    np.testing.assert_allclose(np.asarray(m)[1], np.asarray(vecs)[1, 0])
+
+
+@pytest.mark.parametrize("mesh_name", ["mesh8", "mesh_4x2"])
+def test_embedding_layer_in_model(mesh_name, request):
+    """End-to-end: flax model with a sharded Embedding trains one step."""
+    import flax.linen as nn
+    import optax
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    mesh = request.getfixturevalue(mesh_name)
+
+    class TinyRec(nn.Module):
+        @nn.compact
+        def __call__(self, feats, training=False):
+            emb = Embedding(input_dim=1000, output_dim=8, combiner="sum")(feats["cat"])
+            x = jnp.concatenate([emb, feats["dense"]], axis=-1)
+            return nn.Dense(1)(x).reshape(-1)
+
+    spec = ModelSpec(
+        model=TinyRec(),
+        loss=lambda labels, out: optax.sigmoid_binary_cross_entropy(
+            out, jnp.asarray(labels, jnp.float32).reshape(-1)
+        ),
+        optimizer=optax.adam(1e-2),
+        dataset_fn=None,
+        eval_metrics_fn=None,
+    )
+    trainer = Trainer(spec, mesh)
+
+    def batch(seed):
+        rng = np.random.RandomState(seed)
+        return {
+            "features": {
+                "cat": rng.randint(0, 1000, (16, 4)).astype(np.int32),
+                "dense": rng.randn(16, 3).astype(np.float32),
+            },
+            "labels": rng.randint(0, 2, (16,)).astype(np.float32),
+            "mask": np.ones((16,), np.float32),
+        }
+
+    state = trainer.init_state(batch(0))
+    # table is sharded over every mesh axis
+    table = state.params["Embedding_0"]["table"]
+    assert table.shape == (emb_ops.padded_vocab(1000), 8)
+    spec0 = table.sharding.spec[0]
+    flat = spec0 if isinstance(spec0, tuple) else (spec0,)
+    assert set(flat) == set(mesh.axis_names)
+
+    losses = []
+    for i in range(15):
+        state, logs = trainer.train_step(state, batch(i % 3))
+        losses.append(float(logs["loss"]))
+    assert np.mean(losses[-3:]) < np.mean(losses[:3])

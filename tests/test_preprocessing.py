@@ -1,0 +1,59 @@
+"""Preprocessing parity tests (reference: elasticdl_preprocessing tests)."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from elasticdl_tpu.api import preprocessing as pp
+
+
+def test_hash_bucket_deterministic_and_in_range():
+    x = np.arange(1000, dtype=np.int32)
+    a = np.asarray(pp.hash_bucket(x, 37))
+    b = np.asarray(pp.hash_bucket(x, 37))
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 37
+    # spreads: no bucket hogs the distribution
+    counts = np.bincount(a, minlength=37)
+    assert counts.max() < 5 * counts.mean()
+
+
+def test_bucketize():
+    out = np.asarray(pp.bucketize([1.0, 5.0, 10.0, 100.0], [2.0, 10.0]))
+    np.testing.assert_array_equal(out, [0, 1, 2, 2])
+
+
+def test_normalize_and_log():
+    out = np.asarray(pp.normalize([10.0], 5.0, 2.5))
+    np.testing.assert_allclose(out, [2.0])
+    np.testing.assert_allclose(np.asarray(pp.log_normalize([-3.0, 0.0])), [0.0, 0.0])
+
+
+def test_concat_with_offset():
+    a = jnp.asarray([[1], [2]], jnp.int32)
+    b = jnp.asarray([[0, -1], [3, 1]], jnp.int32)
+    out = np.asarray(pp.concat_with_offset([a, b], [10, 5]))
+    np.testing.assert_array_equal(out, [[1, 10, -1], [2, 13, 11]])
+
+
+def test_int_lookup():
+    out = np.asarray(pp.int_lookup([5, 7, 999], vocab=[5, 7, 11], num_oov=1))
+    assert out[0] == 1 and out[1] == 2   # vocab hits shift by num_oov
+    assert out[2] == 0                    # OOV lands in [0, num_oov)
+
+
+def test_hash_strings_stable():
+    a = pp.hash_strings(["foo", "bar", b"foo"], 100)
+    assert a[0] == a[2]
+    assert 0 <= a.min() and a.max() < 100
+
+
+def test_string_lookup():
+    lookup = pp.StringLookup(["a", "b"], num_oov=2)
+    out = lookup(["a", "b", "zzz"])
+    assert out[0] == 2 and out[1] == 3 and 0 <= out[2] < 2
+    assert lookup.vocab_size == 4
+
+
+def test_pad_to_dense():
+    out = pp.pad_to_dense([[1, 2, 3], [7]], max_len=2)
+    np.testing.assert_array_equal(out, [[1, 2], [7, -1]])

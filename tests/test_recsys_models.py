@@ -1,0 +1,130 @@
+"""The recsys model-zoo configs (Wide&Deep, DeepFM, xDeepFM) train end-to-end
+on the 8-device mesh with sharded embedding tables, and their dataset_fn
+parsers handle real record formats."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.training.model_spec import ModelSpec
+from elasticdl_tpu.training.trainer import Trainer
+
+
+def criteo_batch(n=32, seed=0):
+    rng = np.random.RandomState(seed)
+    # clicks correlate with dense[0] so the model has signal to learn
+    label = rng.randint(0, 2, (n,)).astype(np.float32)
+    dense = rng.rand(n, 13).astype(np.float32) * 10
+    dense[:, 0] += label * 50
+    cat = rng.randint(0, 1 << 30, (n, 26)).astype(np.int32)
+    return {
+        "features": {"dense": dense, "cat": cat},
+        "labels": label,
+        "mask": np.ones((n,), np.float32),
+    }
+
+
+def census_batch(n=32, seed=0):
+    from model_zoo.census.wide_deep import TOTAL_VOCAB
+
+    rng = np.random.RandomState(seed)
+    label = rng.randint(0, 2, (n,)).astype(np.float32)
+    dense = rng.randn(n, 5).astype(np.float32)
+    dense[:, 0] += label * 2
+    cat = rng.randint(0, TOTAL_VOCAB, (n, 9)).astype(np.int32)
+    return {
+        "features": {"dense": dense, "cat": cat},
+        "labels": label,
+        "mask": np.ones((n,), np.float32),
+    }
+
+
+CONFIGS = [
+    ("deepfm.deepfm.custom_model", criteo_batch, "field_vocab=1000;hidden=32,32"),
+    ("deepfm.xdeepfm.custom_model", criteo_batch, "field_vocab=1000;hidden=32,32;cin_sizes=16,16"),
+    ("census.wide_deep.custom_model", census_batch, "hidden=32,16"),
+]
+
+
+@pytest.mark.parametrize("model_def,batch_fn,params", CONFIGS)
+def test_model_trains(model_def, batch_fn, params, mesh8):
+    from elasticdl_tpu.common.config import parse_kv_params
+
+    cfg = JobConfig(
+        model_zoo="model_zoo",
+        model_def=model_def,
+        model_params=parse_kv_params(params),
+    )
+    spec = ModelSpec.from_config(cfg)
+    trainer = Trainer(spec, mesh8)
+    state = trainer.init_state(batch_fn())
+    losses = []
+    for i in range(20):
+        state, logs = trainer.train_step(state, batch_fn(seed=i % 5))
+        losses.append(float(logs["loss"]))
+    assert np.isfinite(losses).all()
+    assert np.mean(losses[-3:]) < np.mean(losses[:3]), losses
+
+    ms = trainer.new_metric_states()
+    ms = trainer.eval_step(state, batch_fn(seed=99), ms)
+    res = trainer.metric_results(ms)
+    assert "auc" in res and 0.0 <= res["auc"] <= 1.0
+
+
+def test_deepfm_table_is_sharded(mesh8):
+    cfg = JobConfig(
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm.custom_model",
+        model_params={"field_vocab": 1000, "hidden": "16"},
+    )
+    trainer = Trainer(ModelSpec.from_config(cfg), mesh8)
+    state = trainer.init_state(criteo_batch(8))
+    table = state.params["fm_embedding"]["table"]
+    spec0 = table.sharding.spec[0]
+    flat = spec0 if isinstance(spec0, tuple) else (spec0,)
+    assert "data" in flat
+    # optimizer state (adam mu/nu) follows the table's sharding — the
+    # PS-tier slot-table equivalent stays sharded in HBM too
+    import jax
+
+    def find_table_like(tree):
+        return [
+            x
+            for x in jax.tree_util.tree_leaves(tree)
+            if getattr(x, "shape", None) == table.shape
+        ]
+
+    slots = find_table_like(state.opt_state)
+    assert slots, "adam slots for the table not found"
+    for s in slots:
+        assert s.sharding.spec == table.sharding.spec
+
+
+def test_criteo_dataset_fn_parses():
+    from model_zoo.deepfm.deepfm import dataset_fn
+
+    parse = dataset_fn("training", None)
+    line = ("1\t" + "\t".join(str(i) for i in range(13)) + "\t"
+            + "\t".join(format(i * 7, "x") for i in range(26))).encode()
+    feats, label = parse(line)
+    assert label == 1
+    assert feats["dense"].shape == (13,) and feats["cat"].shape == (26,)
+    # missing fields tolerated
+    feats2, label2 = parse(b"0\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t")
+    assert label2 == 0 and feats2["cat"].shape == (26,)
+
+
+def test_census_dataset_fn_parses():
+    from model_zoo.census.wide_deep import dataset_fn, TOTAL_VOCAB
+
+    parse = dataset_fn("training", None)
+    line = (b"39, State-gov, 77516, Bachelors, 13, Never-married, "
+            b"Adm-clerical, Not-in-family, White, Male, 2174, 0, 40, "
+            b"United-States, <=50K")
+    feats, label = parse(line)
+    assert label == 0
+    assert feats["dense"].shape == (5,)
+    assert feats["cat"].shape == (9,)
+    assert feats["cat"].min() >= 0 and feats["cat"].max() < TOTAL_VOCAB
+    line2 = line.replace(b"<=50K", b">50K")
+    assert parse(line2)[1] == 1
