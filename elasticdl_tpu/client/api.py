@@ -1,44 +1,49 @@
 """Client verb implementations.
 
-Reference parity: elasticdl_client/api.py (train/evaluate/predict submit a
-master pod; zoo manages the model-zoo image). Local mode runs master+workers
-as processes on this host; k8s mode renders manifests for a TPU slice.
+Reference parity: elasticdl_client/api.py — train/evaluate/predict submit a
+job; zoo manages the model-zoo artifact. Two launch targets:
+- local (default when no --image_name): master in-process + subprocess
+  workers on this host;
+- k8s: render a master pod manifest for a TPU slice (client/k8s.py) and
+  submit it with kubectl.
 """
 
 from __future__ import annotations
 
-import sys
 from typing import List
 
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import JobType
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
 
 
-def _not_ready(what: str) -> int:
-    print(
-        f"{what}: the master/worker runtime is not wired into the CLI yet "
-        "(see elasticdl_tpu/master, elasticdl_tpu/worker).",
-        file=sys.stderr,
-    )
-    return 3
+def _launch(cfg: JobConfig) -> int:
+    cfg.validate()
+    if cfg.image_name:
+        from elasticdl_tpu.client import k8s
+
+        return k8s.submit(cfg)
+    from elasticdl_tpu.client.local import run_local
+
+    return run_local(cfg)
 
 
 def train(cfg: JobConfig) -> int:
-    cfg.validate()
-    return _not_ready("train")
+    return _launch(cfg)
 
 
 def evaluate(cfg: JobConfig) -> int:
-    cfg = cfg.replace(job_type=JobType.EVALUATION_ONLY)
-    cfg.validate()
-    return _not_ready("evaluate")
+    return _launch(cfg.replace(job_type=JobType.EVALUATION_ONLY))
 
 
 def predict(cfg: JobConfig) -> int:
-    cfg = cfg.replace(job_type=JobType.PREDICTION_ONLY)
-    cfg.validate()
-    return _not_ready("predict")
+    return _launch(cfg.replace(job_type=JobType.PREDICTION_ONLY))
 
 
 def zoo(argv: List[str]) -> int:
-    return _not_ready("zoo")
+    """zoo init/build/push — model-zoo image management."""
+    from elasticdl_tpu.client import zoo as zoo_mod
+
+    return zoo_mod.main(argv)

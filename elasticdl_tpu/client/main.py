@@ -33,6 +33,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # Deferred import: the launcher pulls in jax; keep `--help` cheap.
     from elasticdl_tpu.client import api
 
+    if verb == "zoo":
+        return api.zoo(rest)
     cfg = JobConfig.from_argv(rest)
     if verb == "train":
         return api.train(cfg)
@@ -40,8 +42,6 @@ def main(argv: Optional[List[str]] = None) -> int:
         return api.evaluate(cfg)
     if verb == "predict":
         return api.predict(cfg)
-    if verb == "zoo":
-        return api.zoo(rest)
     return 2
 
 

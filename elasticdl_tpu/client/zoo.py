@@ -1,0 +1,123 @@
+"""`zoo` verbs: scaffold, build and push the model-zoo image.
+
+Reference parity: elasticdl_client `zoo init/build/push` — the model zoo is a
+directory of model modules baked into a Docker image the job pods run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+from typing import List
+
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+_DOCKERFILE = """\
+FROM {base_image}
+COPY . /model_zoo
+ENV PYTHONPATH=/model_zoo:$PYTHONPATH
+"""
+
+_TEMPLATE_MODEL = '''\
+"""Model-zoo template. Contract: custom_model/loss/optimizer/dataset_fn/
+eval_metrics_fn module-level functions (see model_zoo/mnist/mnist_cnn.py
+for a complete example)."""
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from elasticdl_tpu.training import metrics as metrics_lib
+
+
+class MyModel(nn.Module):
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        return nn.Dense(2)(x)
+
+
+def custom_model(**kwargs):
+    return MyModel()
+
+
+def loss(labels, outputs):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, jnp.asarray(labels, jnp.int32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))
+
+
+def dataset_fn(mode, metadata):
+    raise NotImplementedError
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics_lib.Accuracy()}
+'''
+
+
+def init(model_zoo_dir: str) -> int:
+    os.makedirs(model_zoo_dir, exist_ok=True)
+    template = os.path.join(model_zoo_dir, "my_model.py")
+    if not os.path.exists(template):
+        with open(template, "w") as f:
+            f.write(_TEMPLATE_MODEL)
+    docker = os.path.join(model_zoo_dir, "Dockerfile")
+    if not os.path.exists(docker):
+        with open(docker, "w") as f:
+            f.write(_DOCKERFILE.format(base_image="python:3.12-slim"))
+    logger.info("initialized model zoo at %s", model_zoo_dir)
+    return 0
+
+
+def build(model_zoo_dir: str, image: str, base_image: str) -> int:
+    docker = shutil.which("docker")
+    dockerfile = os.path.join(model_zoo_dir, "Dockerfile")
+    if not os.path.exists(dockerfile):
+        with open(dockerfile, "w") as f:
+            f.write(_DOCKERFILE.format(base_image=base_image))
+    if docker is None:
+        logger.error("docker not found; wrote %s — build it where docker runs", dockerfile)
+        return 1
+    return subprocess.call([docker, "build", "-t", image, model_zoo_dir])
+
+
+def push(image: str) -> int:
+    docker = shutil.which("docker")
+    if docker is None:
+        logger.error("docker not found")
+        return 1
+    return subprocess.call([docker, "push", image])
+
+
+def main(argv: List[str]) -> int:
+    parser = argparse.ArgumentParser("elasticdl-tpu zoo")
+    sub = parser.add_subparsers(dest="verb", required=True)
+    p_init = sub.add_parser("init")
+    p_init.add_argument("--model_zoo", default="model_zoo")
+    p_build = sub.add_parser("build")
+    p_build.add_argument("--model_zoo", default="model_zoo")
+    p_build.add_argument("--image", required=True)
+    p_build.add_argument("--base_image", default="python:3.12-slim")
+    p_push = sub.add_parser("push")
+    p_push.add_argument("--image", required=True)
+    ns = parser.parse_args(argv)
+    if ns.verb == "init":
+        return init(ns.model_zoo)
+    if ns.verb == "build":
+        return build(ns.model_zoo, ns.image, ns.base_image)
+    if ns.verb == "push":
+        return push(ns.image)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

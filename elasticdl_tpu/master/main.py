@@ -1,0 +1,152 @@
+"""Master entrypoint: task service + membership + evaluation over gRPC.
+
+Reference parity: elasticdl/python/master/main.py — parse args, create data
+shards and the task dispatcher, start the gRPC servicer and services, manage
+worker instances, run to job end. The instance manager half (spawning and
+relaunching workers) lives in process_manager.py / k8s.py; this module wires
+the control plane and blocks until the job finishes.
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import List, Optional
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import JobType
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto.service import add_master_servicer, make_server
+
+logger = default_logger(__name__)
+
+
+class Master:
+    def __init__(self, cfg: JobConfig):
+        cfg.validate()
+        self.cfg = cfg
+
+        def shards_for(path: str):
+            if not path:
+                return []
+            reader = create_data_reader(
+                path, cfg.data_reader, **cfg.data_reader_params
+            )
+            return reader.create_shards()
+
+        train_shards = (
+            shards_for(cfg.training_data)
+            if cfg.job_type
+            in (JobType.TRAINING_ONLY, JobType.TRAINING_WITH_EVALUATION)
+            else []
+        )
+        eval_shards = shards_for(cfg.validation_data)
+        predict_shards = (
+            shards_for(cfg.prediction_data)
+            if cfg.job_type == JobType.PREDICTION_ONLY
+            else []
+        )
+
+        self.dispatcher = TaskDispatcher(
+            training_shards=train_shards,
+            evaluation_shards=eval_shards,
+            prediction_shards=predict_shards,
+            records_per_task=cfg.records_per_task,
+            num_epochs=cfg.num_epochs,
+            max_task_retries=cfg.max_task_retries,
+            shuffle=cfg.shuffle,
+            shuffle_seed=cfg.shuffle_seed,
+            task_timeout_s=cfg.task_timeout_s,
+        )
+        self.membership = Membership(heartbeat_timeout_s=3 * cfg.worker_heartbeat_s)
+        self.membership.add_death_callback(self.dispatcher.recover_tasks)
+
+        metrics = None
+        if eval_shards:
+            # the master loads the model module too — it owns metric
+            # finalization (reference: the master's evaluation service)
+            from elasticdl_tpu.common.model_utils import get_module_attr, load_module
+
+            module, _ = load_module(cfg.model_zoo, cfg.model_def)
+            metrics_fn = get_module_attr(
+                module, "eval_metrics_fn", cfg.eval_metrics_fn, required=False
+            )
+            metrics = dict(metrics_fn()) if metrics_fn else {}
+        self.evaluation: Optional[EvaluationService] = (
+            EvaluationService(
+                self.dispatcher,
+                metrics,
+                evaluation_steps=cfg.evaluation_steps,
+                start_delay_steps=cfg.evaluation_start_delay_steps,
+            )
+            if eval_shards
+            else None
+        )
+        self.servicer = MasterServicer(
+            self.dispatcher, self.membership, self.evaluation
+        )
+        self.server = make_server()
+        add_master_servicer(self.server, self.servicer)
+        port = int(cfg.master_addr.rsplit(":", 1)[1])
+        bound = self.server.add_insecure_port(f"[::]:{port}")
+        if bound == 0:
+            raise RuntimeError(f"could not bind master port {port}")
+
+    def start(self) -> None:
+        self.server.start()
+        logger.info("master serving on %s", self.cfg.master_addr)
+        if self.evaluation is not None and self.cfg.job_type == JobType.EVALUATION_ONLY:
+            self.evaluation.trigger(0)
+
+    def wait(
+        self,
+        poll_s: float = 1.0,
+        timeout_s: Optional[float] = None,
+        abort_fn=None,
+    ) -> bool:
+        """Block until all tasks are done. Returns True on completion.
+        `abort_fn() -> bool` aborts the wait (e.g. every worker failed
+        permanently — without it a dead job would block forever)."""
+        deadline = time.time() + timeout_s if timeout_s else None
+        while not self.dispatcher.finished():
+            self.membership.reap()
+            if deadline and time.time() > deadline:
+                return False
+            if abort_fn is not None and abort_fn():
+                logger.error("job aborted: no workers left to make progress")
+                return False
+            time.sleep(poll_s)
+        return True
+
+    def shutdown(self, grace_s: float = 5.0) -> None:
+        self.servicer.request_shutdown()
+        counts = self.dispatcher.counts()
+        mean_loss = self.servicer.mean_training_loss()
+        results = self.evaluation.latest_results() if self.evaluation else {}
+        logger.info(
+            "job finished: %s mean_loss=%s eval=%s",
+            counts, f"{mean_loss:.4f}" if mean_loss is not None else "n/a", results,
+        )
+        # give workers a heartbeat cycle to see the shutdown flag
+        time.sleep(min(grace_s, self.cfg.worker_heartbeat_s))
+        self.server.stop(grace_s)
+
+    def run(self) -> int:
+        self.start()
+        ok = self.wait()
+        self.shutdown()
+        return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    cfg = JobConfig.from_argv(sys.argv[1:] if argv is None else argv)
+    return Master(cfg).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,119 @@
+"""Worker membership registry — the failure detector + rendezvous version.
+
+Reference parity: two components merged. The reference's instance manager
+watches k8s pod events to detect worker death
+(elasticdl/python/master/k8s_instance_manager.py), and its rendezvous server
+bumps a world version so Horovod re-forms
+(elasticdl/python/master/rendezvous_server.py). Here both jobs are served by
+one registry: liveness from heartbeats (works with or without k8s; the pod
+watcher feeds in too), and a monotonically increasing `membership_version`
+workers watch to know when to re-form the `jax.distributed` mesh.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: int
+    name: str
+    last_heartbeat: float
+    model_version: int = 0
+    alive: bool = True
+
+
+class Membership:
+    def __init__(self, heartbeat_timeout_s: float = 30.0):
+        self._lock = threading.Lock()
+        self._workers: Dict[int, WorkerInfo] = {}
+        self._next_id = 0
+        self._version = 0
+        self._timeout = heartbeat_timeout_s
+        self._death_callbacks: List[Callable[[int], None]] = []
+
+    def add_death_callback(self, cb: Callable[[int], None]) -> None:
+        """cb(worker_id) fires when a worker is declared dead — wire this to
+        TaskDispatcher.recover_tasks."""
+        self._death_callbacks.append(cb)
+
+    def register(self, name: str, preferred_id: int = -1) -> WorkerInfo:
+        with self._lock:
+            wid = None
+            if preferred_id >= 0:
+                existing = self._workers.get(preferred_id)
+                if existing is None or not existing.alive:
+                    wid = preferred_id
+            if wid is None:
+                wid = self._next_id
+            self._next_id = max(self._next_id, wid + 1)
+            info = WorkerInfo(worker_id=wid, name=name, last_heartbeat=time.time())
+            self._workers[wid] = info
+            self._version += 1
+            logger.info(
+                "worker %d (%s) joined; membership v%d, %d alive",
+                wid, name, self._version, self._alive_count_locked(),
+            )
+            return info
+
+    def heartbeat(self, worker_id: int, model_version: int = 0) -> bool:
+        with self._lock:
+            info = self._workers.get(worker_id)
+            if info is None or not info.alive:
+                return False
+            info.last_heartbeat = time.time()
+            info.model_version = max(info.model_version, model_version)
+            return True
+
+    def mark_dead(self, worker_id: int, reason: str = "") -> bool:
+        with self._lock:
+            info = self._workers.get(worker_id)
+            if info is None or not info.alive:
+                return False
+            info.alive = False
+            self._version += 1
+            logger.warning(
+                "worker %d declared dead (%s); membership v%d, %d alive",
+                worker_id, reason or "unknown", self._version,
+                self._alive_count_locked(),
+            )
+        for cb in self._death_callbacks:
+            cb(worker_id)
+        return True
+
+    def reap(self) -> List[int]:
+        """Declare workers dead whose heartbeats lapsed. Returns their ids."""
+        now = time.time()
+        with self._lock:
+            lapsed = [
+                wid
+                for wid, info in self._workers.items()
+                if info.alive and now - info.last_heartbeat > self._timeout
+            ]
+        for wid in lapsed:
+            self.mark_dead(wid, reason="heartbeat timeout")
+        return lapsed
+
+    def _alive_count_locked(self) -> int:
+        return sum(1 for w in self._workers.values() if w.alive)
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def alive_count(self) -> int:
+        with self._lock:
+            return self._alive_count_locked()
+
+    def alive_workers(self) -> List[WorkerInfo]:
+        with self._lock:
+            return [w for w in self._workers.values() if w.alive]

@@ -1,0 +1,202 @@
+"""Elastic worker process manager (local-process instance manager).
+
+Reference parity: elasticdl/python/master/k8s_instance_manager.py — create
+worker instances, watch their lifecycle, relaunch failures up to
+`relaunch_max`, and tell the membership/dispatcher when one dies. This is the
+same state machine with subprocesses instead of pods (the k8s flavor renders
+pod specs through client/k8s.py); the master's control plane is identical in
+both, which is what makes the fault-injection tests honest — they kill real
+worker processes, as the reference's integration tests killed real pods.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import PodStatus, WorkerEnv
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.master.membership import Membership
+
+logger = default_logger(__name__)
+
+
+@dataclass
+class _WorkerProc:
+    worker_id: int
+    proc: subprocess.Popen
+    relaunches: int = 0
+    status: str = PodStatus.RUNNING
+
+
+class ProcessManager:
+    """Spawns and babysits worker subprocesses."""
+
+    def __init__(
+        self,
+        cfg: JobConfig,
+        membership: Optional[Membership] = None,
+        extra_env: Optional[Dict[str, str]] = None,
+        log_dir: Optional[str] = None,
+        job_finished_fn=None,
+    ):
+        self.cfg = cfg
+        self._membership = membership
+        self._extra_env = dict(extra_env or {})
+        self._log_dir = log_dir
+        # when this returns True, worker exits are final — no relaunches
+        self._job_finished_fn = job_finished_fn or (lambda: False)
+        self._procs: Dict[int, _WorkerProc] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        self._next_worker_id = 0
+
+    # ------------------------------------------------------------------ #
+
+    def _spawn(self, worker_id: int, relaunches: int = 0) -> _WorkerProc:
+        env = dict(os.environ)
+        env.update({str(k): str(v) for k, v in self.cfg.envs.items()})
+        env.update(self._extra_env)
+        env[WorkerEnv.WORKER_ID] = str(worker_id)
+        env[WorkerEnv.MASTER_ADDR] = self.cfg.master_addr
+        env[WorkerEnv.NUM_WORKERS] = str(self.cfg.num_workers)
+        argv = self.cfg.to_argv()
+        stdout = stderr = None
+        if self._log_dir:
+            os.makedirs(self._log_dir, exist_ok=True)
+            log = open(
+                os.path.join(self._log_dir, f"worker-{worker_id}.log"), "ab"
+            )
+            stdout = stderr = log
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "elasticdl_tpu.worker.main", *argv],
+            env=env,
+            stdout=stdout,
+            stderr=stderr,
+        )
+        wp = _WorkerProc(worker_id=worker_id, proc=proc, relaunches=relaunches)
+        logger.info("spawned worker %d (pid %d)", worker_id, proc.pid)
+        return wp
+
+    def start_workers(self) -> None:
+        with self._lock:
+            for _ in range(self.cfg.num_workers):
+                wid = self._next_worker_id
+                self._next_worker_id += 1
+                self._procs[wid] = self._spawn(wid)
+        self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
+        self._watcher.start()
+
+    def add_worker(self) -> int:
+        """Scale up by one worker (elastic scale-out)."""
+        with self._lock:
+            wid = self._next_worker_id
+            self._next_worker_id += 1
+            self._procs[wid] = self._spawn(wid)
+            return wid
+
+    def kill_worker(self, worker_id: int, relaunch: bool = True) -> bool:
+        """Kill one worker process (also the fault-injection hook)."""
+        with self._lock:
+            wp = self._procs.get(worker_id)
+            if wp is None or wp.proc.poll() is not None:
+                return False
+            if not relaunch:
+                wp.relaunches = self.cfg.relaunch_max + 1
+            wp.proc.kill()
+        return True
+
+    # ------------------------------------------------------------------ #
+
+    def _watch_loop(self, poll_s: float = 0.5) -> None:
+        """The pod-event watch: detect exits, relaunch or retire."""
+        while not self._stop.is_set():
+            with self._lock:
+                items = list(self._procs.items())
+            for wid, wp in items:
+                code = wp.proc.poll()
+                if code is None or wp.status in (
+                    PodStatus.SUCCEEDED, PodStatus.FAILED, PodStatus.DELETED,
+                ):
+                    continue
+                if code == 0:
+                    wp.status = PodStatus.SUCCEEDED
+                    logger.info("worker %d exited cleanly", wid)
+                    continue
+                if self._job_finished_fn():
+                    # teardown-phase exits are not failures to recover from
+                    wp.status = PodStatus.SUCCEEDED
+                    logger.info("worker %d exited (code %s) after job end", wid, code)
+                    continue
+                # failure/preemption path
+                if self._membership is not None:
+                    self._membership.mark_dead(wid, reason=f"exit code {code}")
+                if wp.relaunches < self.cfg.relaunch_max:
+                    logger.warning(
+                        "worker %d died (code %s); relaunch %d/%d",
+                        wid, code, wp.relaunches + 1, self.cfg.relaunch_max,
+                    )
+                    with self._lock:
+                        self._procs[wid] = self._spawn(
+                            wid, relaunches=wp.relaunches + 1
+                        )
+                else:
+                    wp.status = PodStatus.FAILED
+                    logger.error(
+                        "worker %d died (code %s); relaunch budget exhausted",
+                        wid, code,
+                    )
+            self._stop.wait(poll_s)
+
+    # ------------------------------------------------------------------ #
+
+    def stop(self, grace_s: float = 10.0) -> None:
+        self._stop.set()
+        if self._watcher:
+            self._watcher.join(timeout=grace_s)
+        with self._lock:
+            procs = list(self._procs.values())
+        deadline = time.time() + grace_s
+        for wp in procs:
+            if wp.proc.poll() is None:
+                wp.proc.terminate()
+        for wp in procs:
+            timeout = max(0.1, deadline - time.time())
+            try:
+                wp.proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                wp.proc.kill()
+
+    def all_exited(self) -> bool:
+        with self._lock:
+            return all(wp.proc.poll() is not None for wp in self._procs.values())
+
+    def all_failed(self) -> bool:
+        """True when every worker is dead with its relaunch budget spent —
+        the job cannot make progress anymore."""
+        with self._lock:
+            if not self._procs:
+                return False
+            return all(
+                wp.status == PodStatus.FAILED and wp.proc.poll() is not None
+                for wp in self._procs.values()
+            )
+
+    def statuses(self) -> Dict[int, str]:
+        with self._lock:
+            out = {}
+            for wid, wp in self._procs.items():
+                code = wp.proc.poll()
+                out[wid] = (
+                    wp.status
+                    if code is None
+                    else (PodStatus.SUCCEEDED if code == 0 else wp.status)
+                )
+            return out

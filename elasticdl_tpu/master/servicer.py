@@ -1,0 +1,131 @@
+"""Master gRPC servicer: the single control-plane endpoint workers talk to.
+
+Reference parity: elasticdl/python/master/servicer.py (MasterServicer —
+get_task / report_task_result / report_evaluation_metrics / report_version).
+Membership RPCs replace what the reference delegated to k8s pod events plus
+the Horovod rendezvous: RegisterWorker + Heartbeat carry the
+membership_version that drives elastic mesh re-formation.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = default_logger(__name__)
+
+
+class MasterServicer:
+    def __init__(
+        self,
+        dispatcher: TaskDispatcher,
+        membership: Membership,
+        evaluation_service: Optional[EvaluationService] = None,
+        wait_backoff_s: float = 2.0,
+    ):
+        self._dispatcher = dispatcher
+        self._membership = membership
+        self._evaluation = evaluation_service
+        self._wait_backoff_s = wait_backoff_s
+        self._loss_lock = threading.Lock()
+        self._loss_sum = 0.0
+        self._loss_count = 0
+        self._checkpoint_requested = set()  # worker ids that should checkpoint
+        self._shutdown = False
+
+    # ------------------------------------------------------------------ #
+    # rpc handlers (name-matched by proto/service.py)
+
+    def RegisterWorker(self, request, context):
+        info = self._membership.register(
+            request.worker_name, request.preferred_id if request.preferred_id else -1
+        )
+        return pb.RegisterWorkerResponse(
+            worker_id=info.worker_id,
+            membership_version=self._membership.version,
+            num_workers=self._membership.alive_count(),
+        )
+
+    def GetTask(self, request, context):
+        if self._dispatcher.finished():
+            return pb.GetTaskResponse(job_done=True)
+        task = self._dispatcher.get(request.worker_id)
+        if task is None:
+            return pb.GetTaskResponse(
+                task=pb.Task(type=pb.WAIT),
+                backoff_seconds=self._wait_backoff_s,
+                job_done=self._dispatcher.finished(),
+            )
+        return pb.GetTaskResponse(task=task.to_proto())
+
+    def ReportTaskResult(self, request, context):
+        accepted = self._dispatcher.report(
+            request.task_id, request.worker_id, request.success, request.err_message
+        )
+        if accepted and request.loss_count:
+            # stale/duplicate reports must not skew the job's mean loss
+            with self._loss_lock:
+                self._loss_sum += request.loss_sum
+                self._loss_count += request.loss_count
+        if accepted and request.success and self._evaluation is not None:
+            self._evaluation.maybe_trigger()
+        return pb.Empty()
+
+    def ReportEvaluationMetrics(self, request, context):
+        if self._evaluation is not None:
+            states = {
+                s.name: np.frombuffer(s.data, np.float32) for s in request.states
+            }
+            self._evaluation.report_metrics(
+                request.eval_job_id, request.task_id, states
+            )
+        return pb.ReportEvaluationMetricsResponse()
+
+    def Heartbeat(self, request, context):
+        known = self._membership.heartbeat(request.worker_id, request.model_version)
+        should_ckpt = request.worker_id in self._checkpoint_requested
+        if should_ckpt:
+            self._checkpoint_requested.discard(request.worker_id)
+        return pb.HeartbeatResponse(
+            membership_version=self._membership.version,
+            num_workers=self._membership.alive_count(),
+            should_checkpoint=should_ckpt,
+            shutdown=self._shutdown or not known,
+        )
+
+    def GetJobStatus(self, request, context):
+        counts = self._dispatcher.counts()
+        resp = pb.JobStatusResponse(
+            job_done=self._dispatcher.finished(),
+            finished_training_tasks=counts["finished_training"],
+            pending_tasks=counts["todo"],
+            doing_tasks=counts["doing"],
+            epoch=counts["epoch"],
+            membership_version=self._membership.version,
+        )
+        if self._evaluation is not None:
+            for k, v in self._evaluation.latest_results().items():
+                resp.eval_metrics[k] = v
+        return resp
+
+    # ------------------------------------------------------------------ #
+
+    def request_checkpoint(self, worker_id: int) -> None:
+        self._checkpoint_requested.add(worker_id)
+
+    def request_shutdown(self) -> None:
+        self._shutdown = True
+
+    def mean_training_loss(self) -> Optional[float]:
+        with self._loss_lock:
+            if not self._loss_count:
+                return None
+            return self._loss_sum / self._loss_count

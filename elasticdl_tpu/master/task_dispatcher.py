@@ -1,0 +1,336 @@
+"""Dynamic data sharding: the task queue that makes training elastic.
+
+Reference parity: elasticdl/python/master/task_dispatcher.py — the master
+keeps a `todo` queue of data-span tasks and a `doing` map of leased tasks;
+workers lease tasks, report completion explicitly, and a task is only ever
+marked done on such a report. Worker death ⇒ its `doing` tasks go back to
+`todo`, so elasticity is data-loss-free by construction. This design is
+backend-agnostic and survives the TPU rebuild unchanged in spirit; it is
+re-implemented here (not translated) with lease timeouts added — the
+reference relied purely on pod-death events, which misses hung workers.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = default_logger(__name__)
+
+
+@dataclass
+class TaskSpec:
+    task_id: int
+    type: int                    # pb.TaskType value
+    shard_name: str
+    start: int
+    end: int
+    epoch: int = 0
+    eval_job_id: int = -1
+    retries: int = 0
+
+    def to_proto(self) -> pb.Task:
+        return pb.Task(
+            task_id=self.task_id,
+            type=self.type,
+            shard_name=self.shard_name,
+            start=self.start,
+            end=self.end,
+            epoch=self.epoch,
+            eval_job_id=max(self.eval_job_id, 0),
+        )
+
+    @property
+    def num_records(self) -> int:
+        return self.end - self.start
+
+
+@dataclass
+class _Lease:
+    worker_id: int
+    task: TaskSpec
+    lease_time: float
+
+
+Shard = Tuple[str, int, int]  # (shard_name, start, end)
+
+
+class TaskDispatcher:
+    """Thread-safe todo/doing task queue with epochs, retries and leases."""
+
+    def __init__(
+        self,
+        training_shards: List[Shard],
+        evaluation_shards: Optional[List[Shard]] = None,
+        prediction_shards: Optional[List[Shard]] = None,
+        records_per_task: int = 4096,
+        num_epochs: int = 1,
+        max_task_retries: int = 3,
+        shuffle: bool = True,
+        shuffle_seed: int = 0,
+        task_timeout_s: float = 600.0,
+    ):
+        self._lock = threading.Lock()
+        self._training_shards = list(training_shards)
+        self._evaluation_shards = list(evaluation_shards or [])
+        self._prediction_shards = list(prediction_shards or [])
+        self._records_per_task = max(1, records_per_task)
+        self._num_epochs = num_epochs
+        self._max_task_retries = max_task_retries
+        self._shuffle = shuffle
+        self._rng = random.Random(shuffle_seed)
+        self._task_timeout_s = task_timeout_s
+
+        self._todo: deque[TaskSpec] = deque()
+        self._doing: Dict[int, _Lease] = {}
+        self._next_task_id = 1
+        self._epoch = -1
+        self._finished_training = 0
+        self._failed_permanently = 0
+        self._training_done = False
+        self._epoch_end_fired = False
+        self._job_end_fired = False
+        self._epoch_end_callbacks: List[Callable[[int], None]] = []
+        self._job_end_callbacks: List[Callable[[], None]] = []
+        self._task_failed_callbacks: List[Callable[[TaskSpec], None]] = []
+        # permanently failed tasks whose callbacks haven't fired yet
+        # (collected under the lock, flushed outside it)
+        self._pending_failed: List[TaskSpec] = []
+        # training version counter: bumps on every finished training task
+        self._completed_versions = 0
+
+        if self._training_shards:
+            self._start_next_epoch()
+        else:
+            # evaluation-only / prediction-only jobs: no training epochs.
+            # Eval tasks are injected later by the EvaluationService trigger.
+            self._training_done = True
+            if self._prediction_shards:
+                self._create_tasks(self._prediction_shards, pb.PREDICTION)
+
+    # ------------------------------------------------------------------ #
+    # task creation
+
+    def _split(self, shards: List[Shard]) -> List[Tuple[str, int, int]]:
+        spans = []
+        for name, start, end in shards:
+            s = start
+            while s < end:
+                e = min(s + self._records_per_task, end)
+                spans.append((name, s, e))
+                s = e
+        return spans
+
+    def _create_tasks(
+        self, shards: List[Shard], task_type: int, eval_job_id: int = -1,
+        front: bool = False,
+    ) -> int:
+        spans = self._split(shards)
+        if self._shuffle and task_type == pb.TRAINING:
+            self._rng.shuffle(spans)
+        tasks = []
+        for name, s, e in spans:
+            tasks.append(
+                TaskSpec(
+                    task_id=self._next_task_id,
+                    type=task_type,
+                    shard_name=name,
+                    start=s,
+                    end=e,
+                    epoch=max(self._epoch, 0),
+                    eval_job_id=eval_job_id,
+                )
+            )
+            self._next_task_id += 1
+        if front:
+            self._todo.extendleft(reversed(tasks))
+        else:
+            self._todo.extend(tasks)
+        return len(tasks)
+
+    def _start_next_epoch(self) -> None:
+        self._epoch += 1
+        self._epoch_end_fired = False
+        n = self._create_tasks(self._training_shards, pb.TRAINING)
+        logger.info("epoch %d: created %d training tasks", self._epoch, n)
+
+    def num_evaluation_tasks(self) -> int:
+        """How many tasks one eval job creates (pure function of shards)."""
+        return len(self._split(self._evaluation_shards))
+
+    def create_evaluation_tasks(self, eval_job_id: int) -> int:
+        """Evaluation tasks jump the queue (reference behavior: eval tasks
+        are prioritized so metrics reflect the current model version)."""
+        with self._lock:
+            n = self._create_tasks(
+                self._evaluation_shards, pb.EVALUATION, eval_job_id, front=True
+            )
+        logger.info("eval job %d: created %d evaluation tasks", eval_job_id, n)
+        return n
+
+    # ------------------------------------------------------------------ #
+    # leasing / reporting
+
+    def get(self, worker_id: int) -> Optional[TaskSpec]:
+        callbacks: List[Callable] = []
+        with self._lock:
+            self._reap_expired_locked()
+            if not self._todo:
+                callbacks = self._maybe_advance_epoch_locked()
+        # callbacks (epoch-end eval triggers, …) may enqueue new tasks and
+        # must run outside the lock — they re-enter the dispatcher
+        self._flush_callbacks(callbacks)
+        with self._lock:
+            if not self._todo:
+                return None
+            task = self._todo.popleft()
+            self._doing[task.task_id] = _Lease(worker_id, task, time.time())
+            return task
+
+    def _flush_callbacks(self, callbacks: List[Callable]) -> None:
+        with self._lock:
+            failed, self._pending_failed = self._pending_failed, []
+        for task in failed:
+            for cb in self._task_failed_callbacks:
+                cb(task)
+        for cb in callbacks:
+            cb()
+
+    def report(
+        self, task_id: int, worker_id: int, success: bool, err: str = ""
+    ) -> bool:
+        """Returns False for an unknown/stale lease (e.g. the task was
+        already recovered from this worker and completed elsewhere)."""
+        callbacks: List[Callable] = []
+        with self._lock:
+            lease = self._doing.pop(task_id, None)
+            if lease is None:
+                logger.warning(
+                    "stale/unknown task report: task=%d worker=%d", task_id, worker_id
+                )
+                return False
+            task = lease.task
+            if success:
+                if task.type == pb.TRAINING:
+                    self._finished_training += 1
+                    self._completed_versions += 1
+            else:
+                task.retries += 1
+                if task.retries <= self._max_task_retries:
+                    logger.info(
+                        "task %d failed (%s); requeue retry %d",
+                        task_id, err, task.retries,
+                    )
+                    self._todo.appendleft(task)
+                else:
+                    self._fail_permanently_locked(task, err)
+            callbacks = self._maybe_advance_epoch_locked()
+        self._flush_callbacks(callbacks)
+        return True
+
+    def _fail_permanently_locked(self, task: TaskSpec, err: str) -> None:
+        self._failed_permanently += 1
+        self._pending_failed.append(task)
+        logger.error(
+            "task %d failed permanently after %d retries: %s",
+            task.task_id, task.retries, err,
+        )
+
+    def recover_tasks(self, worker_id: int) -> int:
+        """Requeue every task leased by a dead worker. THE elastic primitive
+        (reference: task recovery on pod FAILED/DELETED events)."""
+        with self._lock:
+            stale = [t for t, l in self._doing.items() if l.worker_id == worker_id]
+            for tid in stale:
+                task = self._doing.pop(tid).task
+                self._todo.appendleft(task)
+        if stale:
+            logger.info("recovered %d tasks from worker %d", len(stale), worker_id)
+        return len(stale)
+
+    def _reap_expired_locked(self) -> None:
+        now = time.time()
+        expired = [
+            tid
+            for tid, lease in self._doing.items()
+            if now - lease.lease_time > self._task_timeout_s
+        ]
+        for tid in expired:
+            lease = self._doing.pop(tid)
+            lease.task.retries += 1
+            if lease.task.retries <= self._max_task_retries:
+                logger.warning(
+                    "task %d lease expired (worker %d); requeued",
+                    tid, lease.worker_id,
+                )
+                self._todo.appendleft(lease.task)
+            else:
+                self._fail_permanently_locked(lease.task, "lease expired")
+
+    def _maybe_advance_epoch_locked(self) -> List[Callable]:
+        """If the current epoch's training drained, fire epoch-end exactly
+        once, then start the next epoch or finish training; fire job-end
+        exactly once when everything (incl. eval/predict tasks) drains."""
+        callbacks: List[Callable] = []
+        training_left = any(t.type == pb.TRAINING for t in self._todo) or any(
+            l.task.type == pb.TRAINING for l in self._doing.values()
+        )
+        if not training_left:
+            if self._epoch >= 0 and not self._epoch_end_fired:
+                self._epoch_end_fired = True
+                epoch = self._epoch
+                callbacks.extend(
+                    lambda cb=cb: cb(epoch) for cb in self._epoch_end_callbacks
+                )
+            if self._epoch + 1 < self._num_epochs:
+                self._start_next_epoch()
+            else:
+                self._training_done = True
+        if (
+            self._training_done
+            and not self._todo
+            and not self._doing
+            and not self._job_end_fired
+        ):
+            self._job_end_fired = True
+            callbacks.extend(self._job_end_callbacks)
+        return callbacks
+
+    # ------------------------------------------------------------------ #
+    # introspection / hooks
+
+    def add_epoch_end_callback(self, cb: Callable[[int], None]) -> None:
+        self._epoch_end_callbacks.append(cb)
+
+    def add_job_end_callback(self, cb: Callable[[], None]) -> None:
+        self._job_end_callbacks.append(cb)
+
+    def add_task_failed_callback(self, cb: Callable[[TaskSpec], None]) -> None:
+        """cb(task) fires when a task fails permanently (retries exhausted)."""
+        self._task_failed_callbacks.append(cb)
+
+    def finished(self) -> bool:
+        with self._lock:
+            return self._training_done and not self._todo and not self._doing
+
+    @property
+    def completed_versions(self) -> int:
+        with self._lock:
+            return self._completed_versions
+
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "todo": len(self._todo),
+                "doing": len(self._doing),
+                "finished_training": self._finished_training,
+                "failed_permanently": self._failed_permanently,
+                "epoch": self._epoch,
+            }

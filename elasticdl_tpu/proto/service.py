@@ -1,0 +1,78 @@
+"""gRPC service glue for the Master service, written against grpc's generic
+handler API (this image has protoc for messages but no grpcio-tools plugin,
+so the service bindings that `elasticdl_pb2_grpc.py` would contain in the
+reference are spelled out here by hand).
+
+Reference parity: the generated MasterServicer/MasterStub pair of
+elasticdl/proto/elasticdl.proto.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import grpc
+
+from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+SERVICE_NAME = "elasticdl_tpu.Master"
+
+# rpc name -> (request type, response type)
+_RPCS = {
+    "RegisterWorker": (pb.RegisterWorkerRequest, pb.RegisterWorkerResponse),
+    "GetTask": (pb.GetTaskRequest, pb.GetTaskResponse),
+    "ReportTaskResult": (pb.ReportTaskResultRequest, pb.Empty),
+    "ReportEvaluationMetrics": (
+        pb.ReportEvaluationMetricsRequest,
+        pb.ReportEvaluationMetricsResponse,
+    ),
+    "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatResponse),
+    "GetJobStatus": (pb.Empty, pb.JobStatusResponse),
+}
+
+
+def add_master_servicer(server: grpc.Server, servicer: Any) -> None:
+    """Register a servicer object exposing methods named after the rpcs."""
+    handlers = {}
+    for name, (req_t, _resp_t) in _RPCS.items():
+        method = getattr(servicer, name)
+        handlers[name] = grpc.unary_unary_rpc_method_handler(
+            method,
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE_NAME, handlers),)
+    )
+
+
+class MasterStub:
+    """Client stub for the Master service."""
+
+    def __init__(self, channel: grpc.Channel):
+        self._methods = {}
+        for name, (req_t, resp_t) in _RPCS.items():
+            self._methods[name] = channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=resp_t.FromString,
+            )
+
+    def __getattr__(self, name: str):
+        try:
+            return self._methods[name]
+        except KeyError as e:
+            raise AttributeError(name) from e
+
+
+def make_channel(addr: str) -> grpc.Channel:
+    return grpc.insecure_channel(addr, options=GRPC.OPTIONS)
+
+
+def make_server(max_workers: int = 32) -> grpc.Server:
+    from concurrent import futures
+
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers), options=GRPC.OPTIONS
+    )

@@ -1,0 +1,22 @@
+"""Worker entrypoint.
+
+Reference parity: elasticdl/python/worker/main.py — parse the re-serialized
+argv the master/launcher passed, build the Worker, run the task loop.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.worker.worker import Worker
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    cfg = JobConfig.from_argv(sys.argv[1:] if argv is None else argv)
+    return Worker(cfg).run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,80 @@
+"""Turn leased tasks into fixed-shape device batches.
+
+Reference parity: elasticdl/python/worker/task_data_service.py — converts the
+task stream into a continuous data pipeline and attributes records to tasks
+so completion is reported exactly when a task's records are consumed. Here a
+task is processed as a unit (batches of one task never mix with another's),
+which keeps exactly-once accounting trivial; the last partial batch is padded
+to static shape with mask=0 rows because XLA recompiles on shape changes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from elasticdl_tpu.data.reader import AbstractDataReader
+
+
+def _stack(values: List[Any]):
+    if isinstance(values[0], dict):
+        return {k: _stack([v[k] for v in values]) for k in values[0]}
+    return np.stack(values)
+
+
+def _pad_batch(feats, labels, count: int, batch_size: int):
+    """Pad a short batch to `batch_size` by repeating row 0, mask marks real
+    rows. Keeps every compiled step shape static."""
+
+    def pad(x):
+        if isinstance(x, dict):
+            return {k: pad(v) for k, v in x.items()}
+        reps = np.repeat(x[:1], batch_size - count, axis=0)
+        return np.concatenate([x, reps], axis=0)
+
+    mask = np.zeros((batch_size,), np.float32)
+    mask[:count] = 1.0
+    return pad(feats), pad(labels), mask
+
+
+class TaskDataService:
+    def __init__(
+        self,
+        reader: AbstractDataReader,
+        parse_fn: Callable[[bytes], Any],
+        batch_size: int,
+        batch_multiple: int = 1,
+    ):
+        self._reader = reader
+        self._parse = parse_fn
+        # batch must stay divisible by the mesh's data-axis size
+        self._batch_size = max(batch_size, batch_multiple)
+        if self._batch_size % batch_multiple:
+            self._batch_size += batch_multiple - self._batch_size % batch_multiple
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def batches(
+        self, shard_name: str, start: int, end: int
+    ) -> Iterator[Dict[str, Any]]:
+        feats_buf: List[Any] = []
+        labels_buf: List[Any] = []
+        for record in self._reader.read_records(shard_name, start, end):
+            f, l = self._parse(record)
+            feats_buf.append(f)
+            labels_buf.append(l)
+            if len(feats_buf) == self._batch_size:
+                yield {
+                    "features": _stack(feats_buf),
+                    "labels": _stack(labels_buf),
+                    "mask": np.ones((self._batch_size,), np.float32),
+                }
+                feats_buf, labels_buf = [], []
+        if feats_buf:
+            f, l, m = _pad_batch(
+                _stack(feats_buf), _stack(labels_buf), len(feats_buf), self._batch_size
+            )
+            yield {"features": f, "labels": l, "mask": m}

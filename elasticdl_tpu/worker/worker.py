@@ -1,0 +1,267 @@
+"""The worker: lease tasks from the master, run the jitted step, report back.
+
+Reference parity: elasticdl/python/worker/worker.py — `Worker.run()` loops
+`get_task` → build dataset → per-minibatch train step → `report_task_result`,
+plus evaluation and prediction task handling. The hot path differs exactly as
+SURVEY §3.3 prescribes: no per-step PS pulls/pushes — forward, backward, and
+optimizer update are one donated-state XLA program on the local mesh, and the
+only RPCs left are one lease + one report per task plus heartbeats.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import WorkerEnv
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.service import MasterStub, make_channel
+from elasticdl_tpu.training.model_spec import ModelSpec
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+logger = default_logger(__name__)
+
+
+class Worker:
+    def __init__(self, cfg: JobConfig, mesh=None):
+        self.cfg = cfg
+        self._mesh = mesh
+        self._trainer = None
+        self._state = None
+        self._spec: Optional[ModelSpec] = None
+        self._services: Dict[int, TaskDataService] = {}
+        self._stub: Optional[MasterStub] = None
+        self.worker_id = -1
+        self._membership_version = -1
+        self._shutdown = threading.Event()
+        self._heartbeat_thread: Optional[threading.Thread] = None
+        self._parse_fns: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------ #
+    # setup
+
+    def _connect(self) -> None:
+        addr = self.cfg.master_addr
+        self._channel = make_channel(addr)
+        self._stub = MasterStub(self._channel)
+        name = f"{socket.gethostname()}:{os.getpid()}"
+        preferred = int(os.environ.get(WorkerEnv.WORKER_ID, -1))
+        resp = self._stub.RegisterWorker(
+            pb.RegisterWorkerRequest(worker_name=name, preferred_id=max(preferred, 0)),
+            timeout=30,
+        )
+        self.worker_id = resp.worker_id
+        self._membership_version = resp.membership_version
+        logger.info(
+            "registered as worker %d (membership v%d, %d workers)",
+            self.worker_id, resp.membership_version, resp.num_workers,
+        )
+
+    def _build_trainer(self) -> None:
+        from elasticdl_tpu.parallel.mesh import build_mesh, data_axis
+        from elasticdl_tpu.training.trainer import Trainer
+        import jax
+
+        self._spec = ModelSpec.from_config(self.cfg)
+        if self._mesh is None:
+            self._mesh = build_mesh(
+                self.cfg.mesh_axes_sizes(len(jax.devices()))
+                if self.cfg.mesh_shape
+                else None
+            )
+        self._trainer = Trainer(
+            self._spec, self._mesh, remat=self.cfg.remat, seed=self.cfg.shuffle_seed
+        )
+
+    def _data_service(self, task_type: int) -> TaskDataService:
+        if task_type not in self._services:
+            paths = {
+                pb.TRAINING: self.cfg.training_data,
+                pb.EVALUATION: self.cfg.validation_data or self.cfg.training_data,
+                pb.PREDICTION: self.cfg.prediction_data,
+            }
+            reader = create_data_reader(
+                paths[task_type], self.cfg.data_reader, **self.cfg.data_reader_params
+            )
+            mode = {
+                pb.TRAINING: "training",
+                pb.EVALUATION: "evaluation",
+                pb.PREDICTION: "prediction",
+            }[task_type]
+            if self._spec.dataset_fn is None:
+                raise ValueError("model module must define dataset_fn for data tasks")
+            parse = self._spec.dataset_fn(mode, reader.metadata)
+            from elasticdl_tpu.parallel.mesh import data_axis
+
+            multiple = dict(
+                zip(self._mesh.axis_names, self._mesh.devices.shape)
+            )[data_axis(self._mesh)]
+            self._services[task_type] = TaskDataService(
+                reader, parse, self.cfg.minibatch_size, batch_multiple=multiple
+            )
+        return self._services[task_type]
+
+    def _ensure_state(self, example_batch: Dict[str, Any]) -> None:
+        if self._state is None:
+            self._state = self._trainer.init_state(example_batch)
+
+    # ------------------------------------------------------------------ #
+    # heartbeats
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                version = self._state.model_version if self._state is not None else 0
+                resp = self._stub.Heartbeat(
+                    pb.HeartbeatRequest(
+                        worker_id=self.worker_id, model_version=version
+                    ),
+                    timeout=10,
+                )
+                if resp.shutdown:
+                    logger.info("master requested shutdown")
+                    self._shutdown.set()
+                    break
+                if resp.membership_version != self._membership_version:
+                    self._on_membership_change(resp.membership_version)
+            except Exception as e:  # master gone → stop
+                logger.warning("heartbeat failed: %s", e)
+            self._shutdown.wait(self.cfg.worker_heartbeat_s)
+
+    def _on_membership_change(self, new_version: int) -> None:
+        """Elastic hook: the worker set changed. Single-host mesh keeps
+        running; the multi-host path re-forms the jax.distributed mesh here
+        (see parallel/elastic.py)."""
+        logger.info(
+            "membership v%d -> v%d", self._membership_version, new_version
+        )
+        self._membership_version = new_version
+
+    # ------------------------------------------------------------------ #
+    # task execution
+
+    def _run_training_task(self, task: pb.Task) -> Dict[str, float]:
+        svc = self._data_service(pb.TRAINING)
+        loss_sum, loss_count = 0.0, 0
+        for batch in svc.batches(task.shard_name, task.start, task.end):
+            self._ensure_state(batch)
+            self._state, logs = self._trainer.train_step(self._state, batch)
+            loss_sum += float(logs["loss"])
+            loss_count += 1
+        return {"loss_sum": loss_sum, "loss_count": loss_count}
+
+    def _run_evaluation_task(self, task: pb.Task) -> None:
+        svc = self._data_service(pb.EVALUATION)
+        states = self._trainer.new_metric_states()
+        for batch in svc.batches(task.shard_name, task.start, task.end):
+            self._ensure_state(batch)
+            states = self._trainer.eval_step(self._state, batch, states)
+        import jax
+
+        msg = pb.ReportEvaluationMetricsRequest(
+            worker_id=self.worker_id,
+            eval_job_id=task.eval_job_id,
+            task_id=task.task_id,
+        )
+        for name, state in states.items():
+            arr = np.asarray(jax.device_get(state), np.float32)
+            msg.states.append(pb.MetricState(name=name, data=arr.tobytes()))
+        self._stub.ReportEvaluationMetrics(msg, timeout=30)
+
+    def _run_prediction_task(self, task: pb.Task) -> None:
+        svc = self._data_service(pb.PREDICTION)
+        processor = self._spec.prediction_outputs_processor
+        for batch in svc.batches(task.shard_name, task.start, task.end):
+            self._ensure_state(batch)
+            outputs = self._trainer.predict_step(self._state, batch)
+            if processor is not None:
+                import jax
+
+                valid = batch["mask"] > 0
+                processor.process(
+                    np.asarray(jax.device_get(outputs))[valid], self.worker_id
+                )
+
+    # ------------------------------------------------------------------ #
+
+    def run(self) -> int:
+        self._connect()
+        self._build_trainer()
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True
+        )
+        self._heartbeat_thread.start()
+
+        tasks_done = 0
+        while not self._shutdown.is_set():
+            try:
+                resp = self._stub.GetTask(
+                    pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
+                )
+            except Exception as e:
+                logger.warning("get_task failed: %s; retrying", e)
+                time.sleep(2)
+                continue
+            if resp.job_done:
+                logger.info("job done after %d tasks", tasks_done)
+                break
+            task = resp.task
+            if task.type == pb.WAIT:
+                time.sleep(resp.backoff_seconds or 1.0)
+                continue
+
+            report = pb.ReportTaskResultRequest(
+                worker_id=self.worker_id, task_id=task.task_id, success=True
+            )
+            try:
+                if task.type == pb.TRAINING:
+                    stats = self._run_training_task(task)
+                    report.loss_sum = stats["loss_sum"]
+                    report.loss_count = int(stats["loss_count"])
+                elif task.type == pb.EVALUATION:
+                    self._run_evaluation_task(task)
+                elif task.type == pb.PREDICTION:
+                    self._run_prediction_task(task)
+                elif task.type == pb.SAVE_MODEL:
+                    self._save_checkpoint()
+                report.records_processed = task.end - task.start
+                if self._state is not None:
+                    report.model_version = self._state.model_version
+            except Exception as e:
+                logger.exception("task %d failed", task.task_id)
+                report.success = False
+                report.err_message = str(e)[:512]
+            try:
+                self._stub.ReportTaskResult(report, timeout=30)
+            except Exception as e:
+                logger.warning("report failed for task %d: %s", task.task_id, e)
+            tasks_done += 1
+
+        # Orderly teardown: stop the heartbeat thread and close the channel
+        # BEFORE interpreter exit — a grpc call in flight during shutdown
+        # aborts the process from the C++ layer.
+        self._shutdown.set()
+        if self._heartbeat_thread is not None:
+            self._heartbeat_thread.join(timeout=2 * self.cfg.worker_heartbeat_s)
+        try:
+            self._channel.close()
+        except Exception:
+            pass
+        return 0
+
+    def _save_checkpoint(self) -> None:
+        from elasticdl_tpu.training.checkpoint import CheckpointManager
+
+        if self._state is None or not self.cfg.checkpoint_dir:
+            return
+        CheckpointManager(
+            self.cfg.checkpoint_dir, keep=self.cfg.keep_checkpoint_max
+        ).save(self._state)

@@ -1,0 +1,84 @@
+"""Data readers + task data service (reference: data_reader_test.py)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data.reader import (
+    SyntheticDataReader,
+    TextLineDataReader,
+    create_data_reader,
+)
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+
+def test_textline_reader(tmp_path):
+    f1 = tmp_path / "a.csv"
+    f1.write_text("".join(f"row{i}\n" for i in range(25)))
+    f2 = tmp_path / "b.csv"
+    f2.write_text("".join(f"other{i}\n" for i in range(5)))
+    reader = TextLineDataReader(str(tmp_path / "*.csv"))
+    shards = reader.create_shards()
+    assert [(s[1], s[2]) for s in shards] == [(0, 25), (0, 5)]
+    recs = list(reader.read_records(str(f1), 10, 13))
+    assert recs == [b"row10", b"row11", b"row12"]
+
+
+def test_textline_skip_header(tmp_path):
+    f = tmp_path / "h.csv"
+    f.write_text("header\nrow0\nrow1\n")
+    reader = TextLineDataReader(str(f), skip_header=True)
+    (name, s, e), = reader.create_shards()
+    assert e - s == 2
+    assert list(reader.read_records(name, 0, 2)) == [b"row0", b"row1"]
+
+
+def test_synthetic_reader_deterministic():
+    r1 = SyntheticDataReader(kind="mnist", num_records=100, num_shards=3)
+    r2 = SyntheticDataReader(kind="mnist", num_records=100, num_shards=3)
+    shards = r1.create_shards()
+    assert sum(e - s for _, s, e in shards) == 100
+    a = list(r1.read_records(*shards[1]))
+    b = list(r2.read_records(*shards[1]))
+    assert a == b
+    assert len(a[0]) == 785
+
+
+def test_create_data_reader_url():
+    r = create_data_reader("synthetic://criteo?n=50&shards=2")
+    shards = r.create_shards()
+    assert len(shards) == 2
+    rec = next(r.read_records(*shards[0]))
+    assert rec.count(b"\t") == 39  # label + 13 dense + 26 cat
+
+
+def test_task_data_service_batches_and_padding():
+    reader = SyntheticDataReader(kind="mnist", num_records=50, num_shards=1)
+
+    def parse(rec):
+        buf = np.frombuffer(rec, np.uint8)
+        return buf[1:].astype(np.float32), np.int32(buf[0])
+
+    svc = TaskDataService(reader, parse, batch_size=16, batch_multiple=8)
+    batches = list(svc.batches("s", 0, 50))
+    assert len(batches) == 4                      # 16+16+16+2(padded)
+    for b in batches[:3]:
+        assert b["features"].shape == (16, 784)
+        assert b["mask"].sum() == 16
+    last = batches[-1]
+    assert last["features"].shape == (16, 784)
+    assert last["mask"].sum() == 2
+
+    # batch size rounded up to the mesh multiple
+    svc2 = TaskDataService(reader, parse, batch_size=10, batch_multiple=8)
+    assert svc2.batch_size == 16
+
+
+def test_task_data_service_dict_features():
+    reader = SyntheticDataReader(kind="criteo", num_records=20, num_shards=1)
+    from model_zoo.deepfm.deepfm import dataset_fn
+
+    parse = dataset_fn("training", reader.metadata)
+    svc = TaskDataService(reader, parse, batch_size=8)
+    b = next(iter(svc.batches("s", 0, 20)))
+    assert b["features"]["dense"].shape == (8, 13)
+    assert b["features"]["cat"].shape == (8, 26)

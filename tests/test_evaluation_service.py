@@ -1,0 +1,96 @@
+"""Evaluation service edge cases — regression tests for the eval-job
+cascade, duplicate-report dedup, and lost-task finalization."""
+
+import numpy as np
+
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.training import metrics as metrics_lib
+
+
+def build(evaluation_steps=2, max_task_retries=1):
+    d = TaskDispatcher(
+        training_shards=[("t", 0, 40)],
+        evaluation_shards=[("v", 0, 20)],
+        records_per_task=10,
+        shuffle=False,
+        max_task_retries=max_task_retries,
+    )
+    ev = EvaluationService(
+        d, {"mean": metrics_lib.Mean()}, evaluation_steps=evaluation_steps
+    )
+    return d, ev
+
+
+def test_no_eval_cascade_at_job_end():
+    """An eval lease outstanding when the last training task reports must
+    NOT retrigger epoch-end eval jobs (the cascade bug)."""
+    d, ev = build(evaluation_steps=0)  # eval only at epoch end
+    worker = 0
+    # drain all training tasks
+    train_tasks = []
+    while (t := d.get(worker)) is not None:
+        if t.type != pb.TRAINING:
+            d.report(t.task_id, worker, True)
+            continue
+        train_tasks.append(t)
+        if len(train_tasks) == 4:
+            break
+    for t in train_tasks[:-1]:
+        d.report(t.task_id, worker, True)
+    # last training report fires epoch end → eval job 0 (2 eval tasks)
+    d.report(train_tasks[-1].task_id, worker, True)
+    e1 = d.get(worker)
+    e2 = d.get(worker)
+    assert e1.type == pb.EVALUATION and e2.type == pb.EVALUATION
+    # report one eval task while the other is still leased: no new jobs
+    ev.report_metrics(e1.eval_job_id, e1.task_id, {"mean": np.array([1.0, 1.0])})
+    d.report(e1.task_id, worker, True)
+    assert d.get(worker) is None, "cascade: a new eval job appeared"
+    ev.report_metrics(e2.eval_job_id, e2.task_id, {"mean": np.array([3.0, 1.0])})
+    d.report(e2.task_id, worker, True)
+    assert d.finished()
+    assert ev.latest_results()["mean"] == 2.0
+
+
+def test_duplicate_eval_report_ignored():
+    d, ev = build()
+    job = ev.trigger(0)
+    t = d.get(0)
+    ev.report_metrics(job, t.task_id, {"mean": np.array([4.0, 2.0])})
+    ev.report_metrics(job, t.task_id, {"mean": np.array([4.0, 2.0])})  # dup
+    t2 = d.get(0)
+    ev.report_metrics(job, t2.task_id, {"mean": np.array([2.0, 1.0])})
+    assert ev.latest_results()["mean"] == 2.0  # (4+2)/(2+1), dup excluded
+
+
+def test_lost_eval_task_still_finalizes():
+    d, ev = build(max_task_retries=0)
+    job = ev.trigger(0)
+    t1 = d.get(0)
+    t2 = d.get(0)
+    ev.report_metrics(job, t1.task_id, {"mean": np.array([6.0, 2.0])})
+    d.report(t1.task_id, 0, True)
+    # t2 fails permanently (retries=0) → job must finalize without it
+    d.report(t2.task_id, 0, False, "crash")
+    assert ev.latest_results()["mean"] == 3.0
+
+
+def test_multi_epoch_fires_eval_per_epoch():
+    d = TaskDispatcher(
+        training_shards=[("t", 0, 20)],
+        evaluation_shards=[("v", 0, 10)],
+        records_per_task=10,
+        num_epochs=2,
+        shuffle=False,
+    )
+    ev = EvaluationService(d, {"mean": metrics_lib.Mean()}, evaluation_steps=0)
+    jobs_seen = set()
+    while (t := d.get(0)) is not None:
+        if t.type == pb.EVALUATION:
+            jobs_seen.add(t.eval_job_id)
+            ev.report_metrics(t.eval_job_id, t.task_id, {"mean": np.array([1.0, 1.0])})
+        d.report(t.task_id, 0, True)
+    assert len(jobs_seen) == 2  # one eval job per epoch end
+    assert d.finished()

