@@ -164,8 +164,9 @@ def create_data_reader(
         opts = dict(p.split("=", 1) for p in qs.split("&") if "=" in p)
         return SyntheticDataReader(
             kind=kind or "mnist",
-            num_records=int(opts.get("n", params.pop("num_records", 60000))),
-            num_shards=int(opts.get("shards", params.pop("num_shards", 4))),
+            # int(float(...)) so scientific notation ("n=1e6") works
+            num_records=int(float(opts.get("n", params.pop("num_records", 60000)))),
+            num_shards=int(float(opts.get("shards", params.pop("num_shards", 4)))),
             **params,
         )
     name = reader_name or ("recordio" if data_path.endswith(".rio") else "textline")

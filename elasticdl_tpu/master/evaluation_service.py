@@ -67,14 +67,18 @@ class EvaluationService:
 
     def maybe_trigger(self) -> Optional[int]:
         """Called after each finished training task; starts an eval job every
-        `evaluation_steps` completed tasks."""
+        `evaluation_steps` completed tasks. The threshold check claims
+        `_last_trigger_version` under the lock so concurrent report handlers
+        can't double-trigger."""
         if not self._evaluation_steps:
             return None
         version = self._dispatcher.completed_versions
-        if version < self._start_delay:
-            return None
-        if version - self._last_trigger_version < self._evaluation_steps:
-            return None
+        with self._lock:
+            if version < self._start_delay:
+                return None
+            if version - self._last_trigger_version < self._evaluation_steps:
+                return None
+            self._last_trigger_version = version
         return self.trigger(version)
 
     def _on_epoch_end(self, epoch: int) -> None:

@@ -115,6 +115,7 @@ class Master:
         deadline = time.time() + timeout_s if timeout_s else None
         while not self.dispatcher.finished():
             self.membership.reap()
+            self.dispatcher.poke()
             if deadline and time.time() > deadline:
                 return False
             if abort_fn is not None and abort_fn():

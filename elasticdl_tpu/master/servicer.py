@@ -46,7 +46,7 @@ class MasterServicer:
 
     def RegisterWorker(self, request, context):
         info = self._membership.register(
-            request.worker_name, request.preferred_id if request.preferred_id else -1
+            request.worker_name, request.preferred_id_plus_one - 1
         )
         return pb.RegisterWorkerResponse(
             worker_id=info.worker_id,

@@ -114,6 +114,9 @@ class TaskDispatcher:
             self._training_done = True
             if self._prediction_shards:
                 self._create_tasks(self._prediction_shards, pb.PREDICTION)
+            elif not self._evaluation_shards:
+                # nothing to do at all — the job is born finished
+                self._job_end_fired = True
 
     # ------------------------------------------------------------------ #
     # task creation
@@ -277,22 +280,30 @@ class TaskDispatcher:
     def _maybe_advance_epoch_locked(self) -> List[Callable]:
         """If the current epoch's training drained, fire epoch-end exactly
         once, then start the next epoch or finish training; fire job-end
-        exactly once when everything (incl. eval/predict tasks) drains."""
+        exactly once when everything (incl. eval/predict tasks) drains.
+
+        Job-end is DEFERRED whenever other callbacks are pending: epoch-end
+        callbacks typically enqueue the final eval job's tasks (outside the
+        lock), and firing job-end in the same pass would let workers see
+        job_done before those tasks exist."""
         callbacks: List[Callable] = []
-        training_left = any(t.type == pb.TRAINING for t in self._todo) or any(
-            l.task.type == pb.TRAINING for l in self._doing.values()
-        )
-        if not training_left:
-            if self._epoch >= 0 and not self._epoch_end_fired:
-                self._epoch_end_fired = True
-                epoch = self._epoch
-                callbacks.extend(
-                    lambda cb=cb: cb(epoch) for cb in self._epoch_end_callbacks
-                )
-            if self._epoch + 1 < self._num_epochs:
-                self._start_next_epoch()
-            else:
-                self._training_done = True
+        if self._training_shards and not self._training_done:
+            training_left = any(
+                t.type == pb.TRAINING for t in self._todo
+            ) or any(l.task.type == pb.TRAINING for l in self._doing.values())
+            if not training_left:
+                if self._epoch >= 0 and not self._epoch_end_fired:
+                    self._epoch_end_fired = True
+                    epoch = self._epoch
+                    callbacks.extend(
+                        lambda cb=cb: cb(epoch) for cb in self._epoch_end_callbacks
+                    )
+                if self._epoch + 1 < self._num_epochs:
+                    self._start_next_epoch()
+                else:
+                    self._training_done = True
+        if callbacks:
+            return callbacks
         if (
             self._training_done
             and not self._todo
@@ -316,9 +327,21 @@ class TaskDispatcher:
         """cb(task) fires when a task fails permanently (retries exhausted)."""
         self._task_failed_callbacks.append(cb)
 
-    def finished(self) -> bool:
+    def poke(self) -> None:
+        """Drive deferred state transitions (lease reaping, epoch/job end)
+        without a worker RPC — the master's wait loop calls this so progress
+        doesn't depend on workers polling."""
         with self._lock:
-            return self._training_done and not self._todo and not self._doing
+            self._reap_expired_locked()
+            callbacks = self._maybe_advance_epoch_locked()
+        self._flush_callbacks(callbacks)
+
+    def finished(self) -> bool:
+        """True only once job-end has actually fired — `_training_done` with
+        empty queues is transiently observable while epoch-end callbacks are
+        still enqueueing the final eval tasks, and must not look finished."""
+        with self._lock:
+            return self._job_end_fired
 
     @property
     def completed_versions(self) -> int:

@@ -55,7 +55,10 @@ class Worker:
         name = f"{socket.gethostname()}:{os.getpid()}"
         preferred = int(os.environ.get(WorkerEnv.WORKER_ID, -1))
         resp = self._stub.RegisterWorker(
-            pb.RegisterWorkerRequest(worker_name=name, preferred_id=max(preferred, 0)),
+            pb.RegisterWorkerRequest(
+                worker_name=name,
+                preferred_id_plus_one=preferred + 1 if preferred >= 0 else 0,
+            ),
             timeout=30,
         )
         self.worker_id = resp.worker_id

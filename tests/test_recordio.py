@@ -1,0 +1,126 @@
+"""EDLR recordio: native ↔ pure-python cross-compat, corruption detection,
+reader integration (reference: RecordIO + pyrecordio role, SURVEY §2.4)."""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.data import recordio as rio
+
+
+@pytest.fixture(scope="module")
+def native_available():
+    return rio.build_native() is not None
+
+
+def write_file(path, records, chunk_bytes=256):
+    w = rio.RecordIOWriter(str(path), chunk_bytes=chunk_bytes)
+    for r in records:
+        w.write(r)
+    return w.close()
+
+
+def records(n=100):
+    return [f"record-{i}".encode() * (1 + i % 7) for i in range(n)]
+
+
+def test_native_builds(native_available):
+    assert native_available, "g++ toolchain present; native build must succeed"
+
+
+def test_roundtrip_native(tmp_path, native_available):
+    recs = records()
+    n = write_file(tmp_path / "a.rio", recs)
+    assert n == 100
+    r = rio.open_shard(str(tmp_path / "a.rio"), prefer_native=True)
+    if native_available:
+        assert isinstance(r, rio._NativeShardReader)
+    assert r.num_records == 100
+    assert list(r.read(0, 100)) == recs
+    assert list(r.read(37, 42)) == recs[37:42]
+    assert list(r.read(95, 200)) == recs[95:]
+    assert list(r.read(50, 50)) == []
+
+
+def test_python_reader_reads_native_file(tmp_path, native_available):
+    recs = records(60)
+    write_file(tmp_path / "b.rio", recs, chunk_bytes=128)
+    pyr = rio._PyShardReader(str(tmp_path / "b.rio"))
+    assert pyr.num_records == 60
+    assert list(pyr.read(10, 20)) == recs[10:20]
+
+
+def test_python_writer_file_read_by_native(tmp_path, native_available):
+    recs = records(40)
+    # force the pure-python writer
+    w = rio.RecordIOWriter.__new__(rio.RecordIOWriter)
+    w._path = str(tmp_path / "c.rio")
+    w._native = None
+    w.num_records = 0
+    w._closed = False
+    w._f = open(w._path, "wb")
+    w._f.write(rio._FILE_MAGIC + struct.pack("<I", rio._VERSION))
+    w._chunk_bytes = 200
+    w._payload = bytearray()
+    w._chunk_records = 0
+    w._index = []
+    for r in recs:
+        w.write(r)
+    assert w.close() == 40
+    if native_available:
+        nr = rio._NativeShardReader(w._path, rio._load_lib())
+        assert nr.num_records == 40
+        assert list(nr.read(5, 15)) == recs[5:15]
+    assert list(rio._PyShardReader(w._path).read(0, 40)) == recs
+
+
+def test_corruption_detected(tmp_path, native_available):
+    recs = records(30)
+    path = tmp_path / "d.rio"
+    write_file(path, recs, chunk_bytes=128)
+    data = bytearray(path.read_bytes())
+    # flip a byte inside the first chunk's payload
+    data[40] ^= 0xFF
+    path.write_bytes(bytes(data))
+    with pytest.raises(IOError):
+        list(rio._PyShardReader(str(path)).read(0, 30))
+    if native_available:
+        nr = rio._NativeShardReader(str(path), rio._load_lib())
+        with pytest.raises(IOError, match="crc"):
+            list(nr.read(0, 30))
+
+
+def test_empty_file_roundtrip(tmp_path):
+    n = write_file(tmp_path / "e.rio", [])
+    assert n == 0
+    r = rio.open_shard(str(tmp_path / "e.rio"))
+    assert r.num_records == 0
+    assert list(r.read(0, 10)) == []
+
+
+def test_data_reader_over_directory(tmp_path):
+    for i in range(3):
+        write_file(tmp_path / f"part-{i}.rio", records(20 + i))
+    reader = rio.RecordIODataReader(str(tmp_path))
+    shards = reader.create_shards()
+    assert [e for _, _, e in shards] == [20, 21, 22]
+    recs = list(reader.read_records(shards[1][0], 5, 8))
+    assert recs == records(21)[5:8]
+
+
+def test_factory_dispatch(tmp_path):
+    from elasticdl_tpu.data.reader import create_data_reader
+
+    write_file(tmp_path / "x.rio", records(10))
+    r = create_data_reader(str(tmp_path / "x.rio"))
+    assert sum(e - s for _, s, e in r.create_shards()) == 10
+
+
+def test_large_records_cross_chunks(tmp_path):
+    # records larger than chunk target: one record per chunk
+    recs = [os.urandom(5000) for _ in range(8)]
+    write_file(tmp_path / "big.rio", recs, chunk_bytes=1024)
+    r = rio.open_shard(str(tmp_path / "big.rio"))
+    assert list(r.read(0, 8)) == recs
