@@ -1,0 +1,96 @@
+"""Flagship benchmark: DeepFM (Criteo-style) training throughput per chip.
+
+BASELINE.md: the reference publishes no numbers (`BASELINE.json "published": {}`),
+so the north-star metric is samples/sec/chip on the DeepFM config. The first
+recorded run becomes the local baseline; later rounds compare against it via
+the `EDL_BENCH_BASELINE` env var or the DEFAULT_BASELINE constant below.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "samples/s/chip", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+# First local measurement (round 1, one TPU v5 lite chip, 2026-07-29):
+# 7.78M samples/s/chip. Later rounds compare against this.
+DEFAULT_BASELINE = 7_784_727.5
+
+BATCH = 8192
+FIELD_VOCAB = 100_000       # 26 fields -> 2.6M-row shared table (~166 MB fp32)
+WARMUP_STEPS = 5
+TIMED_STEPS = 30
+
+
+def main():
+    import jax
+
+    from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.parallel.mesh import build_mesh
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    import numpy as np
+
+    deepfm, _ = load_module(
+        os.path.join(REPO_ROOT, "model_zoo"), "deepfm.deepfm.custom_model"
+    )
+    n_chips = len(jax.devices())
+    mesh = build_mesh({"data": n_chips})
+
+    spec = ModelSpec(
+        model=deepfm.custom_model(field_vocab=FIELD_VOCAB, hidden="400,400"),
+        loss=deepfm.loss,
+        optimizer=deepfm.optimizer(),
+        dataset_fn=None,
+        eval_metrics_fn=deepfm.eval_metrics_fn,
+        module_name="deepfm.deepfm",
+    )
+    trainer = Trainer(spec, mesh)
+
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "dense": rng.rand(BATCH, 13).astype(np.float32),
+            "cat": rng.randint(0, 1 << 30, size=(BATCH, 26)).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, size=(BATCH,)).astype(np.int32),
+    }
+
+    state = trainer.init_state(batch)
+    for _ in range(WARMUP_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        state, metrics = trainer.train_step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    samples_per_sec_chip = BATCH * TIMED_STEPS / dt / n_chips
+    baseline = os.environ.get("EDL_BENCH_BASELINE")
+    baseline = float(baseline) if baseline else DEFAULT_BASELINE
+    vs = samples_per_sec_chip / baseline if baseline else 1.0
+    print(
+        json.dumps(
+            {
+                "metric": "deepfm_train_samples_per_sec_per_chip",
+                "value": round(samples_per_sec_chip, 1),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

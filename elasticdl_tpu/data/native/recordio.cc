@@ -90,6 +90,10 @@ bool write_pod(FILE* f, const T& v) {
 
 bool load_chunk(Reader* r, size_t chunk_i) {
   if ((int64_t)chunk_i == r->cached_chunk) return true;
+  // The payload buffer is overwritten below; until the new chunk fully
+  // validates, the cache must not claim to hold any chunk, or a failed load
+  // would leave a stale cache serving the wrong bytes on a later fast-path hit.
+  r->cached_chunk = -1;
   const ChunkIndexEntry& e = r->index[chunk_i];
   if (fseek(r->f, (long)e.offset, SEEK_SET) != 0) {
     r->error = "seek failed";
@@ -224,6 +228,7 @@ long long edlr_reader_read(void* h, long long start, long long end) {
   Reader* r = (Reader*)h;
   if (!r) return -1;
   if (start < 0) start = 0;
+  if (end < 0) end = 0;
   if ((uint64_t)end > r->num_records) end = (long long)r->num_records;
   r->out.clear();
   if (start >= end) return 0;
@@ -307,7 +312,7 @@ void* edlr_writer_open(const char* path, long long chunk_bytes) {
 
 int edlr_writer_write(void* h, const uint8_t* data, long long len) {
   Writer* w = (Writer*)h;
-  if (!w || len < 0) return -1;
+  if (!w || len < 0 || (unsigned long long)len > UINT32_MAX) return -1;
   uint32_t len32 = (uint32_t)len;
   size_t pos = w->payload.size();
   w->payload.resize(pos + 4 + len32);

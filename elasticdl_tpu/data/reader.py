@@ -169,7 +169,13 @@ def create_data_reader(
             num_shards=int(float(opts.get("shards", params.pop("num_shards", 4)))),
             **params,
         )
-    name = reader_name or ("recordio" if data_path.endswith(".rio") else "textline")
+    if not reader_name:
+        is_rio = data_path.endswith(".rio") or (
+            os.path.isdir(data_path)
+            and any(f.endswith(".rio") for f in os.listdir(data_path))
+        )
+        reader_name = "recordio" if is_rio else "textline"
+    name = reader_name
     if name in ("textline", "csv", "tsv"):
         return TextLineDataReader(data_path, **params)
     if name == "recordio":

@@ -13,6 +13,7 @@ The native library auto-builds on first use when a toolchain is present
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import glob
 import os
@@ -43,32 +44,47 @@ def build_native(force: bool = False) -> Optional[str]:
     A failed build is remembered so N shard opens don't pay N compiles."""
     global _build_failed
     with _build_lock:
-        if os.path.exists(_LIB_PATH) and not force:
+        src = os.path.join(_NATIVE_DIR, "recordio.cc")
+        if (
+            os.path.exists(_LIB_PATH)
+            and not force
+            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
+        ):
             return _LIB_PATH
         if _build_failed and not force:
             return None
-        src = os.path.join(_NATIVE_DIR, "recordio.cc")
+        # Master and workers may all build concurrently on first run; compile
+        # to a per-pid temp file and rename into place (atomic on POSIX) so no
+        # process ever dlopens a half-written .so.
+        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
         try:
             subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", _LIB_PATH],
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp],
                 check=True,
                 capture_output=True,
                 timeout=120,
             )
+            os.replace(tmp, _LIB_PATH)
             logger.info("built native recordio: %s", _LIB_PATH)
             _build_failed = False
             return _LIB_PATH
-        except (subprocess.SubprocessError, FileNotFoundError) as e:
+        except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
             _build_failed = True
             logger.warning("native recordio build failed (%s); using pure python", e)
             return None
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:
     global _lib
     if _lib is not None:
         return _lib
-    path = _LIB_PATH if os.path.exists(_LIB_PATH) else build_native()
+    path = build_native()  # fast no-op when the .so is present and fresh
     if path is None:
         return None
     lib = ctypes.CDLL(path)
@@ -101,9 +117,10 @@ def _load_lib() -> Optional[ctypes.CDLL]:
 class RecordIOWriter:
     """Writes one EDLR shard file (native when available)."""
 
-    def __init__(self, path: str, chunk_bytes: int = 1 << 20):
+    def __init__(self, path: str, chunk_bytes: int = 1 << 20,
+                 prefer_native: bool = True):
         self._path = path
-        self._native = _load_lib()
+        self._native = _load_lib() if prefer_native else None
         self.num_records = 0
         self._closed = False
         if self._native is not None:
@@ -288,15 +305,34 @@ class RecordIODataReader(AbstractDataReader):
         if not self._files:
             raise FileNotFoundError(f"no recordio files match {path!r}")
         self._prefer_native = prefer_native
-        self._readers: Dict[str, object] = {}
+        # Workers stream one shard at a time; a small LRU bounds open fds (a
+        # master over thousands of shards would otherwise exhaust the ulimit)
+        # and chunk-cache memory.
+        self._readers: "collections.OrderedDict[str, object]" = (
+            collections.OrderedDict()
+        )
+        self._max_open = 8
 
     def _reader(self, fname: str):
-        if fname not in self._readers:
-            self._readers[fname] = open_shard(fname, self._prefer_native)
-        return self._readers[fname]
+        if fname in self._readers:
+            self._readers.move_to_end(fname)
+            return self._readers[fname]
+        reader = open_shard(fname, self._prefer_native)
+        self._readers[fname] = reader
+        while len(self._readers) > self._max_open:
+            _, old = self._readers.popitem(last=False)
+            old.close()
+        return reader
 
     def create_shards(self) -> List[Shard]:
-        return [(f, 0, self._reader(f).num_records) for f in self._files]
+        shards = []
+        for f in self._files:
+            reader = open_shard(f, self._prefer_native)
+            try:
+                shards.append((f, 0, reader.num_records))
+            finally:
+                reader.close()
+        return shards
 
     def read_records(self, shard_name: str, start: int, end: int) -> Iterator[bytes]:
         yield from self._reader(shard_name).read(start, end)

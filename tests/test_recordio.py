@@ -54,18 +54,9 @@ def test_python_reader_reads_native_file(tmp_path, native_available):
 
 def test_python_writer_file_read_by_native(tmp_path, native_available):
     recs = records(40)
-    # force the pure-python writer
-    w = rio.RecordIOWriter.__new__(rio.RecordIOWriter)
-    w._path = str(tmp_path / "c.rio")
-    w._native = None
-    w.num_records = 0
-    w._closed = False
-    w._f = open(w._path, "wb")
-    w._f.write(rio._FILE_MAGIC + struct.pack("<I", rio._VERSION))
-    w._chunk_bytes = 200
-    w._payload = bytearray()
-    w._chunk_records = 0
-    w._index = []
+    w = rio.RecordIOWriter(
+        str(tmp_path / "c.rio"), chunk_bytes=200, prefer_native=False
+    )
     for r in recs:
         w.write(r)
     assert w.close() == 40
@@ -124,3 +115,59 @@ def test_large_records_cross_chunks(tmp_path):
     write_file(tmp_path / "big.rio", recs, chunk_bytes=1024)
     r = rio.open_shard(str(tmp_path / "big.rio"))
     assert list(r.read(0, 8)) == recs
+
+
+def test_failed_chunk_load_does_not_poison_cache(tmp_path, native_available):
+    """A CRC failure in chunk N must not leave chunk N's bytes served under a
+    previously cached chunk id (native reader chunk-cache invalidation)."""
+    if not native_available:
+        pytest.skip("needs native reader")
+    recs = records(30)
+    path = tmp_path / "poison.rio"
+    write_file(path, recs, chunk_bytes=128)
+    nr = rio._NativeShardReader(str(path), rio._load_lib())
+    # find a record index inside the second chunk
+    assert nr.num_records == 30
+    first = list(nr.read(0, 2))
+    assert first == recs[:2]
+    # corrupt a later chunk's payload on disk; reopen to see the new bytes
+    data = bytearray(path.read_bytes())
+    data[-200] ^= 0xFF
+    path.write_bytes(bytes(data))
+    nr2 = rio._NativeShardReader(str(path), rio._load_lib())
+    assert list(nr2.read(0, 2)) == recs[:2]        # caches chunk 0
+    with pytest.raises(IOError):
+        list(nr2.read(0, 30))                      # fails in a later chunk
+    assert list(nr2.read(0, 2)) == recs[:2]        # chunk 0 still correct
+
+
+def test_negative_end_matches_python_twin(tmp_path, native_available):
+    recs = records(10)
+    path = tmp_path / "neg.rio"
+    write_file(path, recs)
+    assert list(rio._PyShardReader(str(path)).read(0, -1)) == []
+    if native_available:
+        nr = rio._NativeShardReader(str(path), rio._load_lib())
+        assert list(nr.read(0, -1)) == []
+
+
+def test_directory_of_rio_infers_recordio_reader(tmp_path):
+    from elasticdl_tpu.data.reader import create_data_reader
+
+    write_file(tmp_path / "part-00000.rio", records(10))
+    r = create_data_reader(str(tmp_path))
+    assert isinstance(r, rio.RecordIODataReader)
+
+
+def test_oversized_record_rejected_not_truncated(tmp_path, native_available):
+    """Native writer must reject len > u32 range like the python twin does,
+    never silently wrap. (Exercised via the ctypes arg, not a real 4GiB buf.)"""
+    if not native_available:
+        pytest.skip("needs native writer")
+    import ctypes
+
+    lib = rio._load_lib()
+    h = lib.edlr_writer_open(str(tmp_path / "o.rio").encode(), 1 << 20)
+    assert h
+    assert lib.edlr_writer_write(h, b"x", (1 << 32) + 100) == -1
+    assert lib.edlr_writer_close(h) == 0
