@@ -45,14 +45,17 @@ def build_native(force: bool = False) -> Optional[str]:
     global _build_failed
     with _build_lock:
         src = os.path.join(_NATIVE_DIR, "recordio.cc")
-        if (
-            os.path.exists(_LIB_PATH)
-            and not force
-            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
-        ):
-            return _LIB_PATH
+        have_lib = os.path.exists(_LIB_PATH)
+        if have_lib and not force:
+            # A shipped .so without source (or newer than it) is used as-is.
+            try:
+                fresh = os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
+            except OSError:
+                fresh = True
+            if fresh:
+                return _LIB_PATH
         if _build_failed and not force:
-            return None
+            return _LIB_PATH if have_lib else None
         # Master and workers may all build concurrently on first run; compile
         # to a per-pid temp file and rename into place (atomic on POSIX) so no
         # process ever dlopens a half-written .so.
@@ -70,6 +73,13 @@ def build_native(force: bool = False) -> Optional[str]:
             return _LIB_PATH
         except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
             _build_failed = True
+            if have_lib:
+                # Stale-but-loadable beats the pure-Python fallback.
+                logger.warning(
+                    "native recordio rebuild failed (%s); using existing %s",
+                    e, _LIB_PATH,
+                )
+                return _LIB_PATH
             logger.warning("native recordio build failed (%s); using pure python", e)
             return None
         finally:
@@ -307,10 +317,14 @@ class RecordIODataReader(AbstractDataReader):
         self._prefer_native = prefer_native
         # Workers stream one shard at a time; a small LRU bounds open fds (a
         # master over thousands of shards would otherwise exhaust the ulimit)
-        # and chunk-cache memory.
+        # and chunk-cache memory. Readers backing a partially-consumed
+        # read_records() generator are pinned (refcounted) so eviction never
+        # closes a file mid-iteration; pinned entries may transiently push the
+        # cache past its bound.
         self._readers: "collections.OrderedDict[str, object]" = (
             collections.OrderedDict()
         )
+        self._pins: Dict[str, int] = {}
         self._max_open = 8
 
     def _reader(self, fname: str):
@@ -319,10 +333,23 @@ class RecordIODataReader(AbstractDataReader):
             return self._readers[fname]
         reader = open_shard(fname, self._prefer_native)
         self._readers[fname] = reader
-        while len(self._readers) > self._max_open:
-            _, old = self._readers.popitem(last=False)
-            old.close()
+        evictable = [f for f in self._readers if not self._pins.get(f)]
+        while len(self._readers) > self._max_open and evictable:
+            old_name = evictable.pop(0)
+            if old_name == fname:
+                continue
+            self._readers.pop(old_name).close()
         return reader
+
+    def _pin(self, fname: str) -> None:
+        self._pins[fname] = self._pins.get(fname, 0) + 1
+
+    def _unpin(self, fname: str) -> None:
+        n = self._pins.get(fname, 0) - 1
+        if n <= 0:
+            self._pins.pop(fname, None)
+        else:
+            self._pins[fname] = n
 
     def create_shards(self) -> List[Shard]:
         shards = []
@@ -335,4 +362,9 @@ class RecordIODataReader(AbstractDataReader):
         return shards
 
     def read_records(self, shard_name: str, start: int, end: int) -> Iterator[bytes]:
-        yield from self._reader(shard_name).read(start, end)
+        reader = self._reader(shard_name)
+        self._pin(shard_name)
+        try:
+            yield from reader.read(start, end)
+        finally:
+            self._unpin(shard_name)

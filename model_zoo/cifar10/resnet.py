@@ -1,0 +1,120 @@
+"""CIFAR-10 ResNet — parity config (BASELINE.md: "CIFAR-10 ResNet-20,
+multi-worker data parallel").
+
+Reference parity: model_zoo/cifar10_functional_api/cifar10_functional_api.py
+in the reference zoo (Keras CNN trained data-parallel). Rebuilt as a flax
+ResNet-20 (He et al. CIFAR variant: 3 stages x n basic blocks, 16/32/64
+channels), bfloat16 compute on the MXU, fp32 params and BatchNorm statistics.
+
+BatchNorm runs inside the single jitted step over the whole logical batch, so
+on a data-parallel mesh XLA computes *globally synchronized* batch statistics
+via ICI collectives — the reference's per-replica TF BatchNorm never had that.
+Running statistics live in the `batch_stats` collection, carried by the
+trainer's `extra_vars`.
+"""
+
+from functools import partial
+from typing import Any, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.training import metrics as metrics_lib
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="shortcut")(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class CifarResNet(nn.Module):
+    """ResNet-20/32/44/56 for 32x32 inputs: depth = 6n + 2."""
+
+    depth: int = 20
+    num_classes: int = 10
+    compute_dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        if (self.depth - 2) % 6:
+            raise ValueError(f"CIFAR ResNet depth must be 6n+2, got {self.depth}")
+        n = (self.depth - 2) // 6
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.compute_dtype,
+        )
+        x = x.astype(self.compute_dtype)
+        x = conv(16, (3, 3), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        for stage, filters in enumerate((16, 32, 64)):
+            for block in range(n):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BasicBlock(filters, strides, conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model(**kwargs):
+    return CifarResNet(
+        depth=int(kwargs.get("depth", 20)),
+        num_classes=int(kwargs.get("num_classes", 10)),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+    )
+
+
+def loss(labels, outputs):
+    # per-example; the framework applies the padding mask and takes the mean
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, jnp.asarray(labels, jnp.int32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    lr = float(kwargs.get("learning_rate", 0.1))
+    return optax.chain(
+        optax.add_decayed_weights(float(kwargs.get("weight_decay", 1e-4))),
+        optax.sgd(lr, momentum=0.9, nesterov=True),
+    )
+
+
+def dataset_fn(mode, metadata):
+    """Parse one CIFAR-10-binary record: 1 label byte + 3072 pixel bytes
+    (3x32x32 channel-major uint8, as in the upstream cifar-10-bin files)."""
+
+    def parse(record: bytes):
+        buf = np.frombuffer(record, dtype=np.uint8)
+        label = buf[0].astype(np.int32)
+        image = buf[1:3073].reshape(3, 32, 32).transpose(1, 2, 0)
+        image = image.astype(np.float32) / 255.0
+        return image, label
+
+    return parse
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics_lib.Accuracy()}

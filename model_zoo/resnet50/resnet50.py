@@ -1,0 +1,134 @@
+"""ResNet-50 — parity config (BASELINE.md: "ResNet-50, multi-worker").
+
+Reference parity: model_zoo/resnet50_subclass/resnet50_model.py in the
+reference zoo (Keras ResNet-50 trained data-parallel with allreduce). Rebuilt
+as a flax bottleneck ResNet-50, NHWC, bfloat16 compute for the MXU, fp32
+params/BatchNorm. Gradient rematerialisation of each stage is available via
+the trainer's `remat` flag for memory-bound batch sizes.
+"""
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from elasticdl_tpu.training import metrics as metrics_lib
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale so each block starts as identity
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="shortcut")(residual)
+            residual = self.norm(name="shortcut_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet50(nn.Module):
+    num_classes: int = 1000
+    compute_dtype: jnp.dtype = jnp.bfloat16
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+
+    @nn.compact
+    def __call__(self, x, training: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.compute_dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not training,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.compute_dtype,
+        )
+        x = x.astype(self.compute_dtype)
+        x = conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, (n_blocks, filters) in enumerate(
+            zip(self.stage_sizes, (64, 128, 256, 512))
+        ):
+            for block in range(n_blocks):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(filters, strides, conv, norm)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def custom_model(**kwargs):
+    return ResNet50(
+        num_classes=int(kwargs.get("num_classes", 1000)),
+        compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
+    )
+
+
+def loss(labels, outputs):
+    return optax.softmax_cross_entropy_with_integer_labels(
+        outputs, jnp.asarray(labels, jnp.int32).reshape(-1)
+    )
+
+
+def optimizer(**kwargs):
+    base_lr = float(kwargs.get("learning_rate", 0.1))
+    warmup = int(kwargs.get("warmup_steps", 500))
+    total = int(kwargs.get("total_steps", 50_000))
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=base_lr,
+        warmup_steps=warmup, decay_steps=total,
+    )
+    return optax.chain(
+        optax.add_decayed_weights(float(kwargs.get("weight_decay", 1e-4))),
+        optax.sgd(schedule, momentum=0.9, nesterov=True),
+    )
+
+
+def dataset_fn(mode, metadata):
+    """Parse one record: 2-byte little-endian label, then either the full
+    HxWx3 uint8 image or a shorter seed block that is tiled up to size (the
+    synthetic `imagenet224` reader emits 64-byte seed blocks). Image side
+    defaults to 224 (override with metadata['image_size'])."""
+
+    side = int((metadata or {}).get("image_size", 224))
+    nbytes = side * side * 3
+
+    def parse(record: bytes):
+        if len(record) < 3:
+            raise ValueError(
+                f"imagenet record too short ({len(record)} bytes): need a "
+                f"2-byte label plus at least one image byte"
+            )
+        label = np.int32(int.from_bytes(record[:2], "little"))
+        raw = np.frombuffer(record[2:], dtype=np.uint8)
+        if raw.size < nbytes:
+            raw = np.tile(raw, nbytes // raw.size + 1)
+        image = raw[:nbytes].reshape(side, side, 3).astype(np.float32) / 255.0
+        # standard ImageNet normalization
+        mean = np.array([0.485, 0.456, 0.406], np.float32)
+        std = np.array([0.229, 0.224, 0.225], np.float32)
+        return (image - mean) / std, label
+
+    return parse
+
+
+def eval_metrics_fn():
+    return {"accuracy": metrics_lib.Accuracy()}

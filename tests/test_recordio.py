@@ -171,3 +171,29 @@ def test_oversized_record_rejected_not_truncated(tmp_path, native_available):
     assert h
     assert lib.edlr_writer_write(h, b"x", (1 << 32) + 100) == -1
     assert lib.edlr_writer_close(h) == 0
+
+
+@pytest.mark.parametrize("prefer_native", [True, False])
+def test_interleaved_generators_survive_lru_eviction(tmp_path, prefer_native):
+    """Readers backing a partially-consumed generator are pinned: interleaving
+    more generators than the LRU bound must not close files mid-iteration.
+    The pure-Python reader is the load-bearing case — it streams chunks from
+    the file handle, so a mid-iteration close corrupts it; the native reader
+    buffers the whole span up front."""
+    n_shards = 12  # > _max_open (8)
+    for i in range(n_shards):
+        write_file(tmp_path / f"part-{i:02d}.rio", records(10))
+    reader = rio.RecordIODataReader(str(tmp_path), prefer_native=prefer_native)
+    shards = reader.create_shards()
+    gens = [reader.read_records(name, 0, 10) for name, _, _ in shards]
+    # start every generator, then round-robin drain them all
+    out = [[next(g)] for g in gens]
+    for k in range(9):
+        for i, g in enumerate(gens):
+            out[i].append(next(g))
+    for i, recs in enumerate(out):
+        assert recs == records(10), f"shard {i} corrupted by eviction"
+    # closing (or exhausting) a generator releases its pin
+    for g in gens:
+        g.close()
+    assert len(reader._pins) == 0
