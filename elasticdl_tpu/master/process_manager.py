@@ -102,15 +102,22 @@ class ProcessManager:
             self._procs[wid] = self._spawn(wid)
             return wid
 
-    def kill_worker(self, worker_id: int, relaunch: bool = True) -> bool:
-        """Kill one worker process (also the fault-injection hook)."""
+    def kill_worker(
+        self, worker_id: int, relaunch: bool = True, graceful: bool = False
+    ) -> bool:
+        """Kill one worker process (also the fault-injection hook).
+        graceful=True sends SIGTERM — the k8s-preemption shape: the worker
+        drains, checkpoints, and exits EX_TEMPFAIL; False is SIGKILL."""
         with self._lock:
             wp = self._procs.get(worker_id)
             if wp is None or wp.proc.poll() is not None:
                 return False
             if not relaunch:
                 wp.relaunches = self.cfg.relaunch_max + 1
-            wp.proc.kill()
+            if graceful:
+                wp.proc.terminate()
+            else:
+                wp.proc.kill()
         return True
 
     # ------------------------------------------------------------------ #

@@ -6,6 +6,7 @@ argv the master/launcher passed, build the Worker, run the task loop.
 
 from __future__ import annotations
 
+import signal
 import sys
 from typing import List, Optional
 
@@ -15,7 +16,10 @@ from elasticdl_tpu.worker.worker import Worker
 
 def main(argv: Optional[List[str]] = None) -> int:
     cfg = JobConfig.from_argv(sys.argv[1:] if argv is None else argv)
-    return Worker(cfg).run()
+    worker = Worker(cfg)
+    # k8s preemption delivers SIGTERM with a grace period; drain + checkpoint
+    signal.signal(signal.SIGTERM, lambda *_: worker.preempt())
+    return worker.run()
 
 
 if __name__ == "__main__":

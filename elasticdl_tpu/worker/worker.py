@@ -44,6 +44,9 @@ class Worker:
         self._shutdown = threading.Event()
         self._heartbeat_thread: Optional[threading.Thread] = None
         self._parse_fns: Dict[str, Any] = {}
+        self._ckpt_manager = None
+        self._last_ckpt_step = 0
+        self._preempted = False
 
     # ------------------------------------------------------------------ #
     # setup
@@ -112,9 +115,56 @@ class Worker:
             )
         return self._services[task_type]
 
+    def _checkpoint_manager(self):
+        if self._ckpt_manager is None and self.cfg.checkpoint_dir:
+            from elasticdl_tpu.training.checkpoint import CheckpointManager
+
+            self._ckpt_manager = CheckpointManager(
+                self.cfg.checkpoint_dir, keep=self.cfg.keep_checkpoint_max
+            )
+        return self._ckpt_manager
+
     def _ensure_state(self, example_batch: Dict[str, Any]) -> None:
-        if self._state is None:
-            self._state = self._trainer.init_state(example_batch)
+        if self._state is not None:
+            return
+        self._state = self._trainer.init_state(example_batch)
+        # Elastic recovery: a relaunched worker resumes from the latest
+        # checkpoint instead of fresh params (reference analog: rank-0
+        # Horovod broadcast after re-rendezvous restoring replicated state).
+        mngr = self._checkpoint_manager()
+        if mngr is not None and mngr.latest_step() is not None:
+            restored = mngr.restore(self._state)
+            if restored is not None:
+                self._state = restored
+                self._last_ckpt_step = self._state.model_version
+                logger.info(
+                    "resumed from checkpoint at step %d", self._last_ckpt_step
+                )
+
+    def _maybe_checkpoint(self, force: bool = False) -> None:
+        """Step-interval checkpointing (reference: --checkpoint_steps), plus
+        forced saves on preemption.
+
+        Only worker 0 writes interval/preemption checkpoints: concurrent
+        orbax managers over one directory race on saves and max_to_keep GC
+        (the reference had the same single-writer shape — its master owned
+        checkpointing). Every worker still *restores*. Master-coordinated
+        SAVE_MODEL tasks (exclusive lease) may be served by any worker.
+        force=True also drains any in-flight async save, so a preemption
+        exit never abandons a half-written checkpoint."""
+        mngr = self._checkpoint_manager()
+        if mngr is None or self._state is None or self.worker_id != 0:
+            return
+        step = self._state.model_version
+        due = (
+            self.cfg.checkpoint_steps > 0
+            and step - self._last_ckpt_step >= self.cfg.checkpoint_steps
+        )
+        if (force and step > self._last_ckpt_step) or due:
+            mngr.save(self._state)
+            self._last_ckpt_step = step
+        if force:
+            mngr.wait()
 
     # ------------------------------------------------------------------ #
     # heartbeats
@@ -154,17 +204,31 @@ class Worker:
     def _run_training_task(self, task: pb.Task) -> Dict[str, float]:
         svc = self._data_service(pb.TRAINING)
         loss_sum, loss_count = 0.0, 0
+        interrupted = False
         for batch in svc.batches(task.shard_name, task.start, task.end):
+            if self._shutdown.is_set():
+                # preemption mid-task: abandon without reporting success —
+                # the master recovers the lease, so no records are lost
+                interrupted = True
+                break
             self._ensure_state(batch)
             self._state, logs = self._trainer.train_step(self._state, batch)
             loss_sum += float(logs["loss"])
             loss_count += 1
-        return {"loss_sum": loss_sum, "loss_count": loss_count}
+            self._maybe_checkpoint()
+        return {
+            "loss_sum": loss_sum,
+            "loss_count": loss_count,
+            "interrupted": interrupted,
+        }
 
-    def _run_evaluation_task(self, task: pb.Task) -> None:
+    def _run_evaluation_task(self, task: pb.Task) -> bool:
+        """Returns True if interrupted by shutdown/preemption (no report)."""
         svc = self._data_service(pb.EVALUATION)
         states = self._trainer.new_metric_states()
         for batch in svc.batches(task.shard_name, task.start, task.end):
+            if self._shutdown.is_set():
+                return True
             self._ensure_state(batch)
             states = self._trainer.eval_step(self._state, batch, states)
         import jax
@@ -178,11 +242,15 @@ class Worker:
             arr = np.asarray(jax.device_get(state), np.float32)
             msg.states.append(pb.MetricState(name=name, data=arr.tobytes()))
         self._stub.ReportEvaluationMetrics(msg, timeout=30)
+        return False
 
-    def _run_prediction_task(self, task: pb.Task) -> None:
+    def _run_prediction_task(self, task: pb.Task) -> bool:
+        """Returns True if interrupted by shutdown/preemption (no report)."""
         svc = self._data_service(pb.PREDICTION)
         processor = self._spec.prediction_outputs_processor
         for batch in svc.batches(task.shard_name, task.start, task.end):
+            if self._shutdown.is_set():
+                return True
             self._ensure_state(batch)
             outputs = self._trainer.predict_step(self._state, batch)
             if processor is not None:
@@ -192,6 +260,7 @@ class Worker:
                 processor.process(
                     np.asarray(jax.device_get(outputs))[valid], self.worker_id
                 )
+        return False
 
     # ------------------------------------------------------------------ #
 
@@ -227,12 +296,17 @@ class Worker:
             try:
                 if task.type == pb.TRAINING:
                     stats = self._run_training_task(task)
+                    if stats["interrupted"]:
+                        # leave the lease to the master's recovery path
+                        break
                     report.loss_sum = stats["loss_sum"]
                     report.loss_count = int(stats["loss_count"])
                 elif task.type == pb.EVALUATION:
-                    self._run_evaluation_task(task)
+                    if self._run_evaluation_task(task):
+                        break
                 elif task.type == pb.PREDICTION:
-                    self._run_prediction_task(task)
+                    if self._run_prediction_task(task):
+                        break
                 elif task.type == pb.SAVE_MODEL:
                     self._save_checkpoint()
                 report.records_processed = task.end - task.start
@@ -248,6 +322,15 @@ class Worker:
                 logger.warning("report failed for task %d: %s", task.task_id, e)
             tasks_done += 1
 
+        # Preemption-triggered save (reference: preemption checkpoints in
+        # the checkpoint service): SIGTERM'd workers persist progress so the
+        # relaunch resumes instead of retraining.
+        if self._preempted:
+            try:
+                self._maybe_checkpoint(force=True)
+            except Exception:
+                logger.exception("preemption checkpoint failed")
+
         # Orderly teardown: stop the heartbeat thread and close the channel
         # BEFORE interpreter exit — a grpc call in flight during shutdown
         # aborts the process from the C++ layer.
@@ -258,13 +341,20 @@ class Worker:
             self._channel.close()
         except Exception:
             pass
-        return 0
+        # A preempted worker exits non-zero (EX_TEMPFAIL) so the instance
+        # manager relaunches it and recovers its lease immediately; clean
+        # job-done exits return 0.
+        return 75 if self._preempted else 0
+
+    def preempt(self) -> None:
+        """SIGTERM hook: finish/abandon the current batch, checkpoint, exit."""
+        logger.info("preemption signal received; draining")
+        self._preempted = True
+        self._shutdown.set()
 
     def _save_checkpoint(self) -> None:
-        from elasticdl_tpu.training.checkpoint import CheckpointManager
-
-        if self._state is None or not self.cfg.checkpoint_dir:
+        mngr = self._checkpoint_manager()
+        if self._state is None or mngr is None:
             return
-        CheckpointManager(
-            self.cfg.checkpoint_dir, keep=self.cfg.keep_checkpoint_max
-        ).save(self._state)
+        mngr.save(self._state, wait=True)
+        self._last_ckpt_step = self._state.model_version
