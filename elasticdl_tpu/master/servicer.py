@@ -68,7 +68,12 @@ class MasterServicer:
 
     def ReportTaskResult(self, request, context):
         accepted = self._dispatcher.report(
-            request.task_id, request.worker_id, request.success, request.err_message
+            request.task_id,
+            request.worker_id,
+            request.success,
+            request.err_message,
+            preempted=request.preempted,
+            records_processed=request.records_processed,
         )
         if accepted and request.loss_count:
             # stale/duplicate reports must not skew the job's mean loss
@@ -77,7 +82,7 @@ class MasterServicer:
                 self._loss_count += request.loss_count
         if accepted and request.success and self._evaluation is not None:
             self._evaluation.maybe_trigger()
-        return pb.Empty()
+        return pb.ReportTaskResultResponse(accepted=accepted)
 
     def ReportEvaluationMetrics(self, request, context):
         if self._evaluation is not None:
@@ -99,6 +104,7 @@ class MasterServicer:
             num_workers=self._membership.alive_count(),
             should_checkpoint=should_ckpt,
             shutdown=self._shutdown or not known,
+            job_done=self._dispatcher.finished(),
         )
 
     def GetJobStatus(self, request, context):

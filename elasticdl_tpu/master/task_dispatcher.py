@@ -207,7 +207,13 @@ class TaskDispatcher:
             cb()
 
     def report(
-        self, task_id: int, worker_id: int, success: bool, err: str = ""
+        self,
+        task_id: int,
+        worker_id: int,
+        success: bool,
+        err: str = "",
+        preempted: bool = False,
+        records_processed: int = 0,
     ) -> bool:
         """Returns False for an unknown/stale lease (e.g. the task was
         already recovered from this worker and completed elsewhere)."""
@@ -224,6 +230,22 @@ class TaskDispatcher:
                 if task.type == pb.TRAINING:
                     self._finished_training += 1
                     self._completed_versions += 1
+            elif preempted:
+                # Drain report: the first `records_processed` records were
+                # applied (and are covered by the worker's preemption
+                # checkpoint); requeue only the remainder, retry-free.
+                done = max(0, min(records_processed, task.end - task.start))
+                if task.start + done >= task.end:
+                    if task.type == pb.TRAINING:
+                        self._finished_training += 1
+                        self._completed_versions += 1
+                else:
+                    task.start += done
+                    self._todo.appendleft(task)
+                    logger.info(
+                        "task %d preempted after %d records; requeued remainder "
+                        "[%d, %d)", task_id, done, task.start, task.end,
+                    )
             else:
                 task.retries += 1
                 if task.retries <= self._max_task_retries:

@@ -22,7 +22,7 @@ SERVICE_NAME = "elasticdl_tpu.Master"
 _RPCS = {
     "RegisterWorker": (pb.RegisterWorkerRequest, pb.RegisterWorkerResponse),
     "GetTask": (pb.GetTaskRequest, pb.GetTaskResponse),
-    "ReportTaskResult": (pb.ReportTaskResultRequest, pb.Empty),
+    "ReportTaskResult": (pb.ReportTaskResultRequest, pb.ReportTaskResultResponse),
     "ReportEvaluationMetrics": (
         pb.ReportEvaluationMetricsRequest,
         pb.ReportEvaluationMetricsResponse,

@@ -59,5 +59,14 @@ class CheckpointManager:
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 
+    def delete(self, step: int) -> None:
+        """Discard a saved step (drain checkpoints whose retirement report
+        the master rejected must not be restored)."""
+        try:
+            self._mngr.delete(step)
+            logger.info("deleted checkpoint step %d", step)
+        except Exception:
+            logger.exception("failed to delete checkpoint step %d", step)
+
     def close(self) -> None:
         self._mngr.close()

@@ -38,6 +38,10 @@ class ModelSpec:
     callbacks: List[Any] = field(default_factory=list)
     prediction_outputs_processor: Optional[Any] = None
     module_name: str = ""
+    # The params the model was ACTUALLY built with (cfg.model_params plus
+    # injected defaults like compute_dtype) — export must record these, or a
+    # serving reload could rebuild the module with different defaults.
+    model_params: Dict[str, Any] = field(default_factory=dict)
 
     @classmethod
     def from_config(cls, cfg: JobConfig) -> "ModelSpec":
@@ -78,4 +82,5 @@ class ModelSpec:
             callbacks=list(callbacks_fn()) if callbacks_fn else [],
             prediction_outputs_processor=pop_fn() if pop_fn else None,
             module_name=module.__name__,
+            model_params=model_params,
         )

@@ -1,0 +1,64 @@
+"""Prediction-output hooks for prediction jobs.
+
+Reference parity: elasticdl/python/worker/prediction_outputs_processor.py —
+`BasePredictionOutputsProcessor.process(predictions, worker_id)` is invoked by
+the worker with each minibatch of prediction outputs. Users subclass it in
+their model-zoo module and expose it via a module-level
+`prediction_outputs_processor()` factory (see ModelSpec.from_config).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, List
+
+import numpy as np
+
+
+class BasePredictionOutputsProcessor:
+    """Subclass and override `process`. The default is a no-op."""
+
+    def process(self, predictions: Any, worker_id: int) -> None:
+        """Called once per prediction minibatch with host-numpy outputs
+        (padding rows already removed)."""
+
+    def close(self) -> None:
+        """Called once when the worker finishes its prediction tasks."""
+
+
+class InMemoryPredictionOutputsProcessor(BasePredictionOutputsProcessor):
+    """Accumulates all outputs in memory — tests and small jobs."""
+
+    def __init__(self) -> None:
+        self.outputs: List[np.ndarray] = []
+
+    def process(self, predictions: Any, worker_id: int) -> None:
+        self.outputs.append(np.asarray(predictions))
+
+    def result(self) -> np.ndarray:
+        return (
+            np.concatenate(self.outputs, axis=0)
+            if self.outputs
+            else np.empty((0,), np.float32)
+        )
+
+
+class NpyPredictionOutputsProcessor(BasePredictionOutputsProcessor):
+    """Streams outputs to `<out_dir>/predictions_worker<id>_p<pid>_<n>.npy`,
+    one file per minibatch — per-worker files never contend (the reference's
+    processors wrote per-worker ODPS partitions for the same reason). The pid
+    component keeps a relaunched worker (same worker_id, fresh counter) from
+    overwriting files its previous incarnation already wrote."""
+
+    def __init__(self, out_dir: str) -> None:
+        self.out_dir = os.path.abspath(out_dir)
+        os.makedirs(self.out_dir, exist_ok=True)
+        self._n = 0
+
+    def process(self, predictions: Any, worker_id: int) -> None:
+        path = os.path.join(
+            self.out_dir,
+            f"predictions_worker{worker_id}_p{os.getpid()}_{self._n:06d}.npy",
+        )
+        np.save(path, np.asarray(predictions))
+        self._n += 1

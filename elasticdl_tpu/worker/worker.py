@@ -47,6 +47,8 @@ class Worker:
         self._ckpt_manager = None
         self._last_ckpt_step = 0
         self._preempted = False
+        self._job_done = False
+        self._mid_training_task = False
 
     # ------------------------------------------------------------------ #
     # setup
@@ -143,7 +145,7 @@ class Worker:
 
     def _maybe_checkpoint(self, force: bool = False) -> None:
         """Step-interval checkpointing (reference: --checkpoint_steps), plus
-        forced saves on preemption.
+        forced saves on preemption — both taken only at task boundaries.
 
         Only worker 0 writes interval/preemption checkpoints: concurrent
         orbax managers over one directory race on saves and max_to_keep GC
@@ -154,6 +156,15 @@ class Worker:
         exit never abandons a half-written checkpoint."""
         mngr = self._checkpoint_manager()
         if mngr is None or self._state is None or self.worker_id != 0:
+            return
+        if self._mid_training_task:
+            # Never persist mid-task state: the task's lease is only released
+            # on report, so a mid-task save + relaunch would re-apply the
+            # task's records on top of updates that already include them
+            # (double-counting). Saves happen only at task boundaries, where
+            # state and the task queue agree exactly-once.
+            if force:
+                mngr.wait()
             return
         step = self._state.model_version
         due = (
@@ -181,6 +192,10 @@ class Worker:
                 )
                 if resp.shutdown:
                     logger.info("master requested shutdown")
+                    # job_done distinguishes normal completion (export the
+                    # final model) from aborts/evictions (don't)
+                    if resp.job_done:
+                        self._job_done = True
                     self._shutdown.set()
                     break
                 if resp.membership_version != self._membership_version:
@@ -204,23 +219,86 @@ class Worker:
     def _run_training_task(self, task: pb.Task) -> Dict[str, float]:
         svc = self._data_service(pb.TRAINING)
         loss_sum, loss_count = 0.0, 0
+        records_done = 0
         interrupted = False
+        self._mid_training_task = True
         for batch in svc.batches(task.shard_name, task.start, task.end):
             if self._shutdown.is_set():
-                # preemption mid-task: abandon without reporting success —
-                # the master recovers the lease, so no records are lost
+                # preemption mid-task: stop before the next batch; the drain
+                # report below hands the unprocessed remainder back
                 interrupted = True
                 break
             self._ensure_state(batch)
             self._state, logs = self._trainer.train_step(self._state, batch)
             loss_sum += float(logs["loss"])
             loss_count += 1
-            self._maybe_checkpoint()
+            # mask sums the real (non-padding) records this batch applied
+            records_done += int(batch["mask"].sum())
         return {
             "loss_sum": loss_sum,
             "loss_count": loss_count,
+            "records_done": records_done,
             "interrupted": interrupted,
         }
+
+    def _report_preempted_task(self, task: pb.Task, stats: Dict[str, float]) -> None:
+        """Drain protocol for an interrupted training task. Records may only
+        be retired from the master's queue when a checkpoint covering them is
+        durably on disk, and a drain checkpoint may only survive when its
+        retirement report was accepted — otherwise either path loses or
+        double-applies records:
+
+          1. save the mid-task state (wait for durability); workers that
+             don't checkpoint (worker_id != 0, no checkpoint_dir, failed
+             save) report records_processed=0 → the FULL task is requeued,
+             retry-free, and nothing is lost;
+          2. report the applied-record count;
+          3. if the master rejects the report (stale lease — e.g. the task
+             timed out and was already requeued whole) or the report can't be
+             delivered, delete the just-saved drain checkpoint so a relaunch
+             restores the last task-boundary state instead.
+
+        Residual window (documented at-least-once, same as the reference's
+        PS mode where pushed gradients survived a task re-run): the process
+        dying between (1) and (3) leaves a drain checkpoint whose task is
+        re-leased in full.
+        """
+        mngr = self._checkpoint_manager()
+        records_done = int(stats["records_done"])
+        drain_step = None
+        if records_done > 0 and mngr is not None and self.worker_id == 0:
+            try:
+                drain_step = mngr.save(self._state, wait=True)
+            except Exception:
+                logger.exception("drain checkpoint failed; requeueing full task")
+                drain_step = None
+        if drain_step is None:
+            records_done = 0
+        try:
+            resp = self._stub.ReportTaskResult(
+                pb.ReportTaskResultRequest(
+                    worker_id=self.worker_id,
+                    task_id=task.task_id,
+                    success=False,
+                    preempted=True,
+                    err_message="preempted",
+                    records_processed=records_done,
+                    loss_sum=stats["loss_sum"],
+                    loss_count=int(stats["loss_count"]),
+                ),
+                timeout=10,
+            )
+            accepted = resp.accepted
+        except Exception as e:
+            logger.warning("preemption drain report failed: %s", e)
+            accepted = False
+        if accepted:
+            self._mid_training_task = False
+            if drain_step is not None:
+                self._last_ckpt_step = drain_step
+        elif drain_step is not None:
+            # the full task will re-run; this checkpoint would double-apply
+            mngr.delete(drain_step)
 
     def _run_evaluation_task(self, task: pb.Task) -> bool:
         """Returns True if interrupted by shutdown/preemption (no report)."""
@@ -284,6 +362,7 @@ class Worker:
                 continue
             if resp.job_done:
                 logger.info("job done after %d tasks", tasks_done)
+                self._job_done = True
                 break
             task = resp.task
             if task.type == pb.WAIT:
@@ -297,7 +376,7 @@ class Worker:
                 if task.type == pb.TRAINING:
                     stats = self._run_training_task(task)
                     if stats["interrupted"]:
-                        # leave the lease to the master's recovery path
+                        self._report_preempted_task(task, stats)
                         break
                     report.loss_sum = stats["loss_sum"]
                     report.loss_count = int(stats["loss_count"])
@@ -318,6 +397,10 @@ class Worker:
                 report.err_message = str(e)[:512]
             try:
                 self._stub.ReportTaskResult(report, timeout=30)
+                if task.type == pb.TRAINING and report.success:
+                    # state and task queue agree here: safe checkpoint point
+                    self._mid_training_task = False
+                    self._maybe_checkpoint()
             except Exception as e:
                 logger.warning("report failed for task %d: %s", task.task_id, e)
             tasks_done += 1
@@ -330,6 +413,19 @@ class Worker:
                 self._maybe_checkpoint(force=True)
             except Exception:
                 logger.exception("preemption checkpoint failed")
+
+        # Export runs here, not in the GetTask branch: a worker may learn the
+        # job finished from the heartbeat shutdown flag (another worker took
+        # the last task) without ever seeing a job_done GetTask response.
+        if self._job_done and not self._preempted:
+            self._export_final_model()
+
+        processor = self._spec.prediction_outputs_processor if self._spec else None
+        if processor is not None:
+            try:
+                processor.close()
+            except Exception:
+                logger.exception("prediction outputs processor close failed")
 
         # Orderly teardown: stop the heartbeat thread and close the channel
         # BEFORE interpreter exit — a grpc call in flight during shutdown
@@ -345,6 +441,25 @@ class Worker:
         # manager relaunches it and recovers its lease immediately; clean
         # job-done exits return 0.
         return 75 if self._preempted else 0
+
+    def _export_final_model(self) -> None:
+        """Job-end serving export (reference: model_handler → SavedModel at
+        job completion). Worker 0 writes `--output`; sharded tables gather
+        through device_get inside export_model."""
+        if not self.cfg.output or self.worker_id != 0 or self._state is None:
+            return
+        try:
+            from elasticdl_tpu.training.export import export_model
+
+            export_model(
+                self._state,
+                self.cfg.output,
+                model_def=self.cfg.model_def,
+                model_params=self._spec.model_params,
+                module_name=self._spec.module_name,
+            )
+        except Exception:
+            logger.exception("final model export failed")
 
     def preempt(self) -> None:
         """SIGTERM hook: finish/abandon the current batch, checkpoint, exit."""

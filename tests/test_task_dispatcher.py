@@ -131,3 +131,34 @@ def test_job_end_callback():
     while (t := d.get(0)) is not None:
         d.report(t.task_id, 0, True)
     assert fired == [1]
+
+
+def test_preempted_partial_report_requeues_remainder():
+    """Drain reports split the lease: applied records are retired, the
+    remainder is requeued with no retry charged (exactly-once across a
+    preemption checkpoint)."""
+    d = make(num_records=20, rpt=10)
+    t = d.get(0)
+    assert (t.start, t.end) == (0, 10)
+    assert d.report(t.task_id, 0, False, preempted=True, records_processed=4)
+    # remainder comes back first (appendleft), covering exactly [4, 10)
+    t2 = d.get(1)
+    assert (t2.task_id, t2.start, t2.end) == (t.task_id, 4, 10)
+    assert t2.retries == 0
+    assert d.report(t2.task_id, 1, True)
+    t3 = d.get(1)
+    assert (t3.start, t3.end) == (0, 10) and t3.shard_name != t.shard_name
+    assert d.report(t3.task_id, 1, True)
+    assert d.finished()
+    assert d.counts()["finished_training"] == 2
+
+
+def test_preempted_report_with_all_records_done_counts_finished():
+    d = make(num_records=10, rpt=5)
+    while (t := d.get(0)) is not None:
+        # preempted exactly at the task's end: no remainder, counts finished
+        assert d.report(
+            t.task_id, 0, False, preempted=True, records_processed=t.end - t.start
+        )
+    assert d.finished()
+    assert d.counts()["finished_training"] == 2
