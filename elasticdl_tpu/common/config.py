@@ -98,6 +98,10 @@ class JobConfig:
     checkpoint_steps: int = 0
     keep_checkpoint_max: int = 3
     output: str = ""               # final model export dir
+    summary_dir: str = ""          # JSONL + TensorBoard summaries (master-side)
+    # Elastic linear LR scaling: on membership change, scale the (injected)
+    # learning rate by alive_workers/num_workers (see training/lr_modulation)
+    scale_lr_with_workers: bool = False
 
     # --- cluster shape / elasticity ---
     num_workers: int = 1

@@ -87,8 +87,16 @@ class Master:
             if eval_shards
             else None
         )
+        self.summary = None
+        if cfg.summary_dir:
+            from elasticdl_tpu.master.summary_service import SummaryService
+
+            self.summary = SummaryService(cfg.summary_dir)
+            if self.evaluation is not None:
+                self.evaluation.add_result_callback(self.summary.on_eval_results)
         self.servicer = MasterServicer(
-            self.dispatcher, self.membership, self.evaluation
+            self.dispatcher, self.membership, self.evaluation,
+            summary_service=self.summary,
         )
         self.server = make_server()
         add_master_servicer(self.server, self.servicer)
@@ -133,6 +141,8 @@ class Master:
             "job finished: %s mean_loss=%s eval=%s",
             counts, f"{mean_loss:.4f}" if mean_loss is not None else "n/a", results,
         )
+        if self.summary is not None:
+            self.summary.close()
         # give workers a heartbeat cycle to see the shutdown flag
         time.sleep(min(grace_s, self.cfg.worker_heartbeat_s))
         self.server.stop(grace_s)

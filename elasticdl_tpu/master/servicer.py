@@ -30,10 +30,12 @@ class MasterServicer:
         membership: Membership,
         evaluation_service: Optional[EvaluationService] = None,
         wait_backoff_s: float = 2.0,
+        summary_service=None,
     ):
         self._dispatcher = dispatcher
         self._membership = membership
         self._evaluation = evaluation_service
+        self._summary = summary_service
         self._wait_backoff_s = wait_backoff_s
         self._loss_lock = threading.Lock()
         self._loss_sum = 0.0
@@ -80,6 +82,10 @@ class MasterServicer:
             with self._loss_lock:
                 self._loss_sum += request.loss_sum
                 self._loss_count += request.loss_count
+            if self._summary is not None:
+                self._summary.on_task_report(
+                    request.model_version, request.loss_sum, request.loss_count
+                )
         if accepted and request.success and self._evaluation is not None:
             self._evaluation.maybe_trigger()
         return pb.ReportTaskResultResponse(accepted=accepted)

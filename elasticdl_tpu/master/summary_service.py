@@ -1,0 +1,83 @@
+"""Training summaries: JSONL event log + optional TensorBoard files.
+
+Reference parity: elasticdl/python/master/tensorboard_service.py — the master
+optionally wrote TF summaries of training loss and evaluation metrics. Here
+the master always writes a machine-readable `events.jsonl` (one JSON object
+per line: {"step", "wall_time", <scalars>}) under <summary_dir>/<role>/ and,
+when TensorFlow is importable, mirrors the scalars into TensorBoard event
+files so `tensorboard --logdir` works exactly as it did for the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+
+class SummaryWriter:
+    """One scalar stream (e.g. 'train' or 'eval')."""
+
+    def __init__(self, directory: str):
+        os.makedirs(directory, exist_ok=True)
+        self._jsonl = open(os.path.join(directory, "events.jsonl"), "a")
+        self._lock = threading.Lock()
+        self._tf_writer = None
+        try:
+            import tensorflow as tf
+
+            self._tf_writer = tf.summary.create_file_writer(directory)
+        except Exception:
+            # TF-less deployments still get the JSONL stream
+            self._tf_writer = None
+
+    def scalars(self, step: int, values: Dict[str, float]) -> None:
+        rec = {"step": int(step), "wall_time": time.time()}
+        rec.update({k: float(v) for k, v in values.items()})
+        with self._lock:
+            self._jsonl.write(json.dumps(rec) + "\n")
+            self._jsonl.flush()
+            if self._tf_writer is not None:
+                import tensorflow as tf
+
+                with self._tf_writer.as_default():
+                    for name, value in values.items():
+                        tf.summary.scalar(name, float(value), step=int(step))
+                self._tf_writer.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            self._jsonl.close()
+            if self._tf_writer is not None:
+                self._tf_writer.close()
+
+
+class SummaryService:
+    """Master-side aggregation point: training loss per task report, eval
+    metrics per finished eval job."""
+
+    def __init__(self, summary_dir: str):
+        self._dir = os.path.abspath(summary_dir)
+        self._train = SummaryWriter(os.path.join(self._dir, "train"))
+        self._eval: Optional[SummaryWriter] = None
+
+    def on_task_report(self, model_version: int, loss_sum: float, loss_count: int
+                       ) -> None:
+        if loss_count > 0:
+            self._train.scalars(model_version, {"loss": loss_sum / loss_count})
+
+    def on_eval_results(self, model_version: int, results: Dict[str, float]) -> None:
+        if self._eval is None:
+            self._eval = SummaryWriter(os.path.join(self._dir, "eval"))
+        self._eval.scalars(model_version, results)
+
+    def close(self) -> None:
+        self._train.close()
+        if self._eval is not None:
+            self._eval.close()

@@ -1,0 +1,117 @@
+"""Runtime learning-rate modulation without recompilation.
+
+Reference parity: elasticdl/python/master/learning_rate_modulation.py — the
+reference scaled the learning rate per gradient push (staleness-aware LR for
+its async PS mode). The rebuild is synchronous, but runtime LR control is
+still needed for elasticity: when the worker set grows or shrinks, the
+effective global batch changes and the LR should scale with it (linear
+scaling rule), without retracing the jitted train step.
+
+Mechanism: `optax.inject_hyperparams` lifts the optimizer's hyperparameters
+(learning_rate, ...) out of the traced closure and into the optimizer STATE,
+which is a step input — so mutating the state between steps changes the LR
+with zero recompilation. Zoo modules opt in by building their optimizer
+through `modulated(...)`:
+
+    def optimizer(**kw):
+        return lr_modulation.modulated(optax.adam, learning_rate=1e-3)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def modulated(tx_factory: Callable[..., optax.GradientTransformation],
+              **hyperparams) -> optax.GradientTransformation:
+    """Build `tx_factory(**hyperparams)` with hyperparams lifted into the
+    optimizer state (mutable between steps via set_hyperparam)."""
+    return optax.inject_hyperparams(tx_factory)(**hyperparams)
+
+
+def _hyperparam_leaves(opt_state: Any):
+    """Yield every InjectStatefulHyperparamsState-like node's hyperparams
+    dict in the (possibly nested/chained) optax state tree."""
+    nodes = [opt_state]
+    while nodes:
+        node = nodes.pop()
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict):
+            yield node
+        if isinstance(node, tuple):
+            nodes.extend(node)
+        else:
+            inner = getattr(node, "inner_state", None)
+            if inner is not None:
+                nodes.append(inner)
+
+
+def set_hyperparam(opt_state: Any, name: str, value) -> Any:
+    """Return a copy of opt_state with hyperparam `name` set to `value` in
+    every injected node that carries it. Raises if none does."""
+    found = False
+    nodes = list(_hyperparam_leaves(opt_state))
+    for node in nodes:
+        if name in node.hyperparams:
+            found = True
+    if not found:
+        raise KeyError(
+            f"no injected hyperparam {name!r}; build the optimizer with "
+            f"lr_modulation.modulated(...)"
+        )
+
+    def replace(node):
+        if name in node.hyperparams:
+            old = node.hyperparams[name]
+            new_hp = dict(node.hyperparams)
+            new_hp[name] = jnp.asarray(value, jnp.asarray(old).dtype)
+            return node._replace(hyperparams=new_hp)
+        return node
+
+    def walk(node):
+        hp = getattr(node, "hyperparams", None)
+        if isinstance(hp, dict):
+            node = replace(node)
+        inner = getattr(node, "inner_state", None)
+        if inner is not None:
+            return node._replace(inner_state=walk(inner))
+        if isinstance(node, tuple) and not hasattr(node, "hyperparams"):
+            return type(node)(*(walk(c) for c in node)) if hasattr(
+                node, "_fields"
+            ) else tuple(walk(c) for c in node)
+        return node
+
+    return walk(opt_state)
+
+
+def get_hyperparam(opt_state: Any, name: str) -> Optional[float]:
+    for node in _hyperparam_leaves(opt_state):
+        if name in node.hyperparams:
+            return float(jax.device_get(node.hyperparams[name]))
+    return None
+
+
+def set_learning_rate(opt_state: Any, lr: float) -> Any:
+    return set_hyperparam(opt_state, "learning_rate", lr)
+
+
+def get_learning_rate(opt_state: Any) -> Optional[float]:
+    return get_hyperparam(opt_state, "learning_rate")
+
+
+def linear_scale(base_lr: float, alive_workers: int, base_workers: int) -> float:
+    """Linear-scaling rule for elastic membership changes (the sync-DP analog
+    of the reference's staleness modulation): LR tracks the live worker
+    count, i.e. the effective global batch size."""
+    return base_lr * max(1, alive_workers) / max(1, base_workers)
+
+
+def staleness_modulation(base_lr: float, staleness: int, factor: float = 1.0
+                         ) -> float:
+    """The reference's async-PS formula kept for parity: damp the LR for a
+    gradient computed `staleness` versions behind."""
+    return base_lr / (1.0 + factor * max(0, staleness))

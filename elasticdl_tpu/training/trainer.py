@@ -253,6 +253,15 @@ class Trainer:
         with jax.set_mesh(self.mesh):
             return self._train_step(state, batch)
 
+    def set_learning_rate(self, state: TrainState, lr: float) -> TrainState:
+        """Runtime LR change with no retrace — requires the zoo optimizer to
+        be built via lr_modulation.modulated (injected hyperparams)."""
+        from elasticdl_tpu.training import lr_modulation
+
+        return state.replace(
+            opt_state=lr_modulation.set_learning_rate(state.opt_state, lr)
+        )
+
     def new_metric_states(self) -> Dict[str, np.ndarray]:
         states = metrics_lib.init_states(self.metrics)
         states["_loss"] = np.zeros((2,), np.float32)

@@ -49,6 +49,8 @@ class Worker:
         self._preempted = False
         self._job_done = False
         self._mid_training_task = False
+        self._base_lr = None          # injected LR at init (elastic scaling)
+        self._pending_lr = None       # set by heartbeat thread, applied by run loop
 
     # ------------------------------------------------------------------ #
     # setup
@@ -130,6 +132,19 @@ class Worker:
         if self._state is not None:
             return
         self._state = self._trainer.init_state(example_batch)
+        if self.cfg.scale_lr_with_workers and self._base_lr is None:
+            from elasticdl_tpu.training.lr_modulation import get_learning_rate
+
+            # Read the CONFIGURED base LR from the freshly-initialized state,
+            # before checkpoint restore — a restored opt_state may already
+            # carry an elastically scaled LR, and re-basing on it would
+            # compound the scaling across relaunches.
+            self._base_lr = get_learning_rate(self._state.opt_state)
+            if self._base_lr is None:
+                logger.warning(
+                    "scale_lr_with_workers needs an optimizer built via "
+                    "lr_modulation.modulated(...); LR scaling disabled"
+                )
         # Elastic recovery: a relaunched worker resumes from the latest
         # checkpoint instead of fresh params (reference analog: rank-0
         # Horovod broadcast after re-rendezvous restoring replicated state).
@@ -199,12 +214,14 @@ class Worker:
                     self._shutdown.set()
                     break
                 if resp.membership_version != self._membership_version:
-                    self._on_membership_change(resp.membership_version)
+                    self._on_membership_change(
+                        resp.membership_version, resp.num_workers
+                    )
             except Exception as e:  # master gone → stop
                 logger.warning("heartbeat failed: %s", e)
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
-    def _on_membership_change(self, new_version: int) -> None:
+    def _on_membership_change(self, new_version: int, num_workers: int = 0) -> None:
         """Elastic hook: the worker set changed. Single-host mesh keeps
         running; the multi-host path re-forms the jax.distributed mesh here
         (see parallel/elastic.py)."""
@@ -212,6 +229,14 @@ class Worker:
             "membership v%d -> v%d", self._membership_version, new_version
         )
         self._membership_version = new_version
+        if self.cfg.scale_lr_with_workers and self._base_lr and num_workers:
+            from elasticdl_tpu.training.lr_modulation import linear_scale
+
+            # applied by the run loop at the next task boundary (the
+            # heartbeat thread must not swap state mid-train-step)
+            self._pending_lr = linear_scale(
+                self._base_lr, num_workers, self.cfg.num_workers
+            )
 
     # ------------------------------------------------------------------ #
     # task execution
@@ -264,7 +289,8 @@ class Worker:
         re-leased in full.
         """
         mngr = self._checkpoint_manager()
-        records_done = int(stats["records_done"])
+        records_applied = int(stats["records_done"])
+        records_done = records_applied
         drain_step = None
         if records_done > 0 and mngr is not None and self.worker_id == 0:
             try:
@@ -274,6 +300,7 @@ class Worker:
                 drain_step = None
         if drain_step is None:
             records_done = 0
+        delivered = False
         try:
             resp = self._stub.ReportTaskResult(
                 pb.ReportTaskResultRequest(
@@ -285,19 +312,35 @@ class Worker:
                     records_processed=records_done,
                     loss_sum=stats["loss_sum"],
                     loss_count=int(stats["loss_count"]),
+                    model_version=(
+                        self._state.model_version if self._state is not None else 0
+                    ),
                 ),
                 timeout=10,
             )
             accepted = resp.accepted
+            delivered = True
         except Exception as e:
-            logger.warning("preemption drain report failed: %s", e)
+            logger.warning("preemption drain report failed to deliver: %s", e)
             accepted = False
         if accepted:
-            self._mid_training_task = False
+            # Clear the mid-task flag only when the persisted state and the
+            # task queue actually agree: either the drain checkpoint covers
+            # the applied records, or no records were applied at all. When
+            # the save failed (full task requeued), the live state still
+            # holds the requeued task's records and must NOT be persisted by
+            # the post-loop forced save.
+            if drain_step is not None or records_applied == 0:
+                self._mid_training_task = False
             if drain_step is not None:
                 self._last_ckpt_step = drain_step
-        elif drain_step is not None:
-            # the full task will re-run; this checkpoint would double-apply
+        elif drain_step is not None and delivered:
+            # Explicit rejection (stale lease): the full task will re-run, so
+            # this checkpoint would double-apply — discard it. A DELIVERY
+            # failure is ambiguous (the master may have retired the records):
+            # keep the checkpoint then, since losing retired records is worse
+            # than the bounded double-apply of an undelivered report
+            # (at-least-once, like the reference's PS mode).
             mngr.delete(drain_step)
 
     def _run_evaluation_task(self, task: pb.Task) -> bool:
@@ -365,6 +408,12 @@ class Worker:
                 self._job_done = True
                 break
             task = resp.task
+            if self._pending_lr is not None and self._state is not None:
+                self._state = self._trainer.set_learning_rate(
+                    self._state, self._pending_lr
+                )
+                logger.info("elastic LR scaled to %.6g", self._pending_lr)
+                self._pending_lr = None
             if task.type == pb.WAIT:
                 time.sleep(resp.backoff_seconds or 1.0)
                 continue

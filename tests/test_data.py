@@ -82,3 +82,44 @@ def test_task_data_service_dict_features():
     b = next(iter(svc.batches("s", 0, 20)))
     assert b["features"]["dense"].shape == (8, 13)
     assert b["features"]["cat"].shape == (8, 26)
+
+
+def test_csv_reader_header_and_columns(tmp_path):
+    from elasticdl_tpu.data.reader import CSVDataReader
+
+    f = tmp_path / "census.csv"
+    f.write_text("age,workclass,label\n39,Private,0\n50,Self-emp,1\n")
+    r = CSVDataReader(str(f))
+    assert r.metadata["columns"] == ["age", "workclass", "label"]
+    shards = r.create_shards()
+    assert shards == [(str(f), 0, 2)]
+    rows = list(r.read_records(str(f), 0, 2))
+    assert rows == [b"39,Private,0", b"50,Self-emp,1"]
+    # factory route
+    r2 = create_data_reader(str(f), "csv")
+    assert r2.metadata["columns"] == ["age", "workclass", "label"]
+
+
+def test_csv_reader_explicit_columns_and_delimiter(tmp_path):
+    from elasticdl_tpu.data.reader import CSVDataReader
+
+    f = tmp_path / "t.tsv"
+    f.write_text("h1\th2\n1\t2\n")
+    r = CSVDataReader(str(f), delimiter="\t", columns=["a", "b"])
+    assert r.metadata["columns"] == ["a", "b"]
+    assert list(r.read_records(str(f), 0, 1)) == [b"1\t2"]
+
+
+def test_odps_reader_requires_pyodps():
+    import pytest
+    from elasticdl_tpu.data.reader import ODPSDataReader
+
+    try:
+        import odps  # noqa: F401
+        pytest.skip("pyodps installed; gating not exercised")
+    except ImportError:
+        pass
+    with pytest.raises(ImportError, match="pyodps"):
+        ODPSDataReader("some_table")
+    with pytest.raises(ImportError, match="pyodps"):
+        create_data_reader("odps://some_table#pt=20200101")

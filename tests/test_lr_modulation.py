@@ -1,0 +1,88 @@
+"""Runtime LR modulation (reference: learning_rate_modulation.py) — injected
+hyperparams change between steps with no retrace, through plain and chained
+optimizers, and through the Trainer state."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from elasticdl_tpu.training import lr_modulation as lrm
+
+
+def test_set_get_learning_rate_plain():
+    tx = lrm.modulated(optax.sgd, learning_rate=0.1)
+    params = {"w": jnp.ones((3,))}
+    state = tx.init(params)
+    assert lrm.get_learning_rate(state) == pytest.approx(0.1)
+
+    grads = {"w": jnp.ones((3,))}
+    updates, state = tx.update(grads, state, params)
+    np.testing.assert_allclose(updates["w"], -0.1 * np.ones(3), rtol=1e-6)
+
+    state = lrm.set_learning_rate(state, 0.5)
+    assert lrm.get_learning_rate(state) == pytest.approx(0.5)
+    updates, state = tx.update(grads, state, params)
+    np.testing.assert_allclose(updates["w"], -0.5 * np.ones(3), rtol=1e-6)
+
+
+def test_set_learning_rate_inside_chain():
+    tx = optax.chain(
+        optax.clip_by_global_norm(10.0),
+        lrm.modulated(optax.adam, learning_rate=1e-3),
+    )
+    params = {"w": jnp.ones((2,))}
+    state = tx.init(params)
+    assert lrm.get_learning_rate(state) == pytest.approx(1e-3)
+    state = lrm.set_learning_rate(state, 1e-2)
+    assert lrm.get_learning_rate(state) == pytest.approx(1e-2)
+    # still usable after the rewrite
+    updates, _ = tx.update({"w": jnp.ones((2,))}, state, params)
+    assert np.all(np.isfinite(updates["w"]))
+
+
+def test_uninjected_optimizer_raises():
+    tx = optax.adam(1e-3)
+    state = tx.init({"w": jnp.ones(2)})
+    assert lrm.get_learning_rate(state) is None
+    with pytest.raises(KeyError, match="modulated"):
+        lrm.set_learning_rate(state, 0.1)
+
+
+def test_trainer_set_learning_rate(mesh8):
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    cfg = JobConfig(
+        model_zoo="model_zoo",
+        model_def="deepfm.deepfm.custom_model",
+        model_params={"field_vocab": 64, "hidden": "16,16"},
+    )
+    spec = ModelSpec.from_config(cfg)
+    spec.optimizer = lrm.modulated(optax.adam, learning_rate=1e-3)
+    trainer = Trainer(spec, mesh8)
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "dense": rng.rand(16, 13).astype(np.float32),
+            "cat": rng.randint(0, 1 << 30, size=(16, 26)).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, size=(16,)).astype(np.int32),
+        "mask": np.ones((16,), np.float32),
+    }
+    state = trainer.init_state(batch)
+    state, _ = trainer.train_step(state, batch)
+    state = trainer.set_learning_rate(state, 5e-3)
+    assert lrm.get_learning_rate(state.opt_state) == pytest.approx(5e-3)
+    # the jitted step keeps running with the same trace
+    state, logs = trainer.train_step(state, batch)
+    assert np.isfinite(float(logs["loss"]))
+    assert state.model_version == 2
+
+
+def test_scaling_formulas():
+    assert lrm.linear_scale(0.1, 8, 4) == pytest.approx(0.2)
+    assert lrm.linear_scale(0.1, 2, 4) == pytest.approx(0.05)
+    assert lrm.staleness_modulation(0.1, 0) == pytest.approx(0.1)
+    assert lrm.staleness_modulation(0.1, 3, factor=1.0) == pytest.approx(0.025)

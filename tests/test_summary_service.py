@@ -1,0 +1,36 @@
+"""Summary service (reference: tensorboard_service.py) — JSONL event stream
+always, TensorBoard event files when TF is importable."""
+
+import glob
+import json
+import os
+
+from elasticdl_tpu.master.summary_service import SummaryService
+
+
+def test_summary_service_writes_train_and_eval(tmp_path):
+    svc = SummaryService(str(tmp_path))
+    svc.on_task_report(model_version=10, loss_sum=6.0, loss_count=3)
+    svc.on_task_report(model_version=20, loss_sum=2.0, loss_count=2)
+    svc.on_task_report(model_version=30, loss_sum=0.0, loss_count=0)  # no-op
+    svc.on_eval_results(20, {"auc": 0.8, "accuracy": 0.7})
+    svc.close()
+
+    train = [
+        json.loads(l)
+        for l in open(tmp_path / "train" / "events.jsonl").read().splitlines()
+    ]
+    assert [(r["step"], r["loss"]) for r in train] == [(10, 2.0), (20, 1.0)]
+    ev = [
+        json.loads(l)
+        for l in open(tmp_path / "eval" / "events.jsonl").read().splitlines()
+    ]
+    assert ev[0]["step"] == 20 and ev[0]["auc"] == 0.8
+
+    try:
+        import tensorflow  # noqa: F401
+    except ImportError:
+        return
+    # TB event files mirror the scalars
+    assert glob.glob(str(tmp_path / "train" / "events.out.tfevents.*"))
+    assert glob.glob(str(tmp_path / "eval" / "events.out.tfevents.*"))
