@@ -102,6 +102,11 @@ class JobConfig:
     # Elastic linear LR scaling: on membership change, scale the (injected)
     # learning rate by alive_workers/num_workers (see training/lr_modulation)
     scale_lr_with_workers: bool = False
+    # Async host->device batch prefetch depth (0 disables; see data/prefetch)
+    prefetch_batches: int = 2
+    # Wire dtype for float batch features ("" = native, "bfloat16" halves
+    # transfer bytes; lossless for bf16-compute models — see data/prefetch)
+    wire_dtype: str = "" 
 
     # --- cluster shape / elasticity ---
     num_workers: int = 1

@@ -1,0 +1,88 @@
+"""Async host→device batch prefetching with optional wire compression.
+
+Reference parity: the reference's input path was `tf.data` with internal
+prefetching; the rebuild's TaskDataService yields host numpy batches, and on
+TPU a synchronous `device_put` per step serializes the host→device transfer
+with the compute. Measured on this sandbox's v5e chip (DeepFM, batch 8192,
+160B/sample): ~5.6M samples/s with blocking per-step transfers, ~6.2M with
+lookahead, against a ~6.5M pure-transfer ceiling — the link, not the math,
+bounds the step. A threaded producer measured *slower* (4.9M) than the
+main-thread lookahead: `device_put` dispatch contends on the GIL with the
+step dispatch, so this implementation keeps everything on the calling thread
+and relies on JAX's async dispatch — `device_put` returns before the copy
+completes, letting up to `depth` transfers ride behind the running step.
+
+Wire compression (`cast="bfloat16"`): float32/float64 leaves are cast to
+bfloat16 on the host before transfer, halving float bytes on the wire. When
+the model's compute dtype is bfloat16 (the TPU default here), the values are
+cast there anyway, so the computation sees identical inputs.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Iterable, Iterator
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.parallel import mesh as mesh_lib
+
+logger = default_logger(__name__)
+
+
+def _wire_cast(batch: Any, cast: str) -> Any:
+    if not cast:
+        return batch
+    import jax
+    import ml_dtypes
+
+    wire = np.dtype(ml_dtypes.bfloat16) if cast == "bfloat16" else np.dtype(cast)
+
+    def conv(x):
+        if isinstance(x, np.ndarray) and x.dtype in (np.float32, np.float64):
+            return x.astype(wire)
+        return x
+
+    # "mask" stays float32: the worker SUMS it for record accounting, and
+    # bf16 addition is exact only up to 256 — a cast mask would corrupt
+    # records_done and with it the exactly-once protocol.
+    out = dict(batch)
+    for k, v in out.items():
+        if k == "mask":
+            continue
+        out[k] = jax.tree_util.tree_map(conv, v)
+    return out
+
+
+def prefetch_to_device(
+    mesh, batches: Iterable[Any], depth: int = 2, cast: str = ""
+) -> Iterator[Any]:
+    """Yield device-resident (batch-sharded) batches, keeping up to `depth`
+    transfers in flight ahead of the consumer. depth<=0 disables lookahead
+    but still device-puts (and wire-casts) each batch."""
+    it = iter(batches)
+
+    def put(host_batch):
+        return mesh_lib.shard_batch(mesh, _wire_cast(host_batch, cast))
+
+    if depth <= 0:
+        for b in it:
+            yield put(b)
+        return
+
+    buf: deque = deque()
+    exhausted = False
+    while not exhausted and len(buf) < depth:
+        try:
+            buf.append(put(next(it)))
+        except StopIteration:
+            exhausted = True
+    while buf:
+        cur = buf.popleft()
+        if not exhausted:
+            try:
+                buf.append(put(next(it)))
+            except StopIteration:
+                exhausted = True
+        yield cur

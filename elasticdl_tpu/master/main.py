@@ -141,11 +141,13 @@ class Master:
             "job finished: %s mean_loss=%s eval=%s",
             counts, f"{mean_loss:.4f}" if mean_loss is not None else "n/a", results,
         )
-        if self.summary is not None:
-            self.summary.close()
         # give workers a heartbeat cycle to see the shutdown flag
         time.sleep(min(grace_s, self.cfg.worker_heartbeat_s))
         self.server.stop(grace_s)
+        # only after the server stops: late reports may still hit the
+        # summary writer while RPCs are in flight
+        if self.summary is not None:
+            self.summary.close()
 
     def run(self) -> int:
         self.start()

@@ -74,6 +74,14 @@ def table_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def shard_batch(mesh: Mesh, batch):
-    """Device-put a host batch (pytree of np arrays) with batch sharding."""
+    """Device-put a host batch (pytree of np arrays) with batch sharding.
+    Leaves already resident with the right sharding pass through untouched
+    (the DevicePrefetcher hands the trainer pre-sharded batches)."""
     sh = batch_sharding(mesh)
-    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sh), batch)
+
+    def put(x):
+        if isinstance(x, jax.Array) and x.sharding == sh:
+            return x
+        return jax.device_put(x, sh)
+
+    return jax.tree_util.tree_map(put, batch)

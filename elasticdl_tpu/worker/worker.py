@@ -51,6 +51,7 @@ class Worker:
         self._mid_training_task = False
         self._base_lr = None          # injected LR at init (elastic scaling)
         self._pending_lr = None       # set by heartbeat thread, applied by run loop
+        self._last_known_workers = 0  # latest alive count (register/heartbeat)
 
     # ------------------------------------------------------------------ #
     # setup
@@ -70,6 +71,7 @@ class Worker:
         )
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
+        self._last_known_workers = resp.num_workers
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
@@ -119,6 +121,16 @@ class Worker:
             )
         return self._services[task_type]
 
+    def _prefetched(self, batches):
+        """Overlap host->device transfer with compute (data/prefetch.py).
+        Batches arrive pre-sharded, so the train step's shard_batch is a
+        no-op for them."""
+        from elasticdl_tpu.data.prefetch import prefetch_to_device
+
+        return prefetch_to_device(
+            self._mesh, batches, self.cfg.prefetch_batches, cast=self.cfg.wire_dtype
+        )
+
     def _checkpoint_manager(self):
         if self._ckpt_manager is None and self.cfg.checkpoint_dir:
             from elasticdl_tpu.training.checkpoint import CheckpointManager
@@ -157,6 +169,17 @@ class Worker:
                 logger.info(
                     "resumed from checkpoint at step %d", self._last_ckpt_step
                 )
+                if self.cfg.scale_lr_with_workers and self._base_lr:
+                    from elasticdl_tpu.training.lr_modulation import linear_scale
+
+                    # the restored opt_state may carry an LR scaled for a
+                    # membership that no longer exists; re-derive it from the
+                    # CURRENT worker count seen at registration
+                    self._pending_lr = linear_scale(
+                        self._base_lr,
+                        self._last_known_workers or self.cfg.num_workers,
+                        self.cfg.num_workers,
+                    )
 
     def _maybe_checkpoint(self, force: bool = False) -> None:
         """Step-interval checkpointing (reference: --checkpoint_steps), plus
@@ -213,6 +236,7 @@ class Worker:
                         self._job_done = True
                     self._shutdown.set()
                     break
+                self._last_known_workers = resp.num_workers or self._last_known_workers
                 if resp.membership_version != self._membership_version:
                     self._on_membership_change(
                         resp.membership_version, resp.num_workers
@@ -247,7 +271,7 @@ class Worker:
         records_done = 0
         interrupted = False
         self._mid_training_task = True
-        for batch in svc.batches(task.shard_name, task.start, task.end):
+        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
             if self._shutdown.is_set():
                 # preemption mid-task: stop before the next batch; the drain
                 # report below hands the unprocessed remainder back
@@ -347,7 +371,7 @@ class Worker:
         """Returns True if interrupted by shutdown/preemption (no report)."""
         svc = self._data_service(pb.EVALUATION)
         states = self._trainer.new_metric_states()
-        for batch in svc.batches(task.shard_name, task.start, task.end):
+        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
             if self._shutdown.is_set():
                 return True
             self._ensure_state(batch)
@@ -369,7 +393,7 @@ class Worker:
         """Returns True if interrupted by shutdown/preemption (no report)."""
         svc = self._data_service(pb.PREDICTION)
         processor = self._spec.prediction_outputs_processor
-        for batch in svc.batches(task.shard_name, task.start, task.end):
+        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
             if self._shutdown.is_set():
                 return True
             self._ensure_state(batch)
@@ -408,12 +432,15 @@ class Worker:
                 self._job_done = True
                 break
             task = resp.task
-            if self._pending_lr is not None and self._state is not None:
+            pending_lr, self._pending_lr = self._pending_lr, None
+            if pending_lr is not None and self._state is not None:
                 self._state = self._trainer.set_learning_rate(
-                    self._state, self._pending_lr
+                    self._state, pending_lr
                 )
-                logger.info("elastic LR scaled to %.6g", self._pending_lr)
-                self._pending_lr = None
+                logger.info("elastic LR scaled to %.6g", pending_lr)
+            elif pending_lr is not None:
+                # state not built yet: keep it pending for the next loop
+                self._pending_lr = pending_lr
             if task.type == pb.WAIT:
                 time.sleep(resp.backoff_seconds or 1.0)
                 continue
