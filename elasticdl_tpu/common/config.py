@@ -106,7 +106,7 @@ class JobConfig:
     prefetch_batches: int = 2
     # Wire dtype for float batch features ("" = native, "bfloat16" halves
     # transfer bytes; lossless for bf16-compute models — see data/prefetch)
-    wire_dtype: str = "" 
+    wire_dtype: str = ""
 
     # --- cluster shape / elasticity ---
     num_workers: int = 1
@@ -210,16 +210,40 @@ class JobConfig:
         return dataclasses.replace(self, **kw)
 
     def mesh_axes_sizes(self, n_devices: int) -> Dict[str, int]:
-        """Resolve `mesh_shape` against an actual device count."""
+        """Resolve `mesh_shape` against an actual device count.
+
+        Two forms: positional "4" / "4,2" (data[, model], back-compat) and
+        named "data=2,seq=4" / "data=4,model=2" — named supports any axis
+        set (data/model/seq) in mesh order.
+        """
         if not self.mesh_shape:
             return {"data": n_devices}
-        parts = [int(p) for p in self.mesh_shape.split(",")]
-        if len(parts) == 1:
-            sizes = {"data": parts[0]}
-        elif len(parts) == 2:
-            sizes = {"data": parts[0], "model": parts[1]}
+        if "=" in self.mesh_shape:
+            sizes: Dict[str, int] = {}
+            for part in self.mesh_shape.split(","):
+                name, _, size = part.partition("=")
+                name = name.strip()
+                if not name or not size.strip().isdigit():
+                    raise ValueError(
+                        f"mesh_shape entry {part!r} is not name=size "
+                        f"(got mesh_shape={self.mesh_shape!r})"
+                    )
+                if name in sizes:
+                    raise ValueError(
+                        f"mesh_shape names axis {name!r} twice: {self.mesh_shape!r}"
+                    )
+                sizes[name] = int(size)
         else:
-            raise ValueError(f"mesh_shape must have 1 or 2 dims, got {self.mesh_shape!r}")
+            parts = [int(p) for p in self.mesh_shape.split(",")]
+            if len(parts) == 1:
+                sizes = {"data": parts[0]}
+            elif len(parts) == 2:
+                sizes = {"data": parts[0], "model": parts[1]}
+            else:
+                raise ValueError(
+                    f"positional mesh_shape must have 1 or 2 dims, got "
+                    f"{self.mesh_shape!r}; use named form 'data=4,seq=2'"
+                )
         total = 1
         for s in sizes.values():
             total *= s

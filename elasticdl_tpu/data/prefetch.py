@@ -56,7 +56,8 @@ def _wire_cast(batch: Any, cast: str) -> Any:
 
 
 def prefetch_to_device(
-    mesh, batches: Iterable[Any], depth: int = 2, cast: str = ""
+    mesh, batches: Iterable[Any], depth: int = 2, cast: str = "",
+    partition=None,
 ) -> Iterator[Any]:
     """Yield device-resident (batch-sharded) batches, keeping up to `depth`
     transfers in flight ahead of the consumer. depth<=0 disables lookahead
@@ -64,7 +65,7 @@ def prefetch_to_device(
     it = iter(batches)
 
     def put(host_batch):
-        return mesh_lib.shard_batch(mesh, _wire_cast(host_batch, cast))
+        return mesh_lib.shard_batch(mesh, _wire_cast(host_batch, cast), partition)
 
     if depth <= 0:
         for b in it:

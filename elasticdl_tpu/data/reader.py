@@ -202,12 +202,16 @@ class SyntheticDataReader(AbstractDataReader):
         num_records: int = 60000,
         num_shards: int = 4,
         seed: int = 1234,
+        vocab: int = 256,
+        seq_len: int = 128,
         **_,
     ):
         self._kind = kind
         self._n = int(num_records)
         self._num_shards = max(1, int(num_shards))
         self._seed = seed
+        self._vocab = int(vocab)
+        self._seq_len = int(seq_len)
 
     def create_shards(self) -> List[Shard]:
         per = (self._n + self._num_shards - 1) // self._num_shards
@@ -219,7 +223,10 @@ class SyntheticDataReader(AbstractDataReader):
 
     @property
     def metadata(self) -> Dict:
-        return {"kind": self._kind, "num_records": self._n}
+        return {
+            "kind": self._kind, "num_records": self._n,
+            "vocab": self._vocab, "seq_len": self._seq_len,
+        }
 
     def _record(self, idx: int) -> bytes:
         rng = np.random.RandomState((self._seed + idx) % (2**31))
@@ -244,6 +251,19 @@ class SyntheticDataReader(AbstractDataReader):
                 + "\t" + "\t".join(str(d) for d in dense)
                 + "\t" + "\t".join(format(c, "x") for c in cats)
             ).encode()
+        if self._kind == "lm":
+            # Learnable token sequences: mostly-deterministic affine bigram
+            # process t[i+1] = (5*t[i] + 3) % vocab with 10% noise tokens.
+            # vocab/seq_len come from reader params (metadata carries them).
+            vocab = self._vocab
+            T = self._seq_len
+            toks = np.empty(T + 1, np.uint16)
+            toks[0] = rng.randint(0, vocab)
+            noise = rng.rand(T) < 0.1
+            rand_toks = rng.randint(0, vocab, T)
+            for t in range(T):
+                toks[t + 1] = rand_toks[t] if noise[t] else (5 * int(toks[t]) + 3) % vocab
+            return toks.tobytes()
         if self._kind == "census":
             label = rng.randint(0, 2)
             age = 25 + label * 15 + rng.randint(0, 10)
@@ -270,12 +290,17 @@ def create_data_reader(
         rest = data_path[len("synthetic://"):]
         kind, _, qs = rest.partition("?")
         opts = dict(p.split("=", 1) for p in qs.split("&") if "=" in p)
+        aliases = {"seq": "seq_len"}  # the zoo docs use the short form
+        extra = {
+            aliases.get(k, k): int(float(v))
+            for k, v in opts.items() if k not in ("n", "shards")
+        }
         return SyntheticDataReader(
             kind=kind or "mnist",
             # int(float(...)) so scientific notation ("n=1e6") works
             num_records=int(float(opts.get("n", params.pop("num_records", 60000)))),
             num_shards=int(float(opts.get("shards", params.pop("num_shards", 4)))),
-            **params,
+            **{**params, **extra},
         )
     if data_path.startswith("odps://"):
         # odps://<table>[#partition] — project comes from env, like the

@@ -1,0 +1,164 @@
+"""Sequence/context-parallel attention: ring attention and Ulysses all-to-all.
+
+Net-new relative to the reference (william-wang/elasticdl is recsys/CNN
+oriented and has no attention or sequence scaling anywhere — SURVEY §5), but
+first-class here: long-context training must shard the SEQUENCE dimension
+once activations (B, T, H, D) outgrow one chip's HBM.
+
+Two standard TPU-native strategies over a `seq` mesh axis, both pure
+`shard_map` + XLA collectives over ICI:
+
+- **ring attention** (`mode="ring"`): K/V blocks rotate around the ring via
+  `lax.ppermute` while each device streams them against its resident Q
+  block using the online-softmax (flash-attention) recurrence. Peak memory
+  is one KV block; comm is n-1 block transfers fully overlappable with the
+  block matmuls.
+- **Ulysses** (`mode="ulysses"`): `lax.all_to_all` re-shards heads<->sequence
+  so each device holds the FULL sequence for H/n heads, runs ordinary
+  attention locally, and all-to-alls back. Cheaper comm for moderate T,
+  needs heads % seq_shards == 0.
+
+Everything differentiates through `jax.grad` (scan + ppermute/all_to_all are
+linear/differentiable), so no custom VJP is needed; accumulation runs in
+float32 regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from elasticdl_tpu.common.constants import MeshAxis
+
+NEG_BIG = -1e30  # finite "-inf": avoids nan from (-inf) - (-inf) in softmax
+
+
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   causal: bool = True,
+                   q_offset: int = 0, kv_offset: int = 0) -> jax.Array:
+    """Plain softmax attention. q,k,v: (B, T, H, D). The offsets position the
+    local q/kv blocks in the GLOBAL sequence for causal masking (used by the
+    sequence-parallel paths; leave 0 for unsharded attention)."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * scale
+    if causal:
+        q_pos = q_offset + jnp.arange(q.shape[1])
+        kv_pos = kv_offset + jnp.arange(k.shape[1])
+        mask = kv_pos[None, :] <= q_pos[:, None]
+        s = jnp.where(mask[None, None], s, NEG_BIG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                     preferred_element_type=jnp.float32)
+    return out.astype(q.dtype)
+
+
+def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
+                            manual_axes=()):
+    """Per-shard body (inside shard_map): q,k,v are the LOCAL seq blocks."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32)
+
+    q_pos = idx * Lq + jnp.arange(Lq)
+
+    def accumulate(o, m, l, kb, vb, kv_block):
+        """One online-softmax update against KV block `kv_block`."""
+        s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
+        if causal:
+            kv_pos = kv_block * Lk + jnp.arange(Lk)
+            mask = kv_pos[None, :] <= q_pos[:, None]           # (Lq, Lk)
+            s = jnp.where(mask[None, None], s, NEG_BIG)
+        m_new = jnp.maximum(m, s.max(axis=-1))                 # (B,H,Lq)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        o_new = o * alpha[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+        )
+        return o_new, m_new, l_new
+
+    def block(carry, _):
+        o, m, l, kb, vb, j = carry
+        # permute FIRST: the resident block was consumed before the scan, so
+        # only n-1 rotations cross the ring (no discarded final transfer)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        o, m, l = accumulate(o, m, l, kb, vb, (idx - j) % n)
+        return (o, m, l, kb, vb, j + 1), None
+
+    # scan carries must be "varying" over the manual mesh axes like k/v are
+    mark = lambda x: lax.pcast(x, tuple(manual_axes), to="varying")
+    o0 = mark(jnp.zeros((B, H, Lq, D), jnp.float32))
+    m0 = mark(jnp.full((B, H, Lq), NEG_BIG, jnp.float32))
+    l0 = mark(jnp.zeros((B, H, Lq), jnp.float32))
+    o, m, l = accumulate(o0, m0, l0, k, v, idx)                # resident block
+    if n > 1:
+        (o, m, l, _, _, _), _ = lax.scan(
+            block, (o, m, l, k, v, mark(jnp.int32(1))), None, length=n - 1
+        )
+    out = o / jnp.maximum(l, 1e-20)[..., None]                 # (B,H,Lq,D)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)           # (B,Lq,H,D)
+
+
+def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
+    """Per-shard body: all_to_all heads<->sequence, local full attention."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    H = q.shape[2]
+    if H % n:
+        raise ValueError(f"ulysses needs heads ({H}) divisible by seq shards ({n})")
+
+    def to_seq(x):   # (B, L, H, D) -> (B, n*L, H/n, D)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def to_heads(x):  # inverse
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qs, ks, vs = to_seq(q), to_seq(k), to_seq(v)
+    out = full_attention(qs, ks, vs, causal=causal)
+    return to_heads(out)
+
+
+def sequence_parallel_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True,
+    mode: str = "ring",
+    axis_name: Optional[str] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on mesh axis `axis_name` (default:
+    the ambient mesh's `seq` axis if present). q,k,v: (B, T, H, D) with T
+    sharded over the seq axis. Falls back to full_attention when the mesh
+    has no seq axis (single-chip or pure-DP training)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    names = tuple(mesh.axis_names)
+    axis = axis_name or (MeshAxis.SEQ if MeshAxis.SEQ in names else None)
+    if axis is None or mesh.shape.get(axis, 1) == 1:
+        return full_attention(q, k, v, causal=causal)
+
+    data_ax = MeshAxis.DATA if MeshAxis.DATA in names else None
+    spec = P(data_ax, axis, None, None)
+    manual = tuple(a for a in (data_ax, axis) if a)
+    if mode == "ring":
+        body = partial(
+            _ring_attention_sharded, axis_name=axis, causal=causal,
+            manual_axes=manual,
+        )
+    elif mode == "ulysses":
+        body = partial(_ulysses_sharded, axis_name=axis, causal=causal)
+    else:
+        raise ValueError(f"unknown sequence-parallel mode {mode!r}")
+    return jax.shard_map(
+        body,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )(q, k, v)

@@ -73,15 +73,42 @@ def table_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names, None))
 
 
-def shard_batch(mesh: Mesh, batch):
+def shard_batch(mesh: Mesh, batch, partition=None):
     """Device-put a host batch (pytree of np arrays) with batch sharding.
-    Leaves already resident with the right sharding pass through untouched
-    (the DevicePrefetcher hands the trainer pre-sharded batches)."""
-    sh = batch_sharding(mesh)
 
-    def put(x):
-        if isinstance(x, jax.Array) and x.sharding == sh:
-            return x
-        return jax.device_put(x, sh)
+    `partition` optionally overrides the sharding per TOP-LEVEL key with a
+    PartitionSpec (models with a sequence-parallel axis shard tokens
+    P('data','seq') — see the transformer zoo's batch_partition). Leaves
+    already resident with the right sharding pass through untouched (the
+    DevicePrefetcher hands the trainer pre-sharded batches)."""
+    default = batch_sharding(mesh)
 
-    return jax.tree_util.tree_map(put, batch)
+    def put_with(sh):
+        def put(x):
+            if isinstance(x, jax.Array) and x.sharding == sh:
+                return x
+            return jax.device_put(x, sh)
+        return put
+
+    if not partition:
+        return jax.tree_util.tree_map(put_with(default), batch)
+
+    def prune(spec):
+        # drop axes the mesh doesn't have: the same zoo config runs on a
+        # pure-data mesh (single chip / plain DP) without a seq axis
+        entries = []
+        for e in spec:
+            if e is None:
+                entries.append(None)
+            else:
+                axes = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                             if a in mesh.axis_names)
+                entries.append(axes if axes else None)
+        return P(*entries)
+
+    out = {}
+    for key, value in batch.items():
+        spec = partition.get(key)
+        sh = NamedSharding(mesh, prune(spec)) if spec is not None else default
+        out[key] = jax.tree_util.tree_map(put_with(sh), value)
+    return out

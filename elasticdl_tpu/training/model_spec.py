@@ -42,6 +42,10 @@ class ModelSpec:
     # injected defaults like compute_dtype) — export must record these, or a
     # serving reload could rebuild the module with different defaults.
     model_params: Dict[str, Any] = field(default_factory=dict)
+    # Optional per-top-level-key PartitionSpec overrides for input batches
+    # (zoo module-level `batch_partition()`; sequence-parallel models shard
+    # tokens over ('data', 'seq')).
+    batch_partition: Optional[Dict[str, Any]] = None
 
     @classmethod
     def from_config(cls, cfg: JobConfig) -> "ModelSpec":
@@ -66,6 +70,9 @@ class ModelSpec:
             module, "eval_metrics_fn", cfg.eval_metrics_fn, required=False
         )
         callbacks_fn = get_module_attr(module, "callbacks", "", required=False)
+        batch_partition_fn = get_module_attr(
+            module, "batch_partition", "", required=False
+        )
         pop_fn = get_module_attr(
             module,
             "prediction_outputs_processor",
@@ -83,4 +90,7 @@ class ModelSpec:
             prediction_outputs_processor=pop_fn() if pop_fn else None,
             module_name=module.__name__,
             model_params=model_params,
+            batch_partition=(
+                dict(batch_partition_fn()) if batch_partition_fn else None
+            ),
         )

@@ -249,7 +249,7 @@ class Trainer:
     def train_step(self, state: TrainState, batch: Dict[str, Any]):
         if self._train_step is None:
             self._train_step = self._build_train_step()
-        batch = mesh_lib.shard_batch(self.mesh, batch)
+        batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
             return self._train_step(state, batch)
 
@@ -270,14 +270,14 @@ class Trainer:
     def eval_step(self, state: TrainState, batch, metric_states):
         if self._eval_step is None:
             self._eval_step = self._build_eval_step()
-        batch = mesh_lib.shard_batch(self.mesh, batch)
+        batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
             return self._eval_step(state, batch, metric_states)
 
     def predict_step(self, state: TrainState, batch):
         if self._predict_step is None:
             self._predict_step = self._build_predict_step()
-        batch = mesh_lib.shard_batch(self.mesh, batch)
+        batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
             return self._predict_step(state, batch)
 

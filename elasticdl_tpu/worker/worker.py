@@ -128,7 +128,9 @@ class Worker:
         from elasticdl_tpu.data.prefetch import prefetch_to_device
 
         return prefetch_to_device(
-            self._mesh, batches, self.cfg.prefetch_batches, cast=self.cfg.wire_dtype
+            self._mesh, batches, self.cfg.prefetch_batches,
+            cast=self.cfg.wire_dtype,
+            partition=self._spec.batch_partition if self._spec else None,
         )
 
     def _checkpoint_manager(self):
