@@ -22,10 +22,7 @@ from elasticdl_tpu.master.process_manager import ProcessManager
 logger = default_logger(__name__)
 
 
-def free_port() -> int:
-    with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
-        s.bind(("", 0))
-        return s.getsockname()[1]
+from elasticdl_tpu.common.net import free_port  # noqa: F401  (re-export)
 
 
 def run_local(

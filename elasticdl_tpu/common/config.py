@@ -110,6 +110,10 @@ class JobConfig:
 
     # --- cluster shape / elasticity ---
     num_workers: int = 1
+    # >1 = multi-process SPMD cohort: one jax.distributed world + one global
+    # mesh across this many processes (worker/cohort.py). The master sees one
+    # logical worker (the cohort leader).
+    num_processes: int = 1
     num_minibatches_per_task: int = 0   # 0 = derive from records_per_task
     max_task_retries: int = 3
     relaunch_max: int = 3               # reference: --relaunch_pod_max_num

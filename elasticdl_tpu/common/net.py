@@ -1,0 +1,12 @@
+"""Small networking helpers shared by launchers and managers."""
+
+from __future__ import annotations
+
+import socket
+from contextlib import closing
+
+
+def free_port() -> int:
+    with closing(socket.socket(socket.AF_INET, socket.SOCK_STREAM)) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

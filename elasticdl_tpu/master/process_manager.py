@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
 from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.net import free_port
 from elasticdl_tpu.common.constants import PodStatus, WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.membership import Membership
@@ -57,23 +58,36 @@ class ProcessManager:
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._next_worker_id = 0
+        self._cohort_relaunches = 0
+        self._cohort_coordinator = ""
+
+    @property
+    def _cohort_mode(self) -> bool:
+        return self.cfg.num_processes > 1
+
 
     # ------------------------------------------------------------------ #
 
-    def _spawn(self, worker_id: int, relaunches: int = 0) -> _WorkerProc:
+    def _spawn(self, worker_id: int, relaunches: int = 0,
+               process_id: int = 0) -> _WorkerProc:
         env = dict(os.environ)
         env.update({str(k): str(v) for k, v in self.cfg.envs.items()})
         env.update(self._extra_env)
         env[WorkerEnv.WORKER_ID] = str(worker_id)
         env[WorkerEnv.MASTER_ADDR] = self.cfg.master_addr
         env[WorkerEnv.NUM_WORKERS] = str(self.cfg.num_workers)
+        if self._cohort_mode:
+            env["EDL_PROCESS_ID"] = str(process_id)
+            env["EDL_COORDINATOR_ADDR"] = self._cohort_coordinator
         argv = self.cfg.to_argv()
         stdout = stderr = None
         if self._log_dir:
             os.makedirs(self._log_dir, exist_ok=True)
-            log = open(
-                os.path.join(self._log_dir, f"worker-{worker_id}.log"), "ab"
+            name = (
+                f"worker-{worker_id}-p{process_id}.log"
+                if self._cohort_mode else f"worker-{worker_id}.log"
             )
+            log = open(os.path.join(self._log_dir, name), "ab")
             stdout = stderr = log
         proc = subprocess.Popen(
             [sys.executable, "-m", "elasticdl_tpu.worker.main", *argv],
@@ -87,15 +101,36 @@ class ProcessManager:
 
     def start_workers(self) -> None:
         with self._lock:
-            for _ in range(self.cfg.num_workers):
-                wid = self._next_worker_id
-                self._next_worker_id += 1
-                self._procs[wid] = self._spawn(wid)
+            if self._cohort_mode:
+                self._spawn_cohort_locked()
+            else:
+                for _ in range(self.cfg.num_workers):
+                    wid = self._next_worker_id
+                    self._next_worker_id += 1
+                    self._procs[wid] = self._spawn(wid)
         self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
         self._watcher.start()
 
+    def _spawn_cohort_locked(self) -> None:
+        """Spawn all cohort members (process id == slot id; the leader,
+        process 0, registers with the master as worker 0). A fresh
+        coordinator port per generation avoids TIME_WAIT rebind races."""
+        self._cohort_coordinator = f"localhost:{free_port()}"
+        for p in range(self.cfg.num_processes):
+            self._procs[p] = self._spawn(
+                0, relaunches=self._cohort_relaunches, process_id=p
+            )
+
     def add_worker(self) -> int:
         """Scale up by one worker (elastic scale-out)."""
+        if self._cohort_mode:
+            # a live jax.distributed world is fixed-size; scale-out means a
+            # new cohort generation with a larger num_processes, not an
+            # extra member joining the running coordinator
+            raise RuntimeError(
+                "add_worker is not supported in cohort mode; change "
+                "num_processes and relaunch the cohort instead"
+            )
         with self._lock:
             wid = self._next_worker_id
             self._next_worker_id += 1
@@ -124,6 +159,9 @@ class ProcessManager:
 
     def _watch_loop(self, poll_s: float = 0.5) -> None:
         """The pod-event watch: detect exits, relaunch or retire."""
+        if self._cohort_mode:
+            self._watch_cohort_loop(poll_s)
+            return
         while not self._stop.is_set():
             with self._lock:
                 items = list(self._procs.items())
@@ -160,6 +198,52 @@ class ProcessManager:
                         "worker %d died (code %s); relaunch budget exhausted",
                         wid, code,
                     )
+            self._stop.wait(poll_s)
+
+    def _watch_cohort_loop(self, poll_s: float) -> None:
+        """Cohort semantics: the jax.distributed world is all-or-nothing —
+        one dead member fails the others, so ANY failure tears the cohort
+        down and relaunches it whole (the new world restores from the last
+        checkpoint). The relaunch budget counts cohort generations."""
+        while not self._stop.is_set():
+            with self._lock:
+                items = list(self._procs.items())
+            codes = {pid: wp.proc.poll() for pid, wp in items}
+            failed = [
+                pid for pid, c in codes.items() if c is not None and c != 0
+            ]
+            if failed and not self._job_finished_fn():
+                if self._membership is not None:
+                    self._membership.mark_dead(
+                        0, reason=f"cohort member(s) {failed} died"
+                    )
+                for pid, wp in items:
+                    if wp.proc.poll() is None:
+                        wp.proc.kill()
+                for pid, wp in items:
+                    try:
+                        wp.proc.wait(timeout=30)
+                    except subprocess.TimeoutExpired:
+                        pass
+                if self._cohort_relaunches < self.cfg.relaunch_max:
+                    self._cohort_relaunches += 1
+                    logger.warning(
+                        "cohort member(s) %s died; relaunching cohort "
+                        "(generation %d/%d)",
+                        failed, self._cohort_relaunches, self.cfg.relaunch_max,
+                    )
+                    with self._lock:
+                        self._procs.clear()
+                        self._spawn_cohort_locked()
+                else:
+                    logger.error("cohort relaunch budget exhausted")
+                    for wp in self._procs.values():
+                        wp.status = PodStatus.FAILED
+                    return
+            elif all(c is not None for c in codes.values()) and codes:
+                for wp in self._procs.values():
+                    wp.status = PodStatus.SUCCEEDED
+                return
             self._stop.wait(poll_s)
 
     # ------------------------------------------------------------------ #

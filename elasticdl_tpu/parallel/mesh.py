@@ -92,23 +92,26 @@ def shard_batch(mesh: Mesh, batch, partition=None):
 
     if not partition:
         return jax.tree_util.tree_map(put_with(default), batch)
-
-    def prune(spec):
-        # drop axes the mesh doesn't have: the same zoo config runs on a
-        # pure-data mesh (single chip / plain DP) without a seq axis
-        entries = []
-        for e in spec:
-            if e is None:
-                entries.append(None)
-            else:
-                axes = tuple(a for a in (e if isinstance(e, tuple) else (e,))
-                             if a in mesh.axis_names)
-                entries.append(axes if axes else None)
-        return P(*entries)
-
     out = {}
     for key, value in batch.items():
         spec = partition.get(key)
-        sh = NamedSharding(mesh, prune(spec)) if spec is not None else default
+        sh = (
+            NamedSharding(mesh, prune_spec(mesh, spec))
+            if spec is not None else default
+        )
         out[key] = jax.tree_util.tree_map(put_with(sh), value)
     return out
+
+
+def prune_spec(mesh: Mesh, spec: P) -> P:
+    """Drop spec axes the mesh doesn't have: the same zoo config (e.g. tokens
+    P('data','seq')) runs on a pure-data mesh without a seq axis."""
+    entries = []
+    for e in spec:
+        if e is None:
+            entries.append(None)
+        else:
+            axes = tuple(a for a in (e if isinstance(e, tuple) else (e,))
+                         if a in mesh.axis_names)
+            entries.append(axes if axes else None)
+    return P(*entries)

@@ -55,13 +55,21 @@ def export_model(
     model_def: str = "",
     model_params: Optional[Dict[str, Any]] = None,
     module_name: str = "",
+    write_files: bool = True,
 ) -> str:
-    """Write a serving export of a trained TrainState. Returns export_dir."""
+    """Write a serving export of a trained TrainState. Returns export_dir.
+
+    Multi-process: the host gather inside is COLLECTIVE (process_allgather),
+    so every process must call this; pass write_files=False on non-leader
+    processes so only one writes the artifact.
+    """
     from flax import serialization
 
     export_dir = os.path.abspath(export_dir)
-    os.makedirs(export_dir, exist_ok=True)
     tree = _host_variables(state)
+    if not write_files:
+        return export_dir
+    os.makedirs(export_dir, exist_ok=True)
     with open(os.path.join(export_dir, PARAMS_FILE), "wb") as f:
         f.write(serialization.msgpack_serialize(tree))
     n_params = sum(x.size for x in jax.tree_util.tree_leaves(tree["params"]))

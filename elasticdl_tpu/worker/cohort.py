@@ -1,0 +1,354 @@
+"""Multi-process SPMD worker cohort: one logical worker, one global mesh.
+
+Reference parity: the reference's elastic-AllReduce mode (SURVEY §3.4) — N
+worker pods formed one Horovod ring, each trained on its own minibatches,
+gradients averaged collectively. Rebuilt TPU-native: N processes initialize
+ONE `jax.distributed` world and ONE mesh over all their devices; every
+process executes the same jitted train step (SPMD), each feeding its
+process-local rows of the global batch; gradient averaging is the `psum`
+XLA inserts over the `data` axis.
+
+Topology of control: process 0 (the leader) is the only one the master
+sees — it leases tasks, reports results, and heartbeats. Followers receive
+a small broadcast control vector per task (op, shard, span, flags) and run
+the identical data/compute sequence. Every collective (train step, eval,
+checkpoint save/restore, export gather) is executed by ALL processes; all
+host-side decisions ride the control broadcast, so the cohort stays in
+lockstep by construction.
+
+Elasticity = cohort re-formation (SURVEY §7 hard-part 1): any member dying
+makes the coordination service fail the others; the whole cohort exits and
+the process manager relaunches it; the new world restores from the latest
+checkpoint and re-leases at the task boundary. SIGTERM therefore exits
+immediately (EX_TEMPFAIL) instead of draining — a drain would deadlock
+followers blocked on the next broadcast.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.parallel.elastic import (
+    CohortContext,
+    context_from_env,
+    make_global_batch,
+)
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.service import MasterStub, make_channel
+from elasticdl_tpu.training.model_spec import ModelSpec
+from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+logger = default_logger(__name__)
+
+# control vector: [op, task_id, task_type, shard_idx, start, end, flags, eval_job]
+OP_NOOP, OP_TASK, OP_DONE, OP_ABORT = 0, 1, 2, 3
+FLAG_CHECKPOINT = 1
+CTRL_LEN = 8
+
+
+class CohortWorker:
+    def __init__(self, cfg: JobConfig, ctx: Optional[CohortContext] = None):
+        self.cfg = cfg
+        self.ctx = ctx or context_from_env(cfg)
+        self._stub: Optional[MasterStub] = None
+        self._trainer = None
+        self._state = None
+        self._spec: Optional[ModelSpec] = None
+        self._mesh = None
+        self._services: Dict[int, TaskDataService] = {}
+        self._shards: Dict[int, List[Tuple[str, int, int]]] = {}
+        self._ckpt_manager = None
+        self._last_ckpt_step = 0
+        self._shutdown = threading.Event()
+        self._job_done = False
+        self.worker_id = -1
+
+    # ------------------------------------------------------------------ #
+    # setup (identical on every process)
+
+    def _build(self) -> None:
+        import jax
+
+        from elasticdl_tpu.parallel.mesh import build_mesh
+        from elasticdl_tpu.training.trainer import Trainer
+
+        self._spec = ModelSpec.from_config(self.cfg)
+        self._mesh = build_mesh(
+            self.cfg.mesh_axes_sizes(len(jax.devices()))
+            if self.cfg.mesh_shape else None,
+            jax.devices(),
+        )
+        self._trainer = Trainer(
+            self._spec, self._mesh, remat=self.cfg.remat,
+            seed=self.cfg.shuffle_seed,
+        )
+
+    def _data_service(self, task_type: int) -> TaskDataService:
+        if task_type not in self._services:
+            paths = {
+                pb.TRAINING: self.cfg.training_data,
+                pb.EVALUATION: self.cfg.validation_data or self.cfg.training_data,
+            }
+            reader = create_data_reader(
+                paths[task_type], self.cfg.data_reader,
+                **self.cfg.data_reader_params,
+            )
+            parse = self._spec.dataset_fn(
+                "training" if task_type == pb.TRAINING else "evaluation",
+                reader.metadata,
+            )
+            from elasticdl_tpu.parallel.mesh import data_axis
+
+            multiple = dict(
+                zip(self._mesh.axis_names, self._mesh.devices.shape)
+            )[data_axis(self._mesh)]
+            self._services[task_type] = TaskDataService(
+                reader, parse, self.cfg.minibatch_size, batch_multiple=multiple
+            )
+            # shard index -> name map; identical everywhere (sorted) so a
+            # broadcast int addresses the same shard on every process
+            self._shards[task_type] = sorted(reader.create_shards())
+        return self._services[task_type]
+
+    def _shard_name(self, task_type: int, shard_idx: int) -> str:
+        self._data_service(task_type)
+        return self._shards[task_type][shard_idx][0]
+
+    def _shard_index(self, task_type: int, name: str) -> int:
+        self._data_service(task_type)
+        for i, (n, _, _) in enumerate(self._shards[task_type]):
+            if n == name:
+                return i
+        raise KeyError(f"unknown shard {name!r}")
+
+    def _checkpoint_manager(self):
+        if self._ckpt_manager is None and self.cfg.checkpoint_dir:
+            from elasticdl_tpu.training.checkpoint import CheckpointManager
+
+            self._ckpt_manager = CheckpointManager(
+                self.cfg.checkpoint_dir, keep=self.cfg.keep_checkpoint_max
+            )
+        return self._ckpt_manager
+
+    def _ensure_state(self, example_batch) -> None:
+        if self._state is not None:
+            return
+        self._state = self._trainer.init_state(example_batch)
+        mngr = self._checkpoint_manager()
+        if mngr is not None and mngr.latest_step() is not None:
+            restored = mngr.restore(self._state)
+            if restored is not None:
+                self._state = restored
+                self._last_ckpt_step = self._state.model_version
+                logger.info(
+                    "cohort resumed from checkpoint at step %d",
+                    self._last_ckpt_step,
+                )
+
+    # ------------------------------------------------------------------ #
+    # leader-only: master RPCs
+
+    def _connect(self) -> None:
+        import os
+        import socket
+
+        self._channel = make_channel(self.cfg.master_addr)
+        self._stub = MasterStub(self._channel)
+        resp = self._stub.RegisterWorker(
+            pb.RegisterWorkerRequest(
+                worker_name=f"cohort-{socket.gethostname()}:{os.getpid()}",
+                preferred_id_plus_one=1,
+            ),
+            timeout=30,
+        )
+        self.worker_id = resp.worker_id
+        logger.info(
+            "cohort leader registered as worker %d (%d processes, %d devices)",
+            self.worker_id, self.ctx.num_processes,
+            len(__import__("jax").devices()),
+        )
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                version = self._state.model_version if self._state is not None else 0
+                resp = self._stub.Heartbeat(
+                    pb.HeartbeatRequest(
+                        worker_id=self.worker_id, model_version=version
+                    ),
+                    timeout=10,
+                )
+                if resp.shutdown:
+                    if resp.job_done:
+                        self._job_done = True
+                    self._shutdown.set()
+                    break
+            except Exception as e:
+                logger.warning("cohort heartbeat failed: %s", e)
+            self._shutdown.wait(self.cfg.worker_heartbeat_s)
+
+    def _lease_control(self) -> List[int]:
+        """Leader: turn the next master response into a control vector."""
+        if self._shutdown.is_set():
+            return [OP_DONE if self._job_done else OP_ABORT] + [0] * (CTRL_LEN - 1)
+        try:
+            resp = self._stub.GetTask(
+                pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
+            )
+        except Exception as e:
+            logger.warning("cohort get_task failed: %s", e)
+            return [OP_NOOP, 0, 0, 0, 0, 0, 0, 0]
+        if resp.job_done:
+            self._job_done = True
+            return [OP_DONE] + [0] * (CTRL_LEN - 1)
+        task = resp.task
+        if task.type == pb.WAIT:
+            return [OP_NOOP] + [0] * (CTRL_LEN - 1)
+        due = (
+            self.cfg.checkpoint_steps > 0
+            and self._state is not None
+            and self._state.model_version - self._last_ckpt_step
+            >= self.cfg.checkpoint_steps
+        )
+        return [
+            OP_TASK, task.task_id, task.type,
+            self._shard_index(task.type, task.shard_name),
+            task.start, task.end,
+            FLAG_CHECKPOINT if due else 0,
+            task.eval_job_id,
+        ]
+
+    # ------------------------------------------------------------------ #
+    # collective task execution (every process)
+
+    def _run_task(self, ctrl: List[int]) -> None:
+        import jax
+
+        _, task_id, task_type, shard_idx, start, end, flags, eval_job = ctrl
+        svc = self._data_service(task_type)
+        shard = self._shard_name(task_type, shard_idx)
+        loss_sum, loss_count = 0.0, 0
+        metric_states = None
+        for host_batch in svc.batches(shard, start, end):
+            batch = make_global_batch(
+                self._mesh, host_batch, self._spec.batch_partition
+            )
+            self._ensure_state(batch)
+            if task_type == pb.TRAINING:
+                self._state, logs = self._trainer.train_step(self._state, batch)
+                if self.ctx.is_leader:
+                    loss_sum += float(logs["loss"])
+                    loss_count += 1
+            else:
+                if metric_states is None:
+                    metric_states = self._trainer.new_metric_states()
+                metric_states = self._trainer.eval_step(
+                    self._state, batch, metric_states
+                )
+
+        if flags & FLAG_CHECKPOINT:
+            mngr = self._checkpoint_manager()
+            if mngr is not None and self._state is not None:
+                # collective: every process writes its addressable shards
+                mngr.save(self._state, wait=True)
+                self._last_ckpt_step = self._state.model_version
+
+        if not self.ctx.is_leader:
+            return
+        report = pb.ReportTaskResultRequest(
+            worker_id=self.worker_id, task_id=task_id, success=True,
+            records_processed=end - start,
+            model_version=(
+                self._state.model_version if self._state is not None else 0
+            ),
+            loss_sum=loss_sum, loss_count=loss_count,
+        )
+        try:
+            self._stub.ReportTaskResult(report, timeout=30)
+            if task_type == pb.EVALUATION and metric_states is not None:
+                msg = pb.ReportEvaluationMetricsRequest(
+                    worker_id=self.worker_id, eval_job_id=eval_job,
+                    task_id=task_id,
+                )
+                for name, state in metric_states.items():
+                    arr = np.asarray(jax.device_get(state), np.float32)
+                    msg.states.append(
+                        pb.MetricState(name=name, data=arr.tobytes())
+                    )
+                self._stub.ReportEvaluationMetrics(msg, timeout=30)
+        except Exception as e:
+            logger.warning("cohort report failed for task %d: %s", task_id, e)
+
+    def _export_final_model(self) -> None:
+        if not self.cfg.output or self._state is None:
+            return
+        try:
+            from elasticdl_tpu.training.export import export_model
+
+            # collective gather (process_allgather) on every process;
+            # only the leader writes files
+            export_model(
+                self._state, self.cfg.output,
+                model_def=self.cfg.model_def,
+                model_params=self._spec.model_params,
+                module_name=self._spec.module_name,
+                write_files=self.ctx.is_leader,
+            )
+        except Exception:
+            logger.exception("cohort final export failed")
+
+    # ------------------------------------------------------------------ #
+
+    def run(self) -> int:
+        self.ctx.initialize()
+        try:
+            self._build()
+            if self.ctx.is_leader:
+                self._connect()
+                threading.Thread(
+                    target=self._heartbeat_loop, daemon=True
+                ).start()
+            backoff = max(0.5, self.cfg.worker_heartbeat_s / 4)
+            while True:
+                leader_ctrl = (
+                    self._lease_control()
+                    if self.ctx.is_leader
+                    else [0] * CTRL_LEN
+                )
+                ctrl = [int(x) for x in self.ctx.broadcast_ints(leader_ctrl)]
+                op = ctrl[0]
+                if op == OP_NOOP:
+                    time.sleep(backoff)
+                    continue
+                if op == OP_TASK:
+                    self._run_task(ctrl)
+                    continue
+                if op in (OP_DONE, OP_ABORT):
+                    if op == OP_DONE:
+                        self._export_final_model()
+                    break
+            self._shutdown.set()
+            if self.ctx.is_leader:
+                try:
+                    self._channel.close()
+                except Exception:
+                    pass
+            # ABORT = the master evicted us without job completion (e.g. a
+            # heartbeat lapse marked the leader dead and our tasks were
+            # requeued): exit EX_TEMPFAIL so the manager relaunches the
+            # cohort; a clean 0 would read as success and end all watching.
+            return 0 if op == OP_DONE else 75
+        finally:
+            self.ctx.shutdown()
+
+
+def run_cohort(cfg: JobConfig) -> int:
+    worker = CohortWorker(cfg)
+    return worker.run()

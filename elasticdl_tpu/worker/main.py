@@ -16,6 +16,15 @@ from elasticdl_tpu.worker.worker import Worker
 
 def main(argv: Optional[List[str]] = None) -> int:
     cfg = JobConfig.from_argv(sys.argv[1:] if argv is None else argv)
+    if cfg.num_processes > 1:
+        # SPMD cohort member: no drain on SIGTERM — a draining leader would
+        # deadlock followers blocked on the next control broadcast; exit
+        # EX_TEMPFAIL so the manager relaunches the whole cohort, which
+        # restores from the last checkpoint (worker/cohort.py).
+        from elasticdl_tpu.worker.cohort import run_cohort
+
+        signal.signal(signal.SIGTERM, lambda *_: sys.exit(75))
+        return run_cohort(cfg)
     worker = Worker(cfg)
     # k8s preemption delivers SIGTERM with a grace period; drain + checkpoint
     signal.signal(signal.SIGTERM, lambda *_: worker.preempt())
