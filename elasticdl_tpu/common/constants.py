@@ -60,6 +60,17 @@ class WorkerEnv:
     COORDINATOR_ADDR = "EDL_COORDINATOR_ADDR"
 
 
+class ExitCode:
+    """Worker exit codes the process manager keys recovery decisions on."""
+
+    OK = 0
+    # EX_TEMPFAIL: evicted/preempted mid-job — relaunch me
+    COHORT_EVICTED = 75
+    # jax.distributed world never formed (e.g. coordinator-port TOCTOU);
+    # an infrastructure failure that must not consume the relaunch budget
+    WORLD_FORM_FAILED = 76
+
+
 class MeshAxis:
     """Canonical mesh axis names for every sharding in the framework."""
 

@@ -17,11 +17,11 @@ import sys
 import threading
 import time
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.net import free_port
-from elasticdl_tpu.common.constants import PodStatus, WorkerEnv
+from elasticdl_tpu.common.constants import ExitCode, PodStatus, WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.membership import Membership
 
@@ -34,6 +34,10 @@ class _WorkerProc:
     proc: subprocess.Popen
     relaunches: int = 0
     status: str = PodStatus.RUNNING
+    # cohort mode: this member is permanently gone (host lost, eviction) —
+    # its death must trigger a downsized re-formation, not an in-place
+    # relaunch that would just die again
+    no_relaunch: bool = False
 
 
 class ProcessManager:
@@ -60,10 +64,25 @@ class ProcessManager:
         self._next_worker_id = 0
         self._cohort_relaunches = 0
         self._cohort_coordinator = ""
+        # dynamic world resizing state (cohort mode)
+        self._cohort_size = self.cfg.num_processes
+        self._world_version = 0
+        self._pending_resize: Optional[int] = None
+        self._infra_retries = 0
+        # world-formation failures (coordinator-port TOCTOU etc.) retry
+        # without consuming the relaunch budget, bounded by this cap
+        self.infra_retry_max = 10
+        # timestamped re-formation records: (wall_clock_s, old_size, new_size)
+        self.reformation_log: List[Tuple[float, int, int]] = []
 
     @property
     def _cohort_mode(self) -> bool:
         return self.cfg.num_processes > 1
+
+    @property
+    def cohort_size(self) -> int:
+        with self._lock:
+            return self._cohort_size
 
 
     # ------------------------------------------------------------------ #
@@ -79,6 +98,10 @@ class ProcessManager:
         if self._cohort_mode:
             env["EDL_PROCESS_ID"] = str(process_id)
             env["EDL_COORDINATOR_ADDR"] = self._cohort_coordinator
+            # dynamic resizing: the CURRENT world size/generation, which may
+            # differ from the argv's immutable cfg.num_processes
+            env["EDL_NUM_PROCESSES"] = str(self._cohort_size)
+            env["EDL_WORLD_VERSION"] = str(self._world_version)
         argv = self.cfg.to_argv()
         stdout = stderr = None
         if self._log_dir:
@@ -111,31 +134,52 @@ class ProcessManager:
         self._watcher = threading.Thread(target=self._watch_loop, daemon=True)
         self._watcher.start()
 
-    def _spawn_cohort_locked(self) -> None:
+    def _spawn_cohort_locked(self, size: Optional[int] = None) -> None:
         """Spawn all cohort members (process id == slot id; the leader,
         process 0, registers with the master as worker 0). A fresh
-        coordinator port per generation avoids TIME_WAIT rebind races."""
+        coordinator port per generation avoids TIME_WAIT rebind races;
+        a bind lost to the TOCTOU window surfaces as ExitCode.WORLD_FORM_FAILED
+        and is retried budget-free by the watch loop."""
+        if size is not None:
+            self._cohort_size = size
         self._cohort_coordinator = f"localhost:{free_port()}"
-        for p in range(self.cfg.num_processes):
+        for p in range(self._cohort_size):
             self._procs[p] = self._spawn(
                 0, relaunches=self._cohort_relaunches, process_id=p
             )
 
     def add_worker(self) -> int:
-        """Scale up by one worker (elastic scale-out)."""
+        """Scale up by one worker (elastic scale-out).
+
+        Cohort mode: a live jax.distributed world is fixed-size, so scale-out
+        is a deliberate re-formation — the watch loop tears the cohort down
+        at the next poll and respawns it one process larger (new coordinator,
+        new world version, state restored from the latest checkpoint; global
+        batch and LR are invariant — strong scaling). Returns the new target
+        size.
+        """
         if self._cohort_mode:
-            # a live jax.distributed world is fixed-size; scale-out means a
-            # new cohort generation with a larger num_processes, not an
-            # extra member joining the running coordinator
-            raise RuntimeError(
-                "add_worker is not supported in cohort mode; change "
-                "num_processes and relaunch the cohort instead"
-            )
+            with self._lock:
+                target = (self._pending_resize or self._cohort_size) + 1
+                self._pending_resize = target
+                logger.info("cohort scale-out requested: -> %d processes", target)
+                return target
         with self._lock:
             wid = self._next_worker_id
             self._next_worker_id += 1
             self._procs[wid] = self._spawn(wid)
             return wid
+
+    def remove_worker(self) -> int:
+        """Scale down by one process (cohort mode): deliberate re-formation
+        at N-1, same mechanics as add_worker."""
+        if not self._cohort_mode:
+            raise RuntimeError("remove_worker only applies to cohort mode")
+        with self._lock:
+            target = max(1, (self._pending_resize or self._cohort_size) - 1)
+            self._pending_resize = target
+            logger.info("cohort scale-in requested: -> %d processes", target)
+            return target
 
     def kill_worker(
         self, worker_id: int, relaunch: bool = True, graceful: bool = False
@@ -149,6 +193,7 @@ class ProcessManager:
                 return False
             if not relaunch:
                 wp.relaunches = self.cfg.relaunch_max + 1
+                wp.no_relaunch = True
             if graceful:
                 wp.proc.terminate()
             else:
@@ -200,46 +245,158 @@ class ProcessManager:
                     )
             self._stop.wait(poll_s)
 
+    def _teardown_cohort(self, items, reason: str) -> None:
+        """Kill every member and reap; recover the leader's leased tasks via
+        membership so the new generation re-leases at the task boundary."""
+        if self._membership is not None:
+            self._membership.mark_dead(0, reason=reason)
+        for _, wp in items:
+            if wp.proc.poll() is None:
+                wp.proc.kill()
+        for _, wp in items:
+            try:
+                wp.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                pass
+
+    def _reform_cohort(self, new_size: int, old_size: int, reason: str) -> None:
+        """Spawn the next cohort generation, possibly at a different size
+        (dynamic world resizing — the rebuild of the reference's Horovod
+        re-rendezvous at a new world size, SURVEY §2.1/§3.4). The new world
+        restores from the latest checkpoint and keeps the global batch and
+        LR unchanged (strong scaling — only per-device slice sizes move)."""
+        t0 = time.time()
+        with self._lock:
+            self._procs.clear()
+            self._world_version += 1
+            if new_size != old_size:
+                # a deliberate resize opens a fresh in-place relaunch budget
+                self._cohort_relaunches = 0
+            self._spawn_cohort_locked(new_size)
+            self.reformation_log.append((t0, old_size, new_size))
+        if new_size != old_size:
+            logger.warning(
+                "cohort RESIZED %d -> %d processes (world v%d): %s",
+                old_size, new_size, self._world_version, reason,
+            )
+        else:
+            logger.warning(
+                "cohort relaunched at %d processes (world v%d): %s",
+                new_size, self._world_version, reason,
+            )
+
     def _watch_cohort_loop(self, poll_s: float) -> None:
         """Cohort semantics: the jax.distributed world is all-or-nothing —
         one dead member fails the others, so ANY failure tears the cohort
-        down and relaunches it whole (the new world restores from the last
-        checkpoint). The relaunch budget counts cohort generations."""
+        down and re-forms it whole (the new world restores from the last
+        checkpoint). Three re-formation flavors:
+
+        - in-place relaunch (same size) for transient crashes, up to
+          `relaunch_max` generations;
+        - budget-free retry for world-formation failures (all failed exits
+          are ExitCode.WORLD_FORM_FAILED — coordinator-port races), up to
+          `infra_retry_max`;
+        - RESIZE: on a member marked no-relaunch (permanently lost host), on
+          an exhausted relaunch budget, or on an operator add/remove_worker
+          request, the next generation runs at the NEW process count —
+          training continues at N-1 instead of stalling, or picks up the new
+          capacity at N+1. The job only fails when it cannot even run at
+          size 1.
+        """
         while not self._stop.is_set():
             with self._lock:
                 items = list(self._procs.items())
+                pending = self._pending_resize
             codes = {pid: wp.proc.poll() for pid, wp in items}
             failed = [
                 pid for pid, c in codes.items() if c is not None and c != 0
             ]
+            if not failed and self._infra_retries:
+                # the retried generation has stayed up: the incident is over,
+                # so the next one gets a full budget-free retry budget
+                last = self.reformation_log[-1][0] if self.reformation_log else 0.0
+                if time.time() - last > 60:
+                    self._infra_retries = 0
+                    logger.info("world formation recovered; infra retry budget reset")
             if failed and not self._job_finished_fn():
-                if self._membership is not None:
-                    self._membership.mark_dead(
-                        0, reason=f"cohort member(s) {failed} died"
+                members = dict(items)
+                lost = [pid for pid in failed if members[pid].no_relaunch]
+                infra = all(
+                    codes[pid] == ExitCode.WORLD_FORM_FAILED for pid in failed
+                )
+                # Decide the next generation's size and commit it to
+                # _cohort_size under ONE lock hold: a concurrent
+                # add/remove_worker landing during the (slow) teardown below
+                # then compounds on the new target instead of the stale size.
+                with self._lock:
+                    size = self._cohort_size
+                    if self._pending_resize == pending:
+                        self._pending_resize = None
+                    if pending is not None and pending != size:
+                        target = pending
+                        reason = (
+                            f"resize requested while member(s) {failed} died"
+                        )
+                    elif infra and self._infra_retries < self.infra_retry_max:
+                        self._infra_retries += 1
+                        target = size
+                        reason = (
+                            f"world-formation failure (infra retry "
+                            f"{self._infra_retries}/{self.infra_retry_max}, "
+                            f"budget-free)"
+                        )
+                    elif (
+                        not lost
+                        and self._cohort_relaunches < self.cfg.relaunch_max
+                    ):
+                        self._cohort_relaunches += 1
+                        target = size
+                        reason = (
+                            f"transient failure, generation "
+                            f"{self._cohort_relaunches}/{self.cfg.relaunch_max}"
+                        )
+                    else:
+                        # Permanently lost member(s) or exhausted budget:
+                        # continue at the surviving count instead of failing.
+                        # On budget exhaustion shrink by exactly 1 — a single
+                        # crash can cascade every member to a nonzero exit
+                        # (world collapse), so len(failed) overstates the loss.
+                        target = size - (len(lost) if lost else 1)
+                        reason = (
+                            "lost member(s) " + str(lost or failed)
+                            + ("" if lost else " with relaunch budget spent")
+                        )
+                    if target >= 1:
+                        self._cohort_size = target
+                    if not infra:
+                        # a formed-then-failed world proves the coordinator
+                        # path works: fresh infra budget for the next incident
+                        self._infra_retries = 0
+                self._teardown_cohort(
+                    items, reason=f"cohort member(s) {failed} died"
+                )
+                if target < 1:
+                    logger.error(
+                        "cohort cannot continue: no survivors to re-form"
                     )
-                for pid, wp in items:
-                    if wp.proc.poll() is None:
-                        wp.proc.kill()
-                for pid, wp in items:
-                    try:
-                        wp.proc.wait(timeout=30)
-                    except subprocess.TimeoutExpired:
-                        pass
-                if self._cohort_relaunches < self.cfg.relaunch_max:
-                    self._cohort_relaunches += 1
-                    logger.warning(
-                        "cohort member(s) %s died; relaunching cohort "
-                        "(generation %d/%d)",
-                        failed, self._cohort_relaunches, self.cfg.relaunch_max,
-                    )
-                    with self._lock:
-                        self._procs.clear()
-                        self._spawn_cohort_locked()
-                else:
-                    logger.error("cohort relaunch budget exhausted")
-                    for wp in self._procs.values():
+                    for wp in members.values():
                         wp.status = PodStatus.FAILED
                     return
+                self._reform_cohort(target, size, reason)
+            elif (
+                pending is not None
+                and pending != self._cohort_size
+                and not self._job_finished_fn()
+            ):
+                with self._lock:
+                    if self._pending_resize == pending:
+                        self._pending_resize = None
+                    old = self._cohort_size
+                    self._cohort_size = pending
+                self._teardown_cohort(
+                    items, reason=f"cohort resize to {pending}"
+                )
+                self._reform_cohort(pending, old, "operator resize request")
             elif all(c is not None for c in codes.values()) and codes:
                 for wp in self._procs.values():
                     wp.status = PodStatus.SUCCEEDED

@@ -219,12 +219,23 @@ class TaskDispatcher:
         already recovered from this worker and completed elsewhere)."""
         callbacks: List[Callable] = []
         with self._lock:
-            lease = self._doing.pop(task_id, None)
+            lease = self._doing.get(task_id)
             if lease is None:
                 logger.warning(
                     "stale/unknown task report: task=%d worker=%d", task_id, worker_id
                 )
                 return False
+            if lease.worker_id != worker_id:
+                # The lease expired and was re-leased to another worker; this
+                # report is from the original (stale) holder. Accepting it
+                # would retire records the new holder is still re-running —
+                # double-application under the preemption-drain protocol.
+                logger.warning(
+                    "rejecting report for task %d from worker %d: lease now "
+                    "held by worker %d", task_id, worker_id, lease.worker_id,
+                )
+                return False
+            del self._doing[task_id]
             task = lease.task
             if success:
                 if task.type == pb.TRAINING:

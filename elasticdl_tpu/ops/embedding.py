@@ -38,6 +38,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.constants import MeshAxis
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
 
 # Table rows are padded to a multiple of this so every device of any mesh up
 # to this many chips gets an equal shard (shard_map needs even shards).
@@ -90,11 +93,18 @@ def embedding_lookup(
     for a in axes:
         n_shards *= mesh.shape[a]
     if table.shape[0] % n_shards:
-        raise ValueError(
-            f"manual embedding lookup needs table rows ({table.shape[0]}) "
-            f"divisible by total shards ({n_shards}); pad the vocab "
-            f"(see padded_vocab / VOCAB_ALIGN)"
+        # The table's padded vocab is fixed at creation time (and baked into
+        # checkpoints), but dynamic world resizing can re-form the mesh with
+        # a shard count that doesn't divide it (e.g. 1792 rows over 6
+        # devices). shard_map needs even shards; XLA's auto partitioner does
+        # not — fall back to the auto schedule for this (rare) geometry.
+        logger.warning(
+            "table rows (%d) not divisible by %d shards; using auto-sharded "
+            "lookup for this mesh (align the vocab via padded_vocab for the "
+            "manual schedule)", table.shape[0], n_shards,
         )
+        out = jnp.take(table, safe_ids, axis=0)
+        return jnp.where(in_range[..., None], out, 0.0)
 
     ids2d = safe_ids.reshape(safe_ids.shape[0], -1)  # (B, L)
 

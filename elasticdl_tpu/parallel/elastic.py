@@ -128,8 +128,18 @@ def make_global_batch(mesh, batch: Any, partition=None) -> Any:
 
 def context_from_env(cfg) -> Optional[CohortContext]:
     """Build the context for this process from config + env (the process
-    manager exports EDL_PROCESS_ID per spawned cohort member)."""
-    if cfg.num_processes <= 1:
+    manager exports EDL_PROCESS_ID per spawned cohort member).
+
+    `EDL_NUM_PROCESSES` overrides `cfg.num_processes`: dynamic world
+    resizing re-forms the cohort at a DIFFERENT size than the config's
+    original — the manager tells each member the new world size through the
+    environment so the argv (which is the job's immutable config) stays
+    untouched. `EDL_WORLD_VERSION` carries the generation counter for logs
+    and LR-rescale decisions. A resized-to-1 cohort is still a cohort
+    (EDL_PROCESS_ID present), so the override may legitimately be 1.
+    """
+    n = int(os.environ.get("EDL_NUM_PROCESSES", "0") or 0) or cfg.num_processes
+    if n <= 1 and "EDL_PROCESS_ID" not in os.environ:
         return None
     pid = int(os.environ.get("EDL_PROCESS_ID", "0"))
     addr = (
@@ -137,4 +147,5 @@ def context_from_env(cfg) -> Optional[CohortContext]:
         or cfg.coordinator_addr
         or "localhost:29400"
     )
-    return CohortContext(addr, cfg.num_processes, pid)
+    version = int(os.environ.get("EDL_WORLD_VERSION", "0") or 0)
+    return CohortContext(addr, n, pid, world_version=version)

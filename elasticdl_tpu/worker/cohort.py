@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import ExitCode
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.parallel.elastic import (
@@ -51,6 +52,7 @@ logger = default_logger(__name__)
 OP_NOOP, OP_TASK, OP_DONE, OP_ABORT = 0, 1, 2, 3
 FLAG_CHECKPOINT = 1
 CTRL_LEN = 8
+
 
 
 class CohortWorker:
@@ -151,6 +153,20 @@ class CohortWorker:
                     "cohort resumed from checkpoint at step %d",
                     self._last_ckpt_step,
                 )
+        if self.ctx.num_processes != self.cfg.num_processes:
+            # Dynamic resizing does NOT change the effective global batch in
+            # cohort mode: every generation consumes the same
+            # cfg.minibatch_size rows per step (make_global_batch hands each
+            # device a slice of one identical host batch), so the linear
+            # LR-scaling rule does not apply — only per-device slice size
+            # changed. This differs from independent (non-cohort) workers,
+            # where worker count multiplies the global batch and
+            # worker.py DOES rescale via lr_modulation.linear_scale.
+            logger.info(
+                "cohort world resized %d -> %d processes; global batch and "
+                "LR unchanged (strong scaling)",
+                self.cfg.num_processes, self.ctx.num_processes,
+            )
 
     # ------------------------------------------------------------------ #
     # leader-only: master RPCs
@@ -307,7 +323,15 @@ class CohortWorker:
     # ------------------------------------------------------------------ #
 
     def run(self) -> int:
-        self.ctx.initialize()
+        try:
+            self.ctx.initialize()
+        except Exception:
+            logger.exception(
+                "world formation failed (coordinator %s, process %d/%d)",
+                self.ctx.coordinator_addr, self.ctx.process_id,
+                self.ctx.num_processes,
+            )
+            return ExitCode.WORLD_FORM_FAILED
         try:
             self._build()
             if self.ctx.is_leader:
@@ -344,7 +368,7 @@ class CohortWorker:
             # heartbeat lapse marked the leader dead and our tasks were
             # requeued): exit EX_TEMPFAIL so the manager relaunches the
             # cohort; a clean 0 would read as success and end all watching.
-            return 0 if op == OP_DONE else 75
+            return 0 if op == OP_DONE else ExitCode.COHORT_EVICTED
         finally:
             self.ctx.shutdown()
 

@@ -6,6 +6,7 @@ argv the master/launcher passed, build the Worker, run the task loop.
 
 from __future__ import annotations
 
+import os
 import signal
 import sys
 from typing import List, Optional
@@ -16,7 +17,9 @@ from elasticdl_tpu.worker.worker import Worker
 
 def main(argv: Optional[List[str]] = None) -> int:
     cfg = JobConfig.from_argv(sys.argv[1:] if argv is None else argv)
-    if cfg.num_processes > 1:
+    # EDL_PROCESS_ID marks a cohort member even when dynamic resizing has
+    # shrunk the world to 1 process (cfg.num_processes is the ORIGINAL size)
+    if cfg.num_processes > 1 or "EDL_PROCESS_ID" in os.environ:
         # SPMD cohort member: no drain on SIGTERM — a draining leader would
         # deadlock followers blocked on the next control broadcast; exit
         # EX_TEMPFAIL so the manager relaunches the whole cohort, which

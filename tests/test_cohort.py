@@ -45,7 +45,7 @@ def job_config(tmp_path, **overrides):
     return JobConfig(**base)
 
 
-def run_job(cfg, tmp_path, mid_job=None, timeout_s=420):
+def run_job(cfg, tmp_path, mid_job=None, timeout_s=420, return_all=False):
     master = Master(cfg)
     manager = ProcessManager(
         cfg,
@@ -68,7 +68,8 @@ def run_job(cfg, tmp_path, mid_job=None, timeout_s=420):
         assert master.dispatcher.finished(), (
             master.dispatcher.counts(), all_logs(tmp_path)[-3000:],
         )
-        return master.dispatcher.counts()
+        counts = master.dispatcher.counts()
+        return (master, manager, counts) if return_all else counts
     finally:
         master.shutdown()
         manager.stop()
@@ -116,3 +117,67 @@ def test_cohort_member_kill_relaunches_and_resumes(tmp_path):
     assert counts["failed_permanently"] == 0
     log = all_logs(tmp_path)
     assert "cohort resumed from checkpoint at step" in log, log[-3000:]
+
+
+def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
+    """Dynamic world resizing, scale-in: a member dies with the relaunch
+    budget already spent — instead of stalling/failing, the cohort re-forms
+    at N-1 and finishes the job with exactly-once task accounting
+    (SURVEY §2.1 rendezvous re-formation at a new world size)."""
+    cfg = job_config(
+        tmp_path,
+        training_data="synthetic://criteo?n=8192&shards=8",
+        records_per_task=1024,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=8,
+        relaunch_max=0,  # budget spent from the start: loss must resize
+    )
+    def kill_follower(master, manager):
+        if master.dispatcher.counts()["finished_training"] < 2:
+            return False
+        wp = manager._procs.get(1)
+        if wp is None or wp.proc.poll() is not None:
+            return False
+        wp.proc.kill()
+        return True
+
+    master, manager, counts = run_job(
+        cfg, tmp_path, mid_job=kill_follower, return_all=True
+    )
+    assert counts["finished_training"] == 8
+    assert counts["failed_permanently"] == 0
+    assert manager.cohort_size == 1
+    # one re-formation, from 2 to 1 processes
+    assert [(o, n) for _, o, n in manager.reformation_log] == [(2, 1)]
+    log = all_logs(tmp_path)
+    assert "up: process 0/1" in log  # the new one-process world formed
+    assert "cohort resumed from checkpoint at step" in log
+
+
+def test_cohort_scales_up_on_add_worker(tmp_path):
+    """Dynamic world resizing, scale-out: add_worker mid-job re-forms the
+    cohort at N+1 (fresh coordinator, new world version, checkpoint restore)
+    and the job completes with all tasks accounted for."""
+    cfg = job_config(
+        tmp_path,
+        training_data="synthetic://criteo?n=8192&shards=8",
+        records_per_task=1024,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=8,
+    )
+
+    def scale_up(master, manager):
+        if master.dispatcher.counts()["finished_training"] < 2:
+            return False
+        assert manager.add_worker() == 3
+        return True
+
+    master, manager, counts = run_job(
+        cfg, tmp_path, mid_job=scale_up, return_all=True
+    )
+    assert counts["finished_training"] == 8
+    assert counts["failed_permanently"] == 0
+    assert manager.cohort_size == 3
+    assert [(o, n) for _, o, n in manager.reformation_log] == [(2, 3)]
+    log = all_logs(tmp_path)
+    assert "up: process 2/3" in log  # the third member joined the new world

@@ -91,9 +91,28 @@ def test_recover_tasks_on_worker_death():
     # task went back to the front of todo; worker 1's lease is intact
     t_again = d.get(2)
     assert t_again.task_id == t0.task_id
-    # stale report from the dead worker is rejected
-    assert not d.report(t0.task_id, 0, True) or True  # id re-leased: report accepted for new lease
+    # stale report from the dead worker is rejected: the lease is now held
+    # by worker 2, and only worker 2's report may retire it
+    assert not d.report(t0.task_id, 0, True)
+    assert d.report(t0.task_id, 2, True)
     assert d.report(t1.task_id, 1, True)
+
+
+def test_stale_drain_report_cannot_pop_releases_lease():
+    """A drained worker's preempted report must not retire a task whose
+    lease has since moved to another worker (double-application hazard)."""
+    d = make(num_records=10, rpt=10, task_timeout_s=0.05)
+    t = d.get(0)
+    time.sleep(0.1)           # worker 0's lease expires
+    t2 = d.get(1)             # re-leased to worker 1
+    assert t2.task_id == t.task_id
+    # worker 0's late drain report is rejected and worker 1's lease survives
+    assert not d.report(t.task_id, 0, False, preempted=True, records_processed=4)
+    assert d.counts()["doing"] == 1
+    assert d.report(t2.task_id, 1, True)
+    while (rest := d.get(1)) is not None:
+        assert d.report(rest.task_id, 1, True)
+    assert d.finished()
 
 
 def test_stale_report_rejected():
