@@ -30,15 +30,26 @@ def _launch(cfg: JobConfig) -> int:
     return run_local(cfg)
 
 
+def _require_data(cfg: JobConfig, field: str, verb: str) -> None:
+    """Verb-specific data-flag validation (round-3, VERDICT #8): a missing
+    data path used to surface deep in the master as an opaque reader error;
+    fail at the verb boundary with the flag name instead."""
+    if not getattr(cfg, field):
+        raise ValueError(f"`{verb}` requires --{field}")
+
+
 def train(cfg: JobConfig) -> int:
+    _require_data(cfg, "training_data", "train")
     return _launch(cfg)
 
 
 def evaluate(cfg: JobConfig) -> int:
+    _require_data(cfg, "validation_data", "evaluate")
     return _launch(cfg.replace(job_type=JobType.EVALUATION_ONLY))
 
 
 def predict(cfg: JobConfig) -> int:
+    _require_data(cfg, "prediction_data", "predict")
     return _launch(cfg.replace(job_type=JobType.PREDICTION_ONLY))
 
 

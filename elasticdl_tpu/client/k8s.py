@@ -28,7 +28,11 @@ from typing import Any, Dict, List
 import yaml
 
 from elasticdl_tpu.common.config import JobConfig
-from elasticdl_tpu.common.constants import DEFAULT_MASTER_PORT
+from elasticdl_tpu.common.constants import (
+    DEFAULT_MASTER_PORT,
+    TPU_TYPES as _TPU_TYPES,
+    WorkerEnv,
+)
 from elasticdl_tpu.common.log_utils import default_logger
 
 logger = default_logger(__name__)
@@ -100,29 +104,17 @@ def render_master_service(cfg: JobConfig) -> Dict[str, Any]:
     }
 
 
-# TPU accelerator type → (gke accelerator label, topology, hosts, chips/host)
-TPU_TYPES = {
-    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 1, 4),
-    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 2, 4),
-    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 4, 4),
-    "v5e-32": ("tpu-v5-lite-podslice", "4x8", 8, 4),
-    "v5e-64": ("tpu-v5-lite-podslice", "8x8", 16, 4),
-    "v5p-8": ("tpu-v5p-slice", "2x2x1", 2, 4),
-    "v4-8": ("tpu-v4-podslice", "2x2x1", 2, 4),
-}
+# TPU accelerator type map — canonical copy in common/constants.py so config
+# validation can reason about slice shape without this module
+TPU_TYPES = _TPU_TYPES
 
 
-def render_worker_statefulset(cfg: JobConfig) -> List[Dict[str, Any]]:
-    """Workers as a StatefulSet over the TPU slice's hosts."""
-    name = f"{cfg.job_name}-worker"
-    master_svc = f"{cfg.job_name}-master"
-    port = int(cfg.master_addr.rsplit(":", 1)[1]) if ":" in cfg.master_addr else DEFAULT_MASTER_PORT
-    worker_cfg = cfg.replace(master_addr=f"{master_svc}:{port}")
-    args = worker_cfg.to_argv()
-
+def _tpu_scheduling(cfg: JobConfig) -> tuple:
+    """Shared TPU scheduling block for both worker flavors: returns
+    (node_selector, resources, hosts_in_slice or None)."""
     node_selector: Dict[str, str] = {}
     resources = _parse_resources(cfg.worker_resource_request)
-    replicas = cfg.num_workers
+    hosts = None
     if cfg.tpu_type:
         if cfg.tpu_type not in TPU_TYPES:
             raise ValueError(
@@ -134,7 +126,56 @@ def render_worker_statefulset(cfg: JobConfig) -> List[Dict[str, Any]]:
             "cloud.google.com/gke-tpu-topology": topology,
         }
         resources["google.com/tpu"] = str(chips)
+    return node_selector, resources, hosts
+
+
+def render_worker_statefulset(cfg: JobConfig) -> List[Dict[str, Any]]:
+    """Workers as a StatefulSet over the TPU slice's hosts."""
+    name = f"{cfg.job_name}-worker"
+    master_svc = f"{cfg.job_name}-master"
+    port = int(cfg.master_addr.rsplit(":", 1)[1]) if ":" in cfg.master_addr else DEFAULT_MASTER_PORT
+    worker_cfg = cfg.replace(master_addr=f"{master_svc}:{port}")
+    args = worker_cfg.to_argv()
+
+    node_selector, resources, hosts = _tpu_scheduling(cfg)
+    replicas = cfg.num_workers
+    extra_env = {"EDL_COORDINATOR_ADDR": f"{name}-0.{name}:8471"}
+    if hosts is None and cfg.num_processes > 1:
+        # explicit multi-process cohort without a TPU slice pinning the host
+        # count (CPU/GPU nodes, or TPU via custom selectors): one replica per
+        # cohort process, ids from StatefulSet ordinals — without this the
+        # world has no process ids and never forms
+        replicas = cfg.num_processes
+        extra_env["EDL_PROCESS_ID_FROM_HOSTNAME"] = "1"
+    elif hosts == 1 and cfg.num_processes > 1:
+        raise ValueError(
+            f"tpu_type={cfg.tpu_type} is a single-host slice: it runs ONE "
+            f"process owning all its chips (num_processes=1), got "
+            f"num_processes={cfg.num_processes}"
+        )
+    if hosts is not None:
+        if cfg.num_workers not in (1, hosts):
+            logger.warning(
+                "tpu_type=%s pins the worker count to its host count (%d); "
+                "ignoring num_workers=%d", cfg.tpu_type, hosts, cfg.num_workers,
+            )
         replicas = hosts
+        if hosts > 1:
+            # A multi-host slice is ONE SPMD cohort — plain workers here
+            # would train `hosts` divergent replicas, the exact hole
+            # JobConfig.validate closes for num_workers (the renderer must
+            # enforce it too, since it, not the config, decides replicas).
+            if cfg.num_processes not in (1, hosts):
+                raise ValueError(
+                    f"tpu_type={cfg.tpu_type} is a {hosts}-host slice: "
+                    f"num_processes must be {hosts} (or 1 for auto), got "
+                    f"{cfg.num_processes}"
+                )
+            worker_cfg = worker_cfg.replace(num_processes=hosts)
+            args = worker_cfg.to_argv()
+            # each pod derives its cohort process id from its StatefulSet
+            # ordinal (parallel/elastic.context_from_env)
+            extra_env["EDL_PROCESS_ID_FROM_HOSTNAME"] = "1"
 
     headless = {
         "apiVersion": "v1",
@@ -185,12 +226,7 @@ def render_worker_statefulset(cfg: JobConfig) -> List[Dict[str, Any]]:
                                 k: v for k, v in resources.items()
                                 if k == "google.com/tpu"
                             }},
-                            "env": _env_list(
-                                worker_cfg,
-                                {
-                                    "EDL_COORDINATOR_ADDR": f"{name}-0.{name}:8471",
-                                },
-                            ),
+                            "env": _env_list(worker_cfg, extra_env),
                         }
                     ],
                 },
@@ -200,12 +236,75 @@ def render_worker_statefulset(cfg: JobConfig) -> List[Dict[str, Any]]:
     return [headless, sts]
 
 
+def render_worker_pod(
+    cfg: JobConfig, worker_id: int, pod_name: str = "",
+) -> Dict[str, Any]:
+    """One master-managed worker pod (reference parity: the instance
+    manager's create_worker — pods created/relaunched one by one by the
+    master, unlike the StatefulSet flavor where k8s owns replacement). Used
+    by master/k8s_instance_manager.py, which passes generation-suffixed
+    `pod_name`s so relaunches are new pod objects; restartPolicy=Never
+    because relaunch accounting lives in the manager's budget, not the
+    kubelet."""
+    master_svc = f"{cfg.job_name}-master"
+    port = int(cfg.master_addr.rsplit(":", 1)[1]) if ":" in cfg.master_addr else DEFAULT_MASTER_PORT
+    worker_cfg = cfg.replace(master_addr=f"{master_svc}:{port}")
+    node_selector, resources, hosts = _tpu_scheduling(cfg)
+    if hosts is not None and hosts > 1:
+        # a multi-host slice is one SPMD cohort; managed pods have no cohort
+        # addressing (see JobConfig.validate on instance_manager) — only the
+        # StatefulSet flavor can host it
+        raise ValueError(
+            f"tpu_type={cfg.tpu_type} is a {hosts}-host slice and needs the "
+            "StatefulSet worker flavor (instance_manager=''), not "
+            "master-managed pods"
+        )
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name or f"{cfg.job_name}-worker-{worker_id}",
+            "namespace": cfg.namespace,
+            "labels": {
+                JOB_LABEL: cfg.job_name,
+                "app": "elasticdl-tpu",
+                "role": "worker",
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "nodeSelector": node_selector,
+            "containers": [
+                {
+                    "name": "worker",
+                    "image": cfg.image_name,
+                    "imagePullPolicy": cfg.image_pull_policy,
+                    "command": ["python", "-m", "elasticdl_tpu.worker.main"],
+                    "args": worker_cfg.to_argv(),
+                    "resources": {"requests": resources, "limits": {
+                        k: v for k, v in resources.items()
+                        if k == "google.com/tpu"
+                    }},
+                    "env": _env_list(
+                        worker_cfg, {WorkerEnv.WORKER_ID: str(worker_id)}
+                    ),
+                }
+            ],
+        },
+    }
+
+
 def render_job_manifests(cfg: JobConfig) -> List[Dict[str, Any]]:
-    return [
-        render_master_pod(cfg),
-        render_master_service(cfg),
-        *render_worker_statefulset(cfg),
-    ]
+    """Two worker-deployment flavors: the default renders workers as a
+    StatefulSet (k8s owns replacement; right for TPU slices provisioned as a
+    unit); --instance_manager=k8s renders ONLY the master, which then
+    creates/watches/relaunches worker pods itself through
+    master/k8s_instance_manager.py (the reference's instance-manager shape —
+    the flag rides to the master through the pod args via to_argv)."""
+    manifests = [render_master_pod(cfg), render_master_service(cfg)]
+    if cfg.instance_manager != "k8s":
+        manifests += render_worker_statefulset(cfg)
+    return manifests
 
 
 def submit(cfg: JobConfig) -> int:

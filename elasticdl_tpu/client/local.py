@@ -41,6 +41,8 @@ def run_local(
         extra_env=extra_env,
         log_dir=log_dir,
         job_finished_fn=master.dispatcher.finished,
+        # planned resizes quiesce through the heartbeat should_checkpoint bit
+        checkpoint_request_fn=lambda: master.servicer.request_checkpoint(0),
     )
     master.start()
     manager.start_workers()

@@ -89,8 +89,9 @@ class JobConfig:
     shuffle: bool = True
     shuffle_seed: int = 0
 
-    # --- evaluation ---
-    evaluation_steps: int = 0      # 0 = evaluate at epoch end only
+    # --- evaluation (units: model-version steps = minibatches, matching the
+    # reference's --evaluation_steps; 0 = evaluate at epoch end only) ---
+    evaluation_steps: int = 0
     evaluation_start_delay_steps: int = 0
 
     # --- checkpointing (reference: --checkpoint_steps etc.) ---
@@ -108,7 +109,18 @@ class JobConfig:
     # transfer bytes; lossless for bf16-compute models — see data/prefetch)
     wire_dtype: str = ""
 
+    # --- profiling (SURVEY §5 tracing; the reference had no in-repo tracer,
+    # jax.profiler makes this nearly free) ---
+    profile_dir: str = ""          # "" = off; else jax.profiler trace output
+    profile_start_step: int = 5    # skip compile + warmup steps
+    profile_steps: int = 20        # trace this many steps, then stop
+
     # --- cluster shape / elasticity ---
+    # Who owns worker lifecycles: "" = the launcher (local subprocess
+    # manager, or the k8s StatefulSet's own self-healing); "k8s" = the MASTER
+    # creates/watches/relaunches worker pods through the k8s API — the
+    # reference's k8s_instance_manager flavor (master/k8s_instance_manager.py)
+    instance_manager: str = ""
     num_workers: int = 1
     # >1 = multi-process SPMD cohort: one jax.distributed world + one global
     # mesh across this many processes (worker/cohort.py). The master sees one
@@ -152,6 +164,55 @@ class JobConfig:
             raise ValueError("minibatch_size must be positive")
         if self.num_workers <= 0:
             raise ValueError("num_workers must be positive")
+        if self.num_processes <= 0:
+            raise ValueError("num_processes must be positive")
+        if self.instance_manager not in ("", "k8s"):
+            raise ValueError(
+                f"instance_manager must be '' or 'k8s', got "
+                f"{self.instance_manager!r}"
+            )
+        if self.instance_manager == "k8s" and self.num_processes > 1:
+            # the master-managed-pod flavor has no per-pod cohort addressing
+            # (coordinator DNS + stable process ids) — without this guard the
+            # worker pod's jax.distributed init waits forever for peers that
+            # were never created
+            raise ValueError(
+                "instance_manager='k8s' manages plain worker pods and cannot "
+                "form an SPMD cohort; for num_processes>1 use the default "
+                "StatefulSet flavor (stable ordinals + headless service)"
+            )
+        if self.instance_manager == "k8s" and self.tpu_type:
+            from elasticdl_tpu.common.constants import TPU_TYPES
+
+            hosts = TPU_TYPES.get(self.tpu_type, (None, None, 1, None))[2]
+            if hosts > 1:
+                # statically knowable at submit time — failing here beats the
+                # master discovering it pod-by-pod minutes later in-cluster
+                raise ValueError(
+                    f"tpu_type={self.tpu_type} is a {hosts}-host slice (one "
+                    "SPMD cohort); instance_manager='k8s' manages plain "
+                    "single-host pods — use the default StatefulSet flavor"
+                )
+        is_training = self.job_type in (
+            JobType.TRAINING_ONLY, JobType.TRAINING_WITH_EVALUATION
+        )
+        if is_training and self.num_workers > 1:
+            # N independent worker processes would each hold their own model
+            # replica with NO gradient exchange (and only worker 0 would
+            # checkpoint) — silently-divergent training. The reference's
+            # semantic is one shared model across workers (SURVEY §3.3);
+            # here that is the SPMD cohort: one jax.distributed world of
+            # `num_processes` processes behind a single logical worker.
+            raise ValueError(
+                f"num_workers={self.num_workers} with a training job would "
+                "train num_workers INDEPENDENT model replicas (gradients are "
+                "never exchanged between plain workers). For data-parallel "
+                f"training use the SPMD cohort: num_processes="
+                f"{self.num_workers} (and num_workers=1). Plain "
+                "num_workers>1 is only valid for evaluation_only / "
+                "prediction_only jobs, whose tasks are embarrassingly "
+                "parallel."
+            )
 
     # --- argv round-trip ------------------------------------------------ #
 

@@ -80,3 +80,16 @@ class MeshAxis:
 
 
 DEFAULT_MASTER_PORT = 50001
+
+# TPU accelerator type → (gke accelerator label, topology, hosts, chips/host).
+# Lives here (not client/k8s.py) so config validation can reason about slice
+# shape without importing the client layer.
+TPU_TYPES = {
+    "v5e-4": ("tpu-v5-lite-podslice", "2x2", 1, 4),
+    "v5e-8": ("tpu-v5-lite-podslice", "2x4", 2, 4),
+    "v5e-16": ("tpu-v5-lite-podslice", "4x4", 4, 4),
+    "v5e-32": ("tpu-v5-lite-podslice", "4x8", 8, 4),
+    "v5e-64": ("tpu-v5-lite-podslice", "8x8", 16, 4),
+    "v5p-8": ("tpu-v5p-slice", "2x2x1", 2, 4),
+    "v4-8": ("tpu-v4-podslice", "2x2x1", 2, 4),
+}

@@ -1,0 +1,90 @@
+"""Build-and-load for the in-repo C++ data-plane libraries.
+
+Reference parity: the reference shipped its native record machinery as the
+external `pyrecordio` C++ package (SURVEY §2.7 item 3); the rebuild keeps the
+native code in-tree as single-translation-unit libraries that auto-build with
+g++ on first use (a few hundred ms, no deps), with pure-Python twins when no
+toolchain is present.
+
+Shared by data/recordio.py (libedlrecordio.so, explicit path) and
+data/parsing.py (load_shared("batch_parse") -> libbatch_parse.so): one lock,
+one failure memo per library, atomic temp-then-rename so concurrent
+master/worker processes never dlopen a half-written .so.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, Optional
+
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+
+_lock = threading.Lock()
+_build_failed: Dict[str, bool] = {}
+
+
+def build_shared(src: str, lib_path: str, force: bool = False) -> Optional[str]:
+    """Compile `src` into `lib_path` with g++ if missing/stale. Returns the
+    library path, or None when no usable library can be produced. A failed
+    build is remembered per-library so N opens don't pay N compiles."""
+    with _lock:
+        have_lib = os.path.exists(lib_path)
+        if have_lib and not force:
+            # A shipped .so without source (or newer than it) is used as-is.
+            try:
+                fresh = os.path.getmtime(lib_path) >= os.path.getmtime(src)
+            except OSError:
+                fresh = True
+            if fresh:
+                return lib_path
+        if _build_failed.get(lib_path) and not force:
+            return lib_path if have_lib else None
+        tmp = f"{lib_path}.{os.getpid()}.tmp"
+        try:
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, lib_path)
+            logger.info("built native library: %s", lib_path)
+            _build_failed[lib_path] = False
+            return lib_path
+        except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
+            _build_failed[lib_path] = True
+            if have_lib:
+                # Stale-but-loadable beats the pure-Python fallback.
+                logger.warning(
+                    "native rebuild failed (%s); using existing %s", e, lib_path
+                )
+                return lib_path
+            logger.warning("native build failed for %s (%s); pure-python path", src, e)
+            return None
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+
+
+def load_shared(name: str, force_build: bool = False) -> Optional[ctypes.CDLL]:
+    """Build (if needed) and dlopen native/<name>.cc -> native/lib<name>.so."""
+    src = os.path.join(NATIVE_DIR, f"{name}.cc")
+    lib_path = os.path.join(NATIVE_DIR, f"lib{name}.so")
+    path = build_shared(src, lib_path, force=force_build)
+    if path is None:
+        return None
+    try:
+        return ctypes.CDLL(path)
+    except OSError as e:
+        logger.warning("dlopen(%s) failed: %s", path, e)
+        return None

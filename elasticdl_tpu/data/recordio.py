@@ -18,21 +18,18 @@ import ctypes
 import glob
 import os
 import struct
-import subprocess
-import threading
 import zlib
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.data import nativelib
 from elasticdl_tpu.data.reader import AbstractDataReader, Shard
 
 logger = default_logger(__name__)
 
-_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_NATIVE_DIR = nativelib.NATIVE_DIR
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libedlrecordio.so")
-_build_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
-_build_failed = False
 _FILE_MAGIC = b"EDLR"
 _CHUNK_MAGIC = b"CHNK"
 _INDEX_MAGIC = b"INDX"
@@ -40,54 +37,10 @@ _VERSION = 1
 
 
 def build_native(force: bool = False) -> Optional[str]:
-    """Compile libedlrecordio.so with g++ if missing. Returns path or None.
-    A failed build is remembered so N shard opens don't pay N compiles."""
-    global _build_failed
-    with _build_lock:
-        src = os.path.join(_NATIVE_DIR, "recordio.cc")
-        have_lib = os.path.exists(_LIB_PATH)
-        if have_lib and not force:
-            # A shipped .so without source (or newer than it) is used as-is.
-            try:
-                fresh = os.path.getmtime(_LIB_PATH) >= os.path.getmtime(src)
-            except OSError:
-                fresh = True
-            if fresh:
-                return _LIB_PATH
-        if _build_failed and not force:
-            return _LIB_PATH if have_lib else None
-        # Master and workers may all build concurrently on first run; compile
-        # to a per-pid temp file and rename into place (atomic on POSIX) so no
-        # process ever dlopens a half-written .so.
-        tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
-        try:
-            subprocess.run(
-                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
-            os.replace(tmp, _LIB_PATH)
-            logger.info("built native recordio: %s", _LIB_PATH)
-            _build_failed = False
-            return _LIB_PATH
-        except (subprocess.SubprocessError, FileNotFoundError, OSError) as e:
-            _build_failed = True
-            if have_lib:
-                # Stale-but-loadable beats the pure-Python fallback.
-                logger.warning(
-                    "native recordio rebuild failed (%s); using existing %s",
-                    e, _LIB_PATH,
-                )
-                return _LIB_PATH
-            logger.warning("native recordio build failed (%s); using pure python", e)
-            return None
-        finally:
-            if os.path.exists(tmp):
-                try:
-                    os.remove(tmp)
-                except OSError:
-                    pass
+    """Compile libedlrecordio.so with g++ if missing (delegates to the shared
+    builder in data/nativelib.py). Returns path or None."""
+    src = os.path.join(_NATIVE_DIR, "recordio.cc")
+    return nativelib.build_shared(src, _LIB_PATH, force=force)
 
 
 def _load_lib() -> Optional[ctypes.CDLL]:

@@ -51,6 +51,7 @@ class EvaluationService:
         self._next_job_id = 0
         self._jobs: Dict[int, _EvalJob] = {}
         self._last_trigger_version = 0
+        self._latest_model_version = 0
         self._latest_results: Dict[str, float] = {}
         self._result_callbacks: List[Callable[[int, Dict[str, float]], None]] = []
         dispatcher.add_epoch_end_callback(self._on_epoch_end)
@@ -65,16 +66,40 @@ class EvaluationService:
 
     # ------------------------------------------------------------------ #
 
-    def maybe_trigger(self) -> Optional[int]:
+    def maybe_trigger(self, model_version: Optional[int] = None) -> Optional[int]:
         """Called after each finished training task; starts an eval job every
-        `evaluation_steps` completed tasks. The threshold check claims
-        `_last_trigger_version` under the lock so concurrent report handlers
-        can't double-trigger."""
+        `evaluation_steps` MODEL-VERSION steps (minibatches — the reference's
+        unit for --evaluation_steps; workers report their model_version with
+        each task result, so the servicer passes it here). Falls back to the
+        completed-task counter when no version is supplied (tests, legacy
+        callers). The threshold check claims `_last_trigger_version` under
+        the lock so concurrent report handlers can't double-trigger."""
+        version = (
+            model_version
+            if model_version is not None
+            else self._dispatcher.completed_versions
+        )
+        with self._lock:
+            # tracked even when interval evals are off: epoch-end evals use
+            # it so their scalars land on the same model_version axis as the
+            # train-loss stream
+            self._latest_model_version = max(self._latest_model_version, version)
         if not self._evaluation_steps:
             return None
-        version = self._dispatcher.completed_versions
         with self._lock:
             if version < self._start_delay:
+                return None
+            if version < self._last_trigger_version:
+                # the step counter went BACKWARDS: a worker relaunched
+                # without a checkpoint to restore (fresh model_version).
+                # Re-base the threshold or evals would silently stop for
+                # last_trigger_version - version further steps.
+                logger.warning(
+                    "model_version regressed %d -> %d (worker relaunch "
+                    "without restore); re-basing eval trigger",
+                    self._last_trigger_version, version,
+                )
+                self._last_trigger_version = version
                 return None
             if version - self._last_trigger_version < self._evaluation_steps:
                 return None
@@ -82,7 +107,11 @@ class EvaluationService:
         return self.trigger(version)
 
     def _on_epoch_end(self, epoch: int) -> None:
-        self.trigger(self._dispatcher.completed_versions)
+        with self._lock:
+            version = max(
+                self._latest_model_version, self._dispatcher.completed_versions
+            )
+        self.trigger(version)
 
     def trigger(self, model_version: int) -> Optional[int]:
         # register the job BEFORE its tasks hit the queue — a fast worker can

@@ -27,9 +27,13 @@ logger = default_logger(__name__)
 
 
 class Master:
-    def __init__(self, cfg: JobConfig):
+    def __init__(self, cfg: JobConfig, k8s_api=None):
         cfg.validate()
         self.cfg = cfg
+        # cfg.instance_manager == "k8s": this master owns worker pods
+        # (created in start()); k8s_api injects a fake for tests
+        self._k8s_api = k8s_api
+        self.instance_manager = None
 
         def shards_for(path: str):
             if not path:
@@ -62,14 +66,19 @@ class Master:
             shuffle=cfg.shuffle,
             shuffle_seed=cfg.shuffle_seed,
             task_timeout_s=cfg.task_timeout_s,
+            # end-of-job durability: one exclusive SAVE_MODEL task before
+            # job-end whenever training checkpoints somewhere (SURVEY §2.1)
+            final_save_model=bool(cfg.checkpoint_dir) and bool(train_shards),
         )
         self.membership = Membership(heartbeat_timeout_s=3 * cfg.worker_heartbeat_s)
         self.membership.add_death_callback(self.dispatcher.recover_tasks)
 
         metrics = None
-        if eval_shards:
+        callbacks = []
+        if eval_shards or cfg.model_def:
             # the master loads the model module too — it owns metric
-            # finalization (reference: the master's evaluation service)
+            # finalization and job-level callbacks (reference: the master's
+            # evaluation service + the zoo callbacks() contract)
             from elasticdl_tpu.common.model_utils import get_module_attr, load_module
 
             module, _ = load_module(cfg.model_zoo, cfg.model_def)
@@ -77,6 +86,8 @@ class Master:
                 module, "eval_metrics_fn", cfg.eval_metrics_fn, required=False
             )
             metrics = dict(metrics_fn()) if metrics_fn else {}
+            callbacks_fn = get_module_attr(module, "callbacks", "", required=False)
+            callbacks = list(callbacks_fn()) if callbacks_fn else []
         self.evaluation: Optional[EvaluationService] = (
             EvaluationService(
                 self.dispatcher,
@@ -98,6 +109,26 @@ class Master:
             self.dispatcher, self.membership, self.evaluation,
             summary_service=self.summary,
         )
+        # Zoo callbacks observe job events and act via JobContext (round-3:
+        # callbacks() was collected but never invoked — now wired).
+        self.callbacks = callbacks
+        if callbacks:
+            from elasticdl_tpu.api.callbacks import JobContext
+
+            ctx = JobContext(
+                self.dispatcher, servicer=self.servicer,
+                evaluation=self.evaluation,
+            )
+            for cb in callbacks:
+                if hasattr(cb, "set_context"):
+                    cb.set_context(ctx)
+                if self.evaluation is not None and hasattr(cb, "on_eval_result"):
+                    self.evaluation.add_result_callback(cb.on_eval_result)
+                if hasattr(cb, "on_epoch_end"):
+                    self.dispatcher.add_epoch_end_callback(cb.on_epoch_end)
+                if hasattr(cb, "on_job_end"):
+                    self.dispatcher.add_job_end_callback(cb.on_job_end)
+            logger.info("wired %d zoo callback(s)", len(callbacks))
         self.server = make_server()
         add_master_servicer(self.server, self.servicer)
         port = int(cfg.master_addr.rsplit(":", 1)[1])
@@ -108,6 +139,20 @@ class Master:
     def start(self) -> None:
         self.server.start()
         logger.info("master serving on %s", self.cfg.master_addr)
+        if self.cfg.instance_manager == "k8s":
+            # the reference's k8s flavor: the master creates worker pods and
+            # watches their events (pod death drives task recovery directly)
+            from elasticdl_tpu.master.k8s_instance_manager import (
+                K8sInstanceManager,
+            )
+
+            self.instance_manager = K8sInstanceManager(
+                self.cfg,
+                membership=self.membership,
+                api=self._k8s_api,
+                job_finished_fn=self.dispatcher.finished,
+            )
+            self.instance_manager.start_workers()
         if self.evaluation is not None and self.cfg.job_type == JobType.EVALUATION_ONLY:
             self.evaluation.trigger(0)
 
@@ -134,6 +179,11 @@ class Master:
 
     def shutdown(self, grace_s: float = 5.0) -> None:
         self.servicer.request_shutdown()
+        if self.instance_manager is not None:
+            try:
+                self.instance_manager.stop(grace_s)
+            except Exception:
+                logger.exception("instance manager stop failed")
         counts = self.dispatcher.counts()
         mean_loss = self.servicer.mean_training_loss()
         results = self.evaluation.latest_results() if self.evaluation else {}
@@ -151,7 +201,11 @@ class Master:
 
     def run(self) -> int:
         self.start()
-        ok = self.wait()
+        abort_fn = (
+            self.instance_manager.all_failed
+            if self.instance_manager is not None else None
+        )
+        ok = self.wait(abort_fn=abort_fn)
         self.shutdown()
         return 0 if ok else 1
 

@@ -28,6 +28,21 @@ from elasticdl_tpu.master.membership import Membership
 logger = default_logger(__name__)
 
 
+def _reject_plain_training_scale_out(cfg: JobConfig) -> None:
+    """Runtime twin of JobConfig.validate's multi-replica rule: growing a
+    TRAINING job beyond one plain (non-cohort) worker would train divergent
+    replicas with no gradient exchange — the config guard must not be
+    bypassable through the scale-out API."""
+    from elasticdl_tpu.common.constants import JobType
+
+    if cfg.job_type in (JobType.TRAINING_ONLY, JobType.TRAINING_WITH_EVALUATION):
+        raise RuntimeError(
+            "add_worker on a training job with plain workers would create "
+            "independent model replicas (no gradient exchange); use the SPMD "
+            "cohort (num_processes>1), whose add_worker re-forms the world"
+        )
+
+
 @dataclass
 class _WorkerProc:
     worker_id: int
@@ -50,6 +65,8 @@ class ProcessManager:
         extra_env: Optional[Dict[str, str]] = None,
         log_dir: Optional[str] = None,
         job_finished_fn=None,
+        checkpoint_request_fn=None,
+        resize_checkpoint_timeout_s: float = 30.0,
     ):
         self.cfg = cfg
         self._membership = membership
@@ -57,6 +74,14 @@ class ProcessManager:
         self._log_dir = log_dir
         # when this returns True, worker exits are final — no relaunches
         self._job_finished_fn = job_finished_fn or (lambda: False)
+        # Deliberate-resize quiesce: called before tearing a healthy cohort
+        # down so workers checkpoint at the next task boundary (wired to
+        # servicer.request_checkpoint by the launcher); the teardown then
+        # waits up to resize_checkpoint_timeout_s for a NEW checkpoint to
+        # land, bounding the work a planned resize throws away to one task.
+        self._checkpoint_request_fn = checkpoint_request_fn
+        self._resize_ckpt_timeout_s = resize_checkpoint_timeout_s
+        self._probe_ckpt_mngr = None  # lazily built, reused across resizes
         self._procs: Dict[int, _WorkerProc] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -164,6 +189,7 @@ class ProcessManager:
                 self._pending_resize = target
                 logger.info("cohort scale-out requested: -> %d processes", target)
                 return target
+        _reject_plain_training_scale_out(self.cfg)
         with self._lock:
             wid = self._next_worker_id
             self._next_worker_id += 1
@@ -285,6 +311,52 @@ class ProcessManager:
                 new_size, self._world_version, reason,
             )
 
+    def _await_resize_checkpoint(self) -> None:
+        """Request a checkpoint (via the wired master hook) and wait for a
+        newer one to appear before a deliberate teardown. Best-effort: no
+        hook, no checkpoint_dir, or a quiet worker (no new steps) just times
+        out and the resize proceeds — same cost as before this existed."""
+        if self._checkpoint_request_fn is None or not self.cfg.checkpoint_dir:
+            return
+        try:
+            if self._probe_ckpt_mngr is None:
+                # one orbax manager, reused for every resize (each instance
+                # holds background threads/handles; per-resize construction
+                # would leak them across a long elastic job)
+                from elasticdl_tpu.training.checkpoint import CheckpointManager
+
+                self._probe_ckpt_mngr = CheckpointManager(self.cfg.checkpoint_dir)
+            mngr = self._probe_ckpt_mngr
+            before = mngr.latest_step(refresh=True)
+        except Exception:
+            logger.exception("resize checkpoint probe failed; skipping quiesce")
+            return
+        try:
+            self._checkpoint_request_fn()
+        except Exception:
+            logger.exception("resize checkpoint request failed; skipping quiesce")
+            return
+        deadline = time.time() + self._resize_ckpt_timeout_s
+        while time.time() < deadline and not self._stop.is_set():
+            if self._job_finished_fn():
+                return  # nothing left to protect; caller re-checks job end
+            try:
+                # refresh: the checkpoint is written by the WORKER processes
+                latest = mngr.latest_step(refresh=True)
+            except Exception:
+                break
+            if latest is not None and latest != before:
+                logger.info(
+                    "pre-resize checkpoint landed at step %s (was %s)",
+                    latest, before,
+                )
+                return
+            time.sleep(0.2)
+        logger.warning(
+            "pre-resize checkpoint did not land within %.0fs; resizing anyway",
+            self._resize_ckpt_timeout_s,
+        )
+
     def _watch_cohort_loop(self, poll_s: float) -> None:
         """Cohort semantics: the jax.distributed world is all-or-nothing —
         one dead member fails the others, so ANY failure tears the cohort
@@ -302,6 +374,15 @@ class ProcessManager:
           training continues at N-1 instead of stalling, or picks up the new
           capacity at N+1. The job only fails when it cannot even run at
           size 1.
+
+        Policy note (documented limitation): a permanently lost host is only
+        KNOWN to be lost through the operator/test API
+        (`kill_worker(relaunch=False)` sets no_relaunch). A real lost host is
+        indistinguishable from a transient crash, so recovery first burns the
+        in-place relaunch budget (each a full world boot, see
+        reformation_log / BASELINE.md re-formation latency) before shrinking
+        by one. Tune `relaunch_max` down when hosts are more likely to vanish
+        than to crash transiently.
         """
         while not self._stop.is_set():
             with self._lock:
@@ -388,6 +469,17 @@ class ProcessManager:
                 and pending != self._cohort_size
                 and not self._job_finished_fn()
             ):
+                # planned resize of a HEALTHY cohort: quiesce first — ask for
+                # a checkpoint and wait for it, so only sub-task progress is
+                # redone at the new size (a crash path can't do this; a
+                # deliberate one shouldn't skip it)
+                self._await_resize_checkpoint()
+                if self._job_finished_fn():
+                    # the job ran out from under the resize: nothing to do
+                    with self._lock:
+                        if self._pending_resize == pending:
+                            self._pending_resize = None
+                    continue
                 with self._lock:
                     if self._pending_resize == pending:
                         self._pending_resize = None
@@ -409,6 +501,12 @@ class ProcessManager:
         self._stop.set()
         if self._watcher:
             self._watcher.join(timeout=grace_s)
+        if self._probe_ckpt_mngr is not None:
+            try:
+                self._probe_ckpt_mngr.close()
+            except Exception:
+                logger.exception("closing resize checkpoint probe failed")
+            self._probe_ckpt_mngr = None
         with self._lock:
             procs = list(self._procs.values())
         deadline = time.time() + grace_s

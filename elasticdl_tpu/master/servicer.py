@@ -84,10 +84,15 @@ class MasterServicer:
                 self._loss_count += request.loss_count
             if self._summary is not None:
                 self._summary.on_task_report(
-                    request.model_version, request.loss_sum, request.loss_count
+                    request.model_version, request.loss_sum, request.loss_count,
+                    step_time_sum=request.step_time_sum,
+                    step_count=request.step_count,
                 )
         if accepted and request.success and self._evaluation is not None:
-            self._evaluation.maybe_trigger()
+            # model_version is the worker's minibatch-step counter — the
+            # reference's evaluation_steps unit (round-3 fix: this used to
+            # count completed *tasks*, ~64x coarser at default task sizes)
+            self._evaluation.maybe_trigger(request.model_version)
         return pb.ReportTaskResultResponse(accepted=accepted)
 
     def ReportEvaluationMetrics(self, request, context):

@@ -67,10 +67,16 @@ class SummaryService:
         self._train = SummaryWriter(os.path.join(self._dir, "train"))
         self._eval: Optional[SummaryWriter] = None
 
-    def on_task_report(self, model_version: int, loss_sum: float, loss_count: int
-                       ) -> None:
+    def on_task_report(self, model_version: int, loss_sum: float, loss_count: int,
+                       step_time_sum: float = 0.0, step_count: int = 0) -> None:
         if loss_count > 0:
-            self._train.scalars(model_version, {"loss": loss_sum / loss_count})
+            scalars = {"loss": loss_sum / loss_count}
+            if step_count > 0:
+                # per-step wall time (ms), as measured around the worker's
+                # blocking train step — SURVEY §5's "do better than the
+                # reference here cheaply" observability item
+                scalars["step_time_ms"] = 1e3 * step_time_sum / step_count
+            self._train.scalars(model_version, scalars)
 
     def on_eval_results(self, model_version: int, results: Dict[str, float]) -> None:
         if self._eval is None:

@@ -76,6 +76,7 @@ class TaskDispatcher:
         shuffle: bool = True,
         shuffle_seed: int = 0,
         task_timeout_s: float = 600.0,
+        final_save_model: bool = False,
     ):
         self._lock = threading.Lock()
         self._training_shards = list(training_shards)
@@ -105,6 +106,11 @@ class TaskDispatcher:
         self._pending_failed: List[TaskSpec] = []
         # training version counter: bumps on every finished training task
         self._completed_versions = 0
+        # final exclusive SAVE_MODEL task (reference: the master's save-model
+        # task at job end, SURVEY §2.1): created once, after everything else
+        # drains, before job-end fires
+        self._final_save_model = final_save_model
+        self._save_model_created = False
 
         if self._training_shards:
             self._start_next_epoch()
@@ -343,9 +349,49 @@ class TaskDispatcher:
             and not self._doing
             and not self._job_end_fired
         ):
+            if (
+                self._final_save_model
+                and not self._save_model_created
+                and self._finished_training > 0
+            ):
+                # everything else drained: one exclusive SAVE_MODEL task so a
+                # durable end-of-job checkpoint exists no matter which worker
+                # interval checkpointing last touched (its report re-enters
+                # here and only then does job-end fire)
+                self._save_model_created = True
+                self._todo.append(
+                    TaskSpec(
+                        task_id=self._next_task_id,
+                        type=pb.SAVE_MODEL,
+                        shard_name="",
+                        start=0,
+                        end=0,
+                        epoch=max(self._epoch, 0),
+                    )
+                )
+                self._next_task_id += 1
+                logger.info("created final SAVE_MODEL task")
+                return callbacks
             self._job_end_fired = True
             callbacks.extend(self._job_end_callbacks)
         return callbacks
+
+    def request_stop_training(self, reason: str = "") -> None:
+        """Early stopping: drop queued training tasks and schedule no more
+        epochs; leased tasks drain normally, then the job ends through the
+        usual epoch-end → final-eval → SAVE_MODEL → job-end sequence."""
+        callbacks: List[Callable] = []
+        with self._lock:
+            before = len(self._todo)
+            self._todo = deque(t for t in self._todo if t.type != pb.TRAINING)
+            dropped = before - len(self._todo)
+            self._num_epochs = min(self._num_epochs, self._epoch + 1)
+            logger.info(
+                "training stop requested (%s): dropped %d queued training "
+                "tasks, no further epochs", reason or "no reason", dropped,
+            )
+            callbacks = self._maybe_advance_epoch_locked()
+        self._flush_callbacks(callbacks)
 
     # ------------------------------------------------------------------ #
     # introspection / hooks

@@ -141,6 +141,25 @@ def context_from_env(cfg) -> Optional[CohortContext]:
     n = int(os.environ.get("EDL_NUM_PROCESSES", "0") or 0) or cfg.num_processes
     if n <= 1 and "EDL_PROCESS_ID" not in os.environ:
         return None
+    if (
+        "EDL_PROCESS_ID" not in os.environ
+        and os.environ.get("EDL_PROCESS_ID_FROM_HOSTNAME") == "1"
+    ):
+        # k8s StatefulSet flavor: pods are <name>-<ordinal>; the ordinal IS
+        # the cohort process id (stable across pod restarts, which is what
+        # makes a StatefulSet the right k8s shape for a jax.distributed
+        # world — see client/k8s.py render_worker_statefulset)
+        import socket
+
+        host = socket.gethostname()
+        ordinal = host.rsplit("-", 1)[-1]
+        if ordinal.isdigit():
+            os.environ["EDL_PROCESS_ID"] = ordinal
+        else:
+            raise RuntimeError(
+                f"EDL_PROCESS_ID_FROM_HOSTNAME=1 but hostname {host!r} has "
+                "no trailing ordinal"
+            )
     pid = int(os.environ.get("EDL_PROCESS_ID", "0"))
     addr = (
         os.environ.get("EDL_COORDINATOR_ADDR")

@@ -41,7 +41,16 @@ class CheckpointManager:
         logger.info("checkpoint step %d -> %s", step, self._dir)
         return step
 
-    def latest_step(self) -> Optional[int]:
+    def latest_step(self, refresh: bool = False) -> Optional[int]:
+        """refresh=True re-reads the directory — orbax caches the step list
+        per manager instance, so observers polling for checkpoints written by
+        OTHER processes (e.g. the resize quiesce in master/process_manager)
+        must refresh or they never see them."""
+        if refresh:
+            try:
+                self._mngr.reload()
+            except Exception:
+                logger.exception("checkpoint manager reload failed")
         return self._mngr.latest_step()
 
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Optional[Any]:

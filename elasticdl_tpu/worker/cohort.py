@@ -70,6 +70,7 @@ class CohortWorker:
         self._last_ckpt_step = 0
         self._shutdown = threading.Event()
         self._job_done = False
+        self._ckpt_requested = False  # heartbeat should_checkpoint bit
         self.worker_id = -1
 
     # ------------------------------------------------------------------ #
@@ -206,6 +207,11 @@ class CohortWorker:
                         self._job_done = True
                     self._shutdown.set()
                     break
+                if resp.should_checkpoint:
+                    # honored by the next control vector's FLAG_CHECKPOINT —
+                    # the save itself is collective and happens at the task
+                    # boundary on every process
+                    self._ckpt_requested = True
             except Exception as e:
                 logger.warning("cohort heartbeat failed: %s", e)
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
@@ -233,9 +239,18 @@ class CohortWorker:
             and self._state.model_version - self._last_ckpt_step
             >= self.cfg.checkpoint_steps
         )
+        if self._ckpt_requested:
+            # clear only when consumed: an unconditional clear could drop a
+            # request the heartbeat thread set between read and clear, and
+            # the servicer's should_checkpoint bit is one-shot
+            self._ckpt_requested = False
+            due = True
         return [
             OP_TASK, task.task_id, task.type,
-            self._shard_index(task.type, task.shard_name),
+            (
+                0 if task.type == pb.SAVE_MODEL
+                else self._shard_index(task.type, task.shard_name)
+            ),
             task.start, task.end,
             FLAG_CHECKPOINT if due else 0,
             task.eval_job_id,
@@ -248,9 +263,43 @@ class CohortWorker:
         import jax
 
         _, task_id, task_type, shard_idx, start, end, flags, eval_job = ctrl
+        if task_type == pb.SAVE_MODEL:
+            # The master's final exclusive save task: a collective checkpoint
+            # (every process writes its addressable shards), leader reports.
+            # With no live state (relaunched cohort, no batch processed yet)
+            # success is only true if a checkpoint already exists on disk —
+            # it IS the current state then; otherwise report failure so the
+            # dispatcher retries (all processes branch identically: state
+            # and the checkpoint dir are symmetric across the cohort).
+            mngr = self._checkpoint_manager()
+            ok, err = True, ""
+            if mngr is not None and self._state is not None:
+                mngr.save(self._state, wait=True)
+                self._last_ckpt_step = self._state.model_version
+            elif mngr is not None and mngr.latest_step(refresh=True) is None:
+                ok, err = False, "no live state and no checkpoint on disk"
+            if self.ctx.is_leader:
+                try:
+                    self._stub.ReportTaskResult(
+                        pb.ReportTaskResultRequest(
+                            worker_id=self.worker_id, task_id=task_id,
+                            success=ok, err_message=err,
+                            model_version=(
+                                self._state.model_version
+                                if self._state is not None else 0
+                            ),
+                        ),
+                        timeout=30,
+                    )
+                except Exception as e:
+                    logger.warning(
+                        "cohort report failed for save task %d: %s", task_id, e
+                    )
+            return
         svc = self._data_service(task_type)
         shard = self._shard_name(task_type, shard_idx)
         loss_sum, loss_count = 0.0, 0
+        step_time_sum = 0.0
         metric_states = None
         for host_batch in svc.batches(shard, start, end):
             batch = make_global_batch(
@@ -258,9 +307,13 @@ class CohortWorker:
             )
             self._ensure_state(batch)
             if task_type == pb.TRAINING:
+                t0 = time.perf_counter()
                 self._state, logs = self._trainer.train_step(self._state, batch)
                 if self.ctx.is_leader:
+                    # float() forces the collective step: wall time covers
+                    # dispatch + device compute across the whole cohort
                     loss_sum += float(logs["loss"])
+                    step_time_sum += time.perf_counter() - t0
                     loss_count += 1
             else:
                 if metric_states is None:
@@ -285,6 +338,7 @@ class CohortWorker:
                 self._state.model_version if self._state is not None else 0
             ),
             loss_sum=loss_sum, loss_count=loss_count,
+            step_time_sum=step_time_sum, step_count=loss_count,
         )
         try:
             self._stub.ReportTaskResult(report, timeout=30)

@@ -52,6 +52,9 @@ class Worker:
         self._base_lr = None          # injected LR at init (elastic scaling)
         self._pending_lr = None       # set by heartbeat thread, applied by run loop
         self._last_known_workers = 0  # latest alive count (register/heartbeat)
+        self._global_step = 0         # train steps run by this worker
+        self._profile_state = "idle"  # idle -> active -> done (jax.profiler)
+        self._ckpt_requested = False  # heartbeat should_checkpoint bit
 
     # ------------------------------------------------------------------ #
     # setup
@@ -239,6 +242,10 @@ class Worker:
                     self._shutdown.set()
                     break
                 self._last_known_workers = resp.num_workers or self._last_known_workers
+                if resp.should_checkpoint:
+                    # honored by the run loop at the next task boundary (the
+                    # heartbeat thread must not save mid-train-step)
+                    self._ckpt_requested = True
                 if resp.membership_version != self._membership_version:
                     self._on_membership_change(
                         resp.membership_version, resp.num_workers
@@ -248,9 +255,12 @@ class Worker:
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
     def _on_membership_change(self, new_version: int, num_workers: int = 0) -> None:
-        """Elastic hook: the worker set changed. Single-host mesh keeps
-        running; the multi-host path re-forms the jax.distributed mesh here
-        (see parallel/elastic.py)."""
+        """Elastic hook: the worker set changed. This worker's only local
+        reaction is rescaling the LR (when scale_lr_with_workers) — its
+        single-host mesh keeps running. Multi-process mesh re-formation is
+        NOT done here: cohort worlds are torn down and re-formed by the
+        instance manager (master/process_manager.py), with worker/cohort.py
+        exiting and restoring from checkpoint."""
         logger.info(
             "membership v%d -> v%d", self._membership_version, new_version
         )
@@ -267,10 +277,52 @@ class Worker:
     # ------------------------------------------------------------------ #
     # task execution
 
+    def _maybe_profile(self) -> None:
+        """Drive the jax.profiler trace window (SURVEY §5 tracing): worker 0
+        records steps [profile_start_step, profile_start_step+profile_steps)
+        into profile_dir, skipping compile/warmup. One window per run."""
+        if not self.cfg.profile_dir or self.worker_id != 0:
+            return
+        import jax
+
+        if (
+            self._profile_state == "idle"
+            and self._global_step >= self.cfg.profile_start_step
+        ):
+            try:
+                jax.profiler.start_trace(self.cfg.profile_dir)
+                self._profile_state = "active"
+                logger.info(
+                    "profiler trace started at step %d -> %s",
+                    self._global_step, self.cfg.profile_dir,
+                )
+            except Exception:
+                logger.exception("profiler start failed; disabled")
+                self._profile_state = "done"
+        elif (
+            self._profile_state == "active"
+            and self._global_step
+            >= self.cfg.profile_start_step + self.cfg.profile_steps
+        ):
+            self._stop_profiler()
+
+    def _stop_profiler(self) -> None:
+        if self._profile_state != "active":
+            return
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+            logger.info("profiler trace stopped at step %d", self._global_step)
+        except Exception:
+            logger.exception("profiler stop failed")
+        self._profile_state = "done"
+
     def _run_training_task(self, task: pb.Task) -> Dict[str, float]:
         svc = self._data_service(pb.TRAINING)
         loss_sum, loss_count = 0.0, 0
         records_done = 0
+        step_time_sum = 0.0
         interrupted = False
         self._mid_training_task = True
         for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
@@ -280,15 +332,22 @@ class Worker:
                 interrupted = True
                 break
             self._ensure_state(batch)
+            self._maybe_profile()
+            t0 = time.perf_counter()
             self._state, logs = self._trainer.train_step(self._state, batch)
+            # float() forces the step's result, so this wall time covers the
+            # whole step (dispatch + device compute), not just dispatch
             loss_sum += float(logs["loss"])
+            step_time_sum += time.perf_counter() - t0
             loss_count += 1
+            self._global_step += 1
             # mask sums the real (non-padding) records this batch applied
             records_done += int(batch["mask"].sum())
         return {
             "loss_sum": loss_sum,
             "loss_count": loss_count,
             "records_done": records_done,
+            "step_time_sum": step_time_sum,
             "interrupted": interrupted,
         }
 
@@ -443,6 +502,14 @@ class Worker:
             elif pending_lr is not None:
                 # state not built yet: keep it pending for the next loop
                 self._pending_lr = pending_lr
+            if self._ckpt_requested and not self._mid_training_task:
+                # master-requested checkpoint (heartbeat should_checkpoint),
+                # taken at a task boundary only
+                self._ckpt_requested = False
+                try:
+                    self._maybe_checkpoint(force=True)
+                except Exception:
+                    logger.exception("master-requested checkpoint failed")
             if task.type == pb.WAIT:
                 time.sleep(resp.backoff_seconds or 1.0)
                 continue
@@ -458,6 +525,8 @@ class Worker:
                         break
                     report.loss_sum = stats["loss_sum"]
                     report.loss_count = int(stats["loss_count"])
+                    report.step_time_sum = stats["step_time_sum"]
+                    report.step_count = int(stats["loss_count"])
                 elif task.type == pb.EVALUATION:
                     if self._run_evaluation_task(task):
                         break
@@ -482,6 +551,10 @@ class Worker:
             except Exception as e:
                 logger.warning("report failed for task %d: %s", task.task_id, e)
             tasks_done += 1
+
+        # A trace window still open at exit (short job / preemption) must be
+        # flushed — an unstopped trace writes nothing.
+        self._stop_profiler()
 
         # Preemption-triggered save (reference: preemption checkpoints in
         # the checkpoint service): SIGTERM'd workers persist progress so the
@@ -546,8 +619,22 @@ class Worker:
         self._shutdown.set()
 
     def _save_checkpoint(self) -> None:
+        """Serve a SAVE_MODEL task: persist current state, wait for
+        durability. With no live state (a relaunched worker that has not
+        processed a batch yet), success is only reported if a checkpoint
+        already exists on disk — that checkpoint IS the current state, since
+        no training happened since restore. Otherwise fail the task so the
+        dispatcher retries it on a worker that has state (silent success
+        here would retire the job's durability task with nothing saved)."""
         mngr = self._checkpoint_manager()
-        if self._state is None or mngr is None:
+        if mngr is None:
+            return
+        if self._state is None:
+            if mngr.latest_step(refresh=True) is None:
+                raise RuntimeError(
+                    "SAVE_MODEL: no live training state and no checkpoint on "
+                    "disk to vouch for"
+                )
             return
         mngr.save(self._state, wait=True)
         self._last_ckpt_step = self._state.model_version

@@ -103,17 +103,19 @@ def optimizer(**kwargs):
 
 
 def dataset_fn(mode, metadata):
-    """Parse one CIFAR-10-binary record: 1 label byte + 3072 pixel bytes
-    (3x32x32 channel-major uint8, as in the upstream cifar-10-bin files)."""
+    """Batch-parse CIFAR-10-binary records (1 label byte + 3072 pixel bytes,
+    3x32x32 channel-major uint8 as in the upstream cifar-10-bin files) via
+    the C++ u8-image kernel, then transpose to NHWC vectorized."""
+    from elasticdl_tpu.data import parsing
 
-    def parse(record: bytes):
-        buf = np.frombuffer(record, dtype=np.uint8)
-        label = buf[0].astype(np.int32)
-        image = buf[1:3073].reshape(3, 32, 32).transpose(1, 2, 0)
-        image = image.astype(np.float32) / 255.0
-        return image, label
+    base = parsing.u8_image_batch_parser(3072)
 
-    return parse
+    @parsing.batch_parser
+    def parse_batch(records):
+        imgs, labels = base(records)
+        return imgs.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), labels
+
+    return parse_batch
 
 
 def eval_metrics_fn():

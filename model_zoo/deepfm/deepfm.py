@@ -96,23 +96,25 @@ def optimizer(**kwargs):
 
 
 def dataset_fn(mode, metadata):
-    """Parse a Criteo TSV line: label \\t 13 ints \\t 26 hex categoricals."""
+    """Batch-parse Criteo records (data/parsing.py batch-parser contract).
 
-    def parse(record: bytes):
-        parts = record.decode("utf-8", errors="replace").rstrip("\n").split("\t")
-        label = np.int32(int(parts[0]) if parts[0] else 0)
-        dense = np.array(
-            [float(p) if p else 0.0 for p in parts[1 : 1 + NUM_DENSE]], np.float32
-        )
-        cat = np.array(
-            [int(p, 16) & 0x7FFFFFFF if p else 0 for p in parts[1 + NUM_DENSE :][:NUM_CAT]],
-            np.int32,
-        )
-        if cat.shape[0] < NUM_CAT:
-            cat = np.pad(cat, (0, NUM_CAT - cat.shape[0]))
-        return {"dense": dense, "cat": cat}, label
+    Two wire formats, picked by reader metadata: fixed-width binary .cbin
+    shards (written once by `parsing.convert_criteo_tsv`; decoded at memcpy
+    speed — the production path, mirroring the reference's RecordIO binary
+    shards) and raw TSV (label \\t 13 ints \\t 26 hex categoricals; decoded
+    by the C++ kernel in data/native/batch_parse.cc). The round-2 per-record
+    Python loop capped the pipeline ~26x below the chip (BASELINE.md)."""
+    from elasticdl_tpu.data import parsing
 
-    return parse
+    if metadata and "record_bytes" in metadata:
+        expect = parsing.criteo_bin_record_bytes(NUM_DENSE, NUM_CAT)
+        if metadata["record_bytes"] != expect:
+            raise ValueError(
+                f"binary reader record_bytes={metadata['record_bytes']} does "
+                f"not match the Criteo layout ({expect})"
+            )
+        return parsing.criteo_bin_batch_parser(NUM_DENSE, NUM_CAT)
+    return parsing.criteo_batch_parser(num_dense=NUM_DENSE, num_cat=NUM_CAT)
 
 
 def eval_metrics_fn():

@@ -54,15 +54,11 @@ def optimizer(**kwargs):
 
 
 def dataset_fn(mode, metadata):
-    """Parse one raw record: 1 label byte + 784 pixel bytes (uint8)."""
+    """Batch-parse raw records (1 label byte + 784 pixel bytes) via the C++
+    u8-image kernel (data/parsing.py) into (n, 28, 28, 1) float32 images."""
+    from elasticdl_tpu.data import parsing
 
-    def parse(record: bytes):
-        buf = np.frombuffer(record, dtype=np.uint8)
-        label = buf[0].astype(np.int32)
-        image = (buf[1:785].astype(np.float32) / 255.0).reshape(28, 28, 1)
-        return image, label
-
-    return parse
+    return parsing.u8_image_batch_parser(784, shape=(28, 28, 1))
 
 
 def eval_metrics_fn():

@@ -43,3 +43,52 @@ def test_validate_rejects_missing_model_def():
 
     with pytest.raises(ValueError):
         JobConfig().validate()
+
+
+def test_validate_rejects_divergent_multi_worker_training():
+    """Round-3 fix for the multi-replica correctness hole (SURVEY §3.3): a
+    training job with num_workers>1 (plain workers, no cohort) would train N
+    independent replicas with no gradient exchange — must be an error that
+    points at cohort mode, in every training job_type, regardless of
+    num_processes."""
+    import pytest
+
+    from elasticdl_tpu.common.constants import JobType
+
+    base = JobConfig(model_def="m.n.f", num_workers=3)
+    for jt in (JobType.TRAINING_ONLY, JobType.TRAINING_WITH_EVALUATION):
+        with pytest.raises(ValueError, match="num_processes"):
+            base.replace(job_type=jt).validate()
+    # embarrassingly-parallel job types keep plain multi-worker
+    base.replace(job_type=JobType.EVALUATION_ONLY).validate()
+    base.replace(job_type=JobType.PREDICTION_ONLY).validate()
+    # the correct data-parallel shape: one logical worker, SPMD cohort
+    JobConfig(model_def="m.n.f", num_workers=1, num_processes=3).validate()
+    with pytest.raises(ValueError):
+        JobConfig(model_def="m.n.f", num_processes=0).validate()
+
+
+def test_instance_manager_validation():
+    from elasticdl_tpu.common.constants import JobType
+
+    JobConfig(model_def="m.n.f", instance_manager="k8s").validate()
+    import pytest
+
+    with pytest.raises(ValueError, match="StatefulSet"):
+        JobConfig(model_def="m.n.f", instance_manager="k8s",
+                  num_processes=4).validate()
+    with pytest.raises(ValueError, match="instance_manager"):
+        JobConfig(model_def="m.n.f", instance_manager="bogus").validate()
+
+
+def test_instance_manager_rejects_multihost_slice_at_submit():
+    """Review fix: the statically-knowable tpu_type x instance_manager
+    conflict fails at validate(), not minutes later in the master pod."""
+    import pytest
+
+    with pytest.raises(ValueError, match="StatefulSet"):
+        JobConfig(model_def="m.n.f", instance_manager="k8s",
+                  tpu_type="v5e-16").validate()
+    # single-host slice is fine
+    JobConfig(model_def="m.n.f", instance_manager="k8s",
+              tpu_type="v5e-4").validate()

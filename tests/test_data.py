@@ -123,3 +123,112 @@ def test_odps_reader_requires_pyodps():
         ODPSDataReader("some_table")
     with pytest.raises(ImportError, match="pyodps"):
         create_data_reader("odps://some_table#pt=20200101")
+
+
+def test_csv_header_mismatch_across_files_raises(tmp_path):
+    """Round-3 (VERDICT #8): a directory mixing CSV column orders must fail
+    loudly at reader construction, not silently misparse by position."""
+    from elasticdl_tpu.data.reader import CSVDataReader
+
+    (tmp_path / "a.csv").write_text("age,label\n1,0\n")
+    (tmp_path / "b.csv").write_text("label,age\n0,1\n")
+    with pytest.raises(ValueError, match="header mismatch"):
+        CSVDataReader(str(tmp_path))
+    # consistent headers stay fine
+    (tmp_path / "b.csv").write_text("age,label\n2,1\n")
+    r = CSVDataReader(str(tmp_path))
+    assert r.metadata["columns"] == ["age", "label"]
+    assert sum(e - s for _, s, e in r.create_shards()) == 2
+
+
+class _FakeOdpsReaderCtx:
+    def __init__(self, rows):
+        self._rows = rows
+        self.count = len(rows)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getitem__(self, sl):
+        class Row:
+            def __init__(self, values):
+                self.values = values
+
+            def __getitem__(self, col):
+                return dict(zip(["a", "b"], self.values))[col]
+
+        return [Row(v) for v in self._rows[sl]]
+
+
+class _FakeOdpsTable:
+    name = "t1"
+
+    class table_schema:
+        class _Col:
+            def __init__(self, name):
+                self.name = name
+
+        columns = [_Col("a"), _Col("b")]
+
+    def open_reader(self, partition=None):
+        return _FakeOdpsReaderCtx([(1, "x"), (2, "y,z"), (3, None)])
+
+
+def test_odps_reader_with_mocked_client(monkeypatch):
+    """Round-3 (VERDICT #8): the ODPS reader logic under a faked pyodps —
+    shard math, CSV-quoted record encoding, metadata columns."""
+    import sys
+    import types
+
+    fake = types.ModuleType("odps")
+    fake.ODPS = lambda *a, **kw: types.SimpleNamespace(
+        get_table=lambda name: _FakeOdpsTable()
+    )
+    monkeypatch.setitem(sys.modules, "odps", fake)
+    for v in ("ODPS_PROJECT_NAME", "ODPS_ACCESS_ID", "ODPS_ACCESS_KEY",
+              "ODPS_ENDPOINT"):
+        monkeypatch.setenv(v, "x")
+
+    from elasticdl_tpu.data.reader import ODPSDataReader, create_data_reader
+
+    r = ODPSDataReader("t1", records_per_shard=2)
+    assert r.create_shards() == [("t1", 0, 2), ("t1", 2, 3)]
+    assert r.metadata["columns"] == ["a", "b"]
+    recs = list(r.read_records("t1", 0, 3))
+    assert recs[0] == b"1,x"
+    assert recs[1] == b'2,"y,z"'   # delimiter-containing field stays quoted
+    assert recs[2] == b"3,"        # None -> empty
+    # odps:// factory addressing with a partition suffix
+    r2 = create_data_reader("odps://t1#pt=20260729")
+    assert r2._partition == "pt=20260729"
+
+
+def test_odps_reader_missing_env_raises(monkeypatch):
+    import sys
+    import types
+
+    monkeypatch.setitem(sys.modules, "odps", types.ModuleType("odps"))
+    for v in ("ODPS_PROJECT_NAME", "ODPS_ACCESS_ID", "ODPS_ACCESS_KEY",
+              "ODPS_ENDPOINT"):
+        monkeypatch.delenv(v, raising=False)
+    from elasticdl_tpu.data.reader import ODPSDataReader
+
+    with pytest.raises(ValueError, match="ODPS credentials"):
+        ODPSDataReader("t1")
+
+
+def test_client_verbs_require_matching_data_flags():
+    """Round-3 (VERDICT #8): each verb validates ITS data flag up front."""
+    from elasticdl_tpu.client import api
+    from elasticdl_tpu.common.config import JobConfig
+
+    cfg = JobConfig(model_def="m.n.f")
+    with pytest.raises(ValueError, match="--training_data"):
+        api.train(cfg)
+    with pytest.raises(ValueError, match="--validation_data"):
+        api.evaluate(cfg)
+    with pytest.raises(ValueError, match="--prediction_data"):
+        api.predict(cfg)

@@ -94,3 +94,30 @@ def test_multi_epoch_fires_eval_per_epoch():
         d.report(t.task_id, 0, True)
     assert len(jobs_seen) == 2  # one eval job per epoch end
     assert d.finished()
+
+
+def test_version_regression_rebases_trigger():
+    """Review fix: a worker relaunching WITHOUT a checkpoint restore reports
+    model_version starting from 0 again; the trigger threshold must re-base
+    instead of silently skipping the next `last - new` steps' evals."""
+    d, ev = build(evaluation_steps=10)
+    assert ev.maybe_trigger(10) is not None     # normal trigger at v10
+    assert ev.maybe_trigger(3) is None          # regression: re-base, no job
+    assert ev.maybe_trigger(12) is None         # 12 - 3 < 10? no: 9 < 10
+    assert ev.maybe_trigger(13) is not None     # 13 - 3 >= 10: triggers
+
+
+def test_plain_training_scale_out_rejected():
+    """Review fix: the runtime scale-out API must not reopen the divergent-
+    replica hole JobConfig.validate closes at submit time."""
+    import pytest
+
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.process_manager import ProcessManager
+
+    cfg = JobConfig(model_def="m.n.f", job_type="training_with_evaluation")
+    mgr = ProcessManager(cfg)
+    with pytest.raises(RuntimeError, match="cohort"):
+        mgr.add_worker()
+    # evaluation-only jobs may still scale out (checked in the k8s twin's
+    # tests with a live fake API; here the guard itself is the subject)

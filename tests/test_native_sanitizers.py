@@ -1,0 +1,139 @@
+"""Sanitizer builds of the in-repo C++ (SURVEY §5: the reference ran its Go
+side under `go test -race`; the rebuild's native data plane gets the C++
+equivalent — ASan/UBSan-instrumented builds exercised through their hot
+paths in a subprocess).
+
+Marked slow-ish (two extra g++ builds, ~seconds each); the sanitized .so
+files live in a temp dir and never replace the production libraries.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from elasticdl_tpu.data import nativelib
+
+SAN_FLAGS = ["-fsanitize=address,undefined", "-fno-sanitize-recover=all", "-g"]
+
+
+def _build_sanitized(tmp_path, name):
+    src = os.path.join(nativelib.NATIVE_DIR, f"{name}.cc")
+    out = str(tmp_path / f"lib{name}_san.so")
+    proc = subprocess.run(
+        ["g++", "-O1", "-std=c++17", "-shared", "-fPIC", *SAN_FLAGS, src,
+         "-o", out],
+        capture_output=True,
+    )
+    if proc.returncode != 0:
+        pytest.skip(f"sanitized build unavailable: {proc.stderr.decode()[:200]}")
+    return out
+
+
+DRIVER = textwrap.dedent(
+    """
+    import ctypes, os, sys
+    import numpy as np
+
+    lib_bp = ctypes.CDLL(sys.argv[1])
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    lib_bp.edl_parse_criteo.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        i32p, f32p, i32p,
+    ]
+    # adversarial records: empty, truncated, non-ascii, huge hex, no tabs
+    records = [
+        b"", b"1", b"\\t\\t\\t", b"9\\t" + b"\\xff" * 50,
+        (b"1\\t" + b"\\t".join(b"%d" % i for i in range(13)) + b"\\t"
+         + b"\\t".join(b"%x" % (i * 7) for i in range(26))),
+        b"0\\t" + b"f" * 64, b"-\\t-\\t-",
+    ] * 50
+    offs = np.zeros(len(records) + 1, np.int64)
+    np.cumsum([len(r) for r in records], out=offs[1:])
+    buf = b"".join(records)
+    n = len(records)
+    labels = np.empty(n, np.int32)
+    dense = np.empty((n, 13), np.float32)
+    cat = np.empty((n, 26), np.int32)
+    lib_bp.edl_parse_criteo(buf, offs, n, 13, 26, labels, dense, cat)
+
+    lib_bp.edl_parse_numeric.argtypes = [
+        ctypes.c_char_p, i64p, ctypes.c_int64, ctypes.c_char, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, i32p, f32p,
+    ]
+    out = np.empty((n, 3), np.float32)
+    lib_bp.edl_parse_numeric(buf, offs, n, b",", 4, 2, 1, labels, out)
+
+    lib_rio = ctypes.CDLL(sys.argv[2])
+    lib_rio.edlr_writer_open.restype = ctypes.c_void_p
+    lib_rio.edlr_writer_open.argtypes = [ctypes.c_char_p, ctypes.c_longlong]
+    lib_rio.edlr_writer_write.restype = ctypes.c_int
+    lib_rio.edlr_writer_write.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_longlong
+    ]
+    lib_rio.edlr_writer_close.restype = ctypes.c_longlong
+    lib_rio.edlr_writer_close.argtypes = [ctypes.c_void_p]
+    lib_rio.edlr_reader_open.restype = ctypes.c_void_p
+    lib_rio.edlr_reader_open.argtypes = [ctypes.c_char_p]
+    lib_rio.edlr_reader_num_records.restype = ctypes.c_longlong
+    lib_rio.edlr_reader_num_records.argtypes = [ctypes.c_void_p]
+    lib_rio.edlr_reader_read.restype = ctypes.c_longlong
+    lib_rio.edlr_reader_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_longlong, ctypes.c_longlong
+    ]
+    lib_rio.edlr_reader_buffer.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib_rio.edlr_reader_buffer.argtypes = [ctypes.c_void_p]
+    lib_rio.edlr_reader_close.restype = None
+    lib_rio.edlr_reader_close.argtypes = [ctypes.c_void_p]
+
+    path = os.path.join(sys.argv[3], "san.rio")
+    h = lib_rio.edlr_writer_open(path.encode(), 1024)
+    assert h
+    for i in range(500):
+        rec = (b"record-%d-" % i) * (i % 7 + 1)
+        assert lib_rio.edlr_writer_write(h, rec, len(rec)) == 0
+    assert lib_rio.edlr_writer_close(h) == 500
+
+    r = lib_rio.edlr_reader_open(path.encode())
+    assert r and lib_rio.edlr_reader_num_records(r) == 500
+    total = lib_rio.edlr_reader_read(r, 100, 400)
+    assert total > 0
+    ctypes.string_at(lib_rio.edlr_reader_buffer(r), total)
+    lib_rio.edlr_reader_close(r)
+    # a bogus file must fail cleanly, not crash
+    bogus = os.path.join(sys.argv[3], "bogus.rio")
+    open(bogus, "wb").write(b"not a recordio file at all")
+    assert not lib_rio.edlr_reader_open(bogus.encode())
+    print("SANITIZED-OK")
+    """
+)
+
+
+def test_native_libs_clean_under_asan_ubsan(tmp_path):
+    bp = _build_sanitized(tmp_path, "batch_parse")
+    rio = _build_sanitized(tmp_path, "recordio")
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER)
+    env = dict(os.environ, ASAN_OPTIONS="detect_leaks=0")
+    # ASan must be loaded before python: LD_PRELOAD its runtime
+    probe = subprocess.run(
+        ["g++", "-print-file-name=libasan.so"], capture_output=True, text=True
+    )
+    asan_rt = probe.stdout.strip()
+    if asan_rt and os.path.sep in asan_rt:
+        env["LD_PRELOAD"] = asan_rt
+    proc = subprocess.run(
+        [sys.executable, str(driver), bp, rio, str(tmp_path)],
+        capture_output=True,
+        env=env,
+        timeout=300,
+    )
+    out = proc.stdout.decode() + proc.stderr.decode()
+    assert proc.returncode == 0, out[-3000:]
+    assert "SANITIZED-OK" in out
+    assert "ERROR: AddressSanitizer" not in out
+    assert "runtime error" not in out  # UBSan report marker

@@ -101,17 +101,20 @@ def test_deepfm_table_is_sharded(mesh8):
 
 
 def test_criteo_dataset_fn_parses():
+    from elasticdl_tpu.data.parsing import is_batch_parser
     from model_zoo.deepfm.deepfm import dataset_fn
 
     parse = dataset_fn("training", None)
+    assert is_batch_parser(parse)
     line = ("1\t" + "\t".join(str(i) for i in range(13)) + "\t"
             + "\t".join(format(i * 7, "x") for i in range(26))).encode()
-    feats, label = parse(line)
-    assert label == 1
-    assert feats["dense"].shape == (13,) and feats["cat"].shape == (26,)
-    # missing fields tolerated
-    feats2, label2 = parse(b"0\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t")
-    assert label2 == 0 and feats2["cat"].shape == (26,)
+    # missing fields tolerated (second record)
+    feats, labels = parse([line, b"0\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t\t"])
+    assert labels.tolist() == [1, 0]
+    assert feats["dense"].shape == (2, 13) and feats["cat"].shape == (2, 26)
+    assert feats["dense"][0].tolist() == [float(i) for i in range(13)]
+    assert feats["cat"][0].tolist() == [i * 7 for i in range(26)]
+    assert feats["cat"][1].tolist() == [0] * 26
 
 
 def test_census_dataset_fn_parses():

@@ -76,8 +76,9 @@ def test_cifar_record_parser():
     parse = resnet.dataset_fn("training", {})
     img = np.arange(3072, dtype=np.uint8)
     rec = bytes([7]) + img.tobytes()
-    feats, label = parse(rec)
-    assert label == 7 and feats.shape == (32, 32, 1 * 3)
+    batch, labels = parse([rec])
+    feats = batch[0]
+    assert labels[0] == 7 and feats.shape == (32, 32, 1 * 3)
     # channel-major source layout: first 1024 bytes are the red plane
     assert np.allclose(feats[0, 0, 0], 0.0)
     assert np.allclose(feats[0, 1, 0], 1 / 255.0)
