@@ -35,6 +35,33 @@ from elasticdl_tpu.data import nativelib
 
 BatchParser = Callable[[Sequence[bytes]], Tuple[Any, Any]]
 
+
+def _int0(p: str) -> int:
+    """Malformed/empty field -> 0, matching the C++ kernels' stance
+    (batch_parse.cc degrades bad bytes to zeros rather than failing the
+    batch); without this the pure-Python fallback's behavior would depend on
+    whether the host has a toolchain."""
+    try:
+        return int(p)
+    except ValueError:
+        return 0
+
+
+def _float0(p: str) -> float:
+    try:
+        v = float(p)
+        return v if np.isfinite(v) else 0.0
+    except ValueError:
+        return 0.0
+
+
+def _hex0(p: str) -> int:
+    try:
+        return int(p, 16) & 0x7FFFFFFF
+    except ValueError:
+        return 0
+
+
 _lib = None
 _lib_loaded = False
 
@@ -122,15 +149,13 @@ def criteo_batch_parser(num_dense: int = 13, num_cat: int = 26) -> BatchParser:
         else:
             for i, record in enumerate(records):
                 parts = record.decode("utf-8", errors="replace").rstrip("\n").split("\t")
-                labels[i] = int(parts[0]) if parts[0] else 0
+                labels[i] = _int0(parts[0])
                 drow = parts[1:1 + num_dense]
-                dense[i] = [float(p) if p else 0.0 for p in drow] + [0.0] * (
+                dense[i] = [_float0(p) for p in drow] + [0.0] * (
                     num_dense - len(drow)
                 )
                 crow = parts[1 + num_dense:][:num_cat]
-                cat[i] = [int(p, 16) & 0x7FFFFFFF if p else 0 for p in crow] + [
-                    0
-                ] * (num_cat - len(crow))
+                cat[i] = [_hex0(p) for p in crow] + [0] * (num_cat - len(crow))
         return {"dense": dense, "cat": cat}, labels
 
     return parse_batch
@@ -267,7 +292,7 @@ def numeric_batch_parser(
         else:
             for i, record in enumerate(records):
                 parts = record.decode("utf-8", errors="replace").strip().split(sep)
-                vals = [float(p) if p else 0.0 for p in parts[:num_cols]]
+                vals = [_float0(p) for p in parts[:num_cols]]
                 vals += [0.0] * (num_cols - len(vals))
                 if label_col >= 0:
                     labels[i] = int(vals[label_col])

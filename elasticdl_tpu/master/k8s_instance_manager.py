@@ -306,7 +306,19 @@ class K8sInstanceManager:
                 with self._lock:
                     self._status[wid] = PodStatus.SUCCEEDED
             elif event.phase == "Failed":
-                self._on_pod_death(wid, f"pod {event.name} Failed")
+                with self._lock:
+                    # same terminal guard as DELETED: a budget-exhausted
+                    # worker's Failed pod lingers in the cluster (no relaunch
+                    # deletes it), and every watch reconnect re-lists it as
+                    # ADDED/Failed for the same generation — without this,
+                    # each reconnect re-fires _on_pod_death (repeat
+                    # mark_dead; status corruption once the job finishes)
+                    terminal = self._status.get(wid) in (
+                        PodStatus.SUCCEEDED, PodStatus.FAILED,
+                        PodStatus.DELETED,
+                    )
+                if not terminal:
+                    self._on_pod_death(wid, f"pod {event.name} Failed")
         elif event.type == "DELETED":
             with self._lock:
                 terminal = self._status.get(wid) in (

@@ -96,6 +96,7 @@ class TaskDispatcher:
         self._finished_training = 0
         self._failed_permanently = 0
         self._training_done = False
+        self._stop_training = False
         self._epoch_end_fired = False
         self._job_end_fired = False
         self._epoch_end_callbacks: List[Callable[[int], None]] = []
@@ -258,7 +259,7 @@ class TaskDispatcher:
                         self._completed_versions += 1
                 else:
                     task.start += done
-                    self._todo.appendleft(task)
+                    self._requeue_locked(task, "preemption remainder")
                     logger.info(
                         "task %d preempted after %d records; requeued remainder "
                         "[%d, %d)", task_id, done, task.start, task.end,
@@ -270,12 +271,25 @@ class TaskDispatcher:
                         "task %d failed (%s); requeue retry %d",
                         task_id, err, task.retries,
                     )
-                    self._todo.appendleft(task)
+                    self._requeue_locked(task, "failure retry")
                 else:
                     self._fail_permanently_locked(task, err)
             callbacks = self._maybe_advance_epoch_locked()
         self._flush_callbacks(callbacks)
         return True
+
+    def _requeue_locked(self, task: TaskSpec, why: str) -> None:
+        """Put a task back on todo — unless it's a TRAINING task after
+        request_stop_training, which would resurrect training the early stop
+        already ended (the one-shot queue purge can't catch tasks that were
+        in flight when the stop fired)."""
+        if self._stop_training and task.type == pb.TRAINING:
+            logger.info(
+                "dropping training task %d (%s) after stop request",
+                task.task_id, why,
+            )
+            return
+        self._todo.appendleft(task)
 
     def _fail_permanently_locked(self, task: TaskSpec, err: str) -> None:
         self._failed_permanently += 1
@@ -292,7 +306,7 @@ class TaskDispatcher:
             stale = [t for t, l in self._doing.items() if l.worker_id == worker_id]
             for tid in stale:
                 task = self._doing.pop(tid).task
-                self._todo.appendleft(task)
+                self._requeue_locked(task, f"worker {worker_id} died")
         if stale:
             logger.info("recovered %d tasks from worker %d", len(stale), worker_id)
         return len(stale)
@@ -312,7 +326,7 @@ class TaskDispatcher:
                     "task %d lease expired (worker %d); requeued",
                     tid, lease.worker_id,
                 )
-                self._todo.appendleft(lease.task)
+                self._requeue_locked(lease.task, "lease expired")
             else:
                 self._fail_permanently_locked(lease.task, "lease expired")
 
@@ -382,6 +396,7 @@ class TaskDispatcher:
         usual epoch-end → final-eval → SAVE_MODEL → job-end sequence."""
         callbacks: List[Callable] = []
         with self._lock:
+            self._stop_training = True   # _requeue_locked drops in-flight ones
             before = len(self._todo)
             self._todo = deque(t for t in self._todo if t.type != pb.TRAINING)
             dropped = before - len(self._todo)

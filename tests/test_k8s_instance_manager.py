@@ -142,6 +142,26 @@ def test_relaunch_budget_exhaustion_marks_failed(manager_setup):
     # budget = relaunch_max creations beyond the original
     assert _count_worker(api, 0) == 1 + cfg.relaunch_max
 
+    # watch-reconnect replay (code-review round 3): the budget-exhausted
+    # worker's Failed pod lingers and re-lists as ADDED/Failed on every
+    # reconnect — FAILED must stay terminal (no extra relaunch, no status
+    # flip), exactly like the DELETED branch
+    last = f"kj-worker-0-g{cfg.relaunch_max}"
+    # drain the job so _job_finished_fn() is true — the un-guarded path
+    # would now flip FAILED -> SUCCEEDED on the replayed event
+    while True:
+        t = _d.get(worker_id=1)
+        if t is None:
+            break
+        _d.report(t.task_id, 1, True)
+    assert _d.finished()
+    mgr._job_finished_fn = _d.finished  # the fixture wires api only
+    api.push(last, "Failed", type_="ADDED")
+    api.push(last, "Failed", type_="ADDED")
+    time.sleep(0.3)
+    assert mgr.statuses().get(0) == PodStatus.FAILED
+    assert _count_worker(api, 0) == 1 + cfg.relaunch_max
+
 
 def test_deleted_event_and_succeeded_are_terminal(manager_setup):
     cfg, api, _m, _d, mgr = manager_setup

@@ -52,6 +52,35 @@ def test_criteo_native_matches_python_fallback(force_python_fallback):
     np.testing.assert_array_equal(py_feats["cat"], nat_feats["cat"])
 
 
+def test_malformed_fields_degrade_to_zero_in_both_twins(force_python_fallback):
+    """Garbage fields must parse as 0 in BOTH the Python fallback and the
+    C++ kernel — not raise (code-review round 3: results must not differ by
+    deployment toolchain, and one bad record must not burn a task's
+    retries)."""
+    bad = [
+        b"abc\tnan\tinf" + b"\t" * 11 + b"\txyz\t-" + b"\t" * 24,  # garbage
+        b"2\t" + b"\t".join(b"1" for _ in range(13)) + b"\t" +
+        b"\t".join(b"g" for _ in range(26)),  # 'g' is not hex
+    ]
+    py_feats, py_labels = parsing.criteo_batch_parser()(bad)
+    assert py_labels[0] == 0 and py_labels[1] == 2
+    assert py_feats["dense"][0, 0] == 0.0 and py_feats["dense"][0, 1] == 0.0
+    assert py_feats["cat"][0, 0] == 0 and py_feats["cat"][1, 0] == 0
+    py_num, py_nlab = parsing.numeric_batch_parser(3, label_col=0)(
+        [b"1,foo,2", b"bar,3,4"])
+    np.testing.assert_array_equal(py_nlab, [1, 0])
+    np.testing.assert_allclose(py_num, [[0.0, 2.0], [3.0, 4.0]])
+
+    parsing._lib_loaded = False  # now the native twin, same inputs
+    parsing._lib = None
+    if parsing._load() is None:
+        pytest.skip("native batch_parse unavailable")
+    nat_feats, nat_labels = parsing.criteo_batch_parser()(bad)
+    np.testing.assert_array_equal(py_labels, nat_labels)
+    np.testing.assert_allclose(py_feats["dense"], nat_feats["dense"])
+    np.testing.assert_array_equal(py_feats["cat"], nat_feats["cat"])
+
+
 def test_criteo_matches_legacy_per_record_parser():
     """The batch parser must reproduce the original per-record dataset_fn
     (model_zoo/deepfm round-2 revision) bit-for-bit on well-formed data."""

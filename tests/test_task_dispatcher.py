@@ -225,3 +225,32 @@ def test_request_stop_training_drops_queue_and_ends_job():
     assert d.get(0) is None
     assert d.finished()
     assert d.counts()["epoch"] == 0  # epoch 1..4 never started
+
+
+def test_request_stop_training_drops_failed_inflight_task():
+    """A leased training task that FAILS after the stop request must not be
+    requeued/retried — the one-shot queue purge can't see in-flight leases
+    (code-review round 3)."""
+    d = make(num_records=100, rpt=10, epochs=5)
+    t = d.get(0)
+    d.request_stop_training("test")
+    assert d.report(t.task_id, 0, False, err="boom")  # would retry normally
+    assert d.counts()["todo"] == 0                    # dropped, not requeued
+    assert d.get(0) is None
+    assert d.finished()
+
+
+def test_request_stop_training_drops_recovered_and_expired_tasks():
+    """Same hole via the two other requeue paths: dead-worker recovery and
+    lease expiry must not resurrect training after a stop."""
+    d = make(num_records=100, rpt=10, epochs=5)
+    t1 = d.get(0)
+    t2 = d.get(1)
+    assert t1 and t2
+    d.request_stop_training("test")
+    d.recover_tasks(0)                  # worker 0 died with t1 leased
+    assert d.counts()["todo"] == 0
+    d._task_timeout_s = 0.0             # expire t2's lease instantly
+    assert d.get(2) is None             # get() reaps expired leases
+    assert d.counts()["todo"] == 0
+    assert d.finished()
