@@ -1,0 +1,392 @@
+"""Pallas TPU flash-attention kernel — the fused hot path behind
+`ops.attention.full_attention`.
+
+Net-new relative to the reference (william-wang/elasticdl has no attention
+anywhere — SURVEY §5 long-context), but central to the rebuild's transformer
+path: the XLA fallback materializes the (B, H, Tq, Tk) score matrix in HBM,
+which caps sequence length and burns HBM bandwidth; this kernel streams KV
+blocks through VMEM with the online-softmax recurrence so scores never leave
+the chip's vector memory, and the backward recomputes them blockwise
+(flash-attention style) instead of saving them.
+
+Layout: the public contract is (B, T, H, D) like `full_attention`; the
+kernel internally works on (B, H, T, D) because Mosaic requires the last two
+block dims to be (8·k, 128·k)-tiled or full — a per-head (…, 1, D) block in
+the (B, T, H, D) layout violates that. The only residual saved is the
+logsumexp, lane-broadcast to (B, H, Tq, 128) (TPU scratch/IO wants a 128
+lane minor); `delta = rowsum(do·o)` is recomputed in-kernel from the o/do
+blocks rather than stored.
+
+`q_offset`/`kv_offset` position the local blocks in a GLOBAL sequence for
+causal masking, mirroring `full_attention`'s contract; they must be static
+Python ints here (the Ulysses all-to-all path and unsharded attention use
+offset 0; ring attention keeps its own blockwise-XLA recurrence because its
+offsets are traced per ppermute step).
+
+Fully-masked causal blocks are skipped (`pl.when`), giving the ~2x causal
+FLOP saving without dynamic shapes. Fully-masked ROWS (possible only with
+exotic offsets) return 0, unlike the XLA path's finite-NEG_BIG uniform
+softmax — zero is the defensible answer and no real caller produces them.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -1e30  # finite "-inf", matches ops.attention
+_LANE = 128      # TPU lane width: minor dims of scratch/residuals
+
+# Tuned on TPU v5 lite, T=4096 H8 D64 fwd+bwd: (256,256) 14.0ms,
+# (512,512) 7.6ms, (512,1024) 5.9ms, (1024,1024) 5.5ms. Large KV blocks
+# amortize the per-grid-step overhead; VMEM at (1024,1024) stays ~10 MB
+# (the f32 score block dominates: bq*bk*4 = 4 MB).
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
+
+
+def pick_block(t: int, target: int) -> Optional[int]:
+    """Largest power-of-two block <= target that divides t (>= 8 sublanes
+    for a float32 tile). None when t has no such divisor: caller falls back
+    to the XLA path rather than padding."""
+    b = 1
+    while b * 2 <= min(t, target):
+        b *= 2
+    while b >= 8:
+        if t % b == 0:
+            return b
+        b //= 2
+    return None
+
+
+def _causal_p_mask(p, q_start, kv_start, block_q, block_k):
+    q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    kv_pos = kv_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    return jnp.where(kv_pos <= q_pos, p, 0.0) if p is not None else kv_pos <= q_pos
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, causal, q_off, kv_off, block_q, block_k, num_kv):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_start = q_off + i * block_q
+    kv_start = kv_off + j * block_k
+    # causal: skip KV blocks entirely above the diagonal
+    live = (not causal) or (kv_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]                             # (bq, D)
+        k = k_ref[0, 0]                             # (bk, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                   # (bq, bk)
+        if causal:
+            mask = _causal_p_mask(None, q_start, kv_start, block_q, block_k)
+            s = jnp.where(mask, s, NEG_BIG)
+
+        m_prev = m_scr[:, :1]                       # (bq, 1)
+        l_prev = l_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                      # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)             # (bq, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p, v.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[2:]
+        )
+
+
+def _flash_fwd(qt, kt, vt, *, causal, q_off, kv_off, bq, bk, interpret):
+    """qt/kt/vt: (B, H, T, D)."""
+    B, H, Tq, D = qt.shape
+    Tk = kt.shape[2]
+    num_q, num_kv = Tq // bq, Tk // bk
+    scale = D ** -0.5
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, q_off=q_off, kv_off=kv_off,
+        block_q=bq, block_k=bk, num_kv=num_kv,
+    )
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, num_q, num_kv),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=[
+            q_spec,
+            pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qt.shape, qt.dtype),
+            jax.ShapeDtypeStruct((B, H, Tq, _LANE), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out, lse
+
+
+# ---------------------------------------------------------------- backward
+
+
+def _p_and_ds(q, k, v, do, lse, delta, *, scale, causal, q_start, kv_start,
+              block_q, block_k):
+    """Recompute the (bq, bk) p block from saved lse, and ds = p*(dp-delta).
+    lse/delta: (bq, 1) float32."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32,
+    ) * scale
+    p = jnp.exp(s - lse)
+    if causal:
+        p = _causal_p_mask(p, q_start, kv_start, block_q, block_k)
+    dp = jax.lax.dot_general(
+        do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                               # (bq, bk)
+    ds = p * (dp - delta)
+    return p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
+                   dq_acc, delta_scr, *, scale, causal, q_off, kv_off,
+                   block_q, block_k, num_kv):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        delta_scr[:] = jnp.broadcast_to(
+            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape)
+
+    q_start = q_off + i * block_q
+    kv_start = kv_off + j * block_k
+    live = (not causal) or (kv_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        _, ds = _p_and_ds(
+            q, k, v_ref[0, 0], do, lse_ref[0, 0, :, :1], delta_scr[:, :1],
+            scale=scale, causal=causal, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_k=block_k)
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    q_off, kv_off, block_q, block_k, num_q):
+    kv = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = q_off + qi * block_q
+    kv_start = kv_off + kv * block_k
+    live = (not causal) or (kv_start <= q_start + block_q - 1)
+
+    @pl.when(live)
+    def _accumulate():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        do = do_ref[0, 0].astype(jnp.float32)
+        o = o_ref[0, 0].astype(jnp.float32)
+        delta = jnp.sum(do * o, axis=-1, keepdims=True)   # (bq, 1)
+        p, ds = _p_and_ds(
+            q, k, v_ref[0, 0], do, lse_ref[0, 0, :, :1], delta,
+            scale=scale, causal=causal, q_start=q_start, kv_start=kv_start,
+            block_q=block_q, block_k=block_k)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                                  # (bk, D)
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                                          # (bk, D)
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(res, g, *, causal, q_off, kv_off, bq, bk, interpret):
+    qt, kt, vt, ot, lse = res                       # all (B, H, T, D) / lse 4D
+    B, H, Tq, D = qt.shape
+    Tk = kt.shape[2]
+    num_q, num_kv = Tq // bq, Tk // bk
+    scale = D ** -0.5
+    gt = g.transpose(0, 2, 1, 3)                    # (B, H, Tq, D)
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, i, j: (b, h, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel, scale=scale, causal=causal, q_off=q_off,
+            kv_off=kv_off, block_q=bq, block_k=bk, num_kv=num_kv),
+        grid=(B, H, num_q, num_kv),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct(qt.shape, qt.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, ot, gt, lse)[0]
+
+    # dk/dv sweep: kv block outer (revisited output), q block inner
+    q_spec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, x, y: (b, h, y, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, x, y: (b, h, x, 0))
+    lse_spec2 = pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, x, y: (b, h, y, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal, q_off=q_off,
+            kv_off=kv_off, block_q=bq, block_k=bk, num_q=num_q),
+        grid=(B, H, num_kv, num_q),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
+        out_specs=[kv_spec2, kv_spec2],
+        out_shape=[
+            jax.ShapeDtypeStruct(kt.shape, kt.dtype),
+            jax.ShapeDtypeStruct(vt.shape, vt.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt, ot, gt, lse)
+
+    back = lambda x: x.transpose(0, 2, 1, 3)
+    return back(dq), back(dk), back(dv)
+
+
+# ---------------------------------------------------------------- public
+
+
+@functools.lru_cache(maxsize=None)
+def _make_flash(causal: bool, q_off: int, kv_off: int, bq: int, bk: int,
+                interpret: bool):
+    def _fwd_transposed(q, k, v):
+        qt = q.transpose(0, 2, 1, 3)
+        kt = k.transpose(0, 2, 1, 3)
+        vt = v.transpose(0, 2, 1, 3)
+        out, lse = _flash_fwd(qt, kt, vt, causal=causal, q_off=q_off,
+                              kv_off=kv_off, bq=bq, bk=bk, interpret=interpret)
+        return (qt, kt, vt, out, lse)
+
+    @jax.custom_vjp
+    def flash(q, k, v):
+        res = _fwd_transposed(q, k, v)
+        return res[3].transpose(0, 2, 1, 3)
+
+    def fwd(q, k, v):
+        res = _fwd_transposed(q, k, v)
+        return res[3].transpose(0, 2, 1, 3), res
+
+    def bwd(res, g):
+        return _flash_bwd(res, g, causal=causal, q_off=q_off, kv_off=kv_off,
+                          bq=bq, bk=bk, interpret=interpret)
+
+    flash.defvjp(fwd, bwd)
+    return flash
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True,
+    q_offset: int = 0, kv_offset: int = 0,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over (B, T, H, D) q/k/v; same contract as
+    `ops.attention.full_attention`. Offsets must be static ints. Raises
+    ValueError when the shapes can't be blocked — use `can_flash` first."""
+    blocks = _plan_blocks(q.shape, k.shape, block_q, block_k)
+    if blocks is None:
+        raise ValueError(
+            f"flash_attention cannot block Tq={q.shape[1]}, Tk={k.shape[1]} "
+            f"(need a power-of-two divisor >= 8)")
+    bq, bk = blocks
+    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
+        raise ValueError("flash_attention offsets must be static Python ints")
+    return _make_flash(bool(causal), q_offset, kv_offset, bq, bk,
+                       bool(interpret))(q, k, v)
+
+
+def _plan_blocks(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
+                 block_q: int, block_k: int) -> Optional[Tuple[int, int]]:
+    bq = pick_block(q_shape[1], block_q)
+    bk = pick_block(k_shape[1], block_k)
+    if bq is None or bk is None:
+        return None
+    return bq, bk
+
+
+def can_flash(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
+              q_offset=0, kv_offset=0) -> bool:
+    """True when flash_attention supports these shapes/offsets AND the
+    backend is TPU (the Mosaic kernel has no CPU/GPU compile path; interpret
+    mode is for tests only). EDL_FLASH=0 force-disables, =1 force-enables
+    (e.g. under force_tpu_interpret_mode in tests)."""
+    flag = os.environ.get("EDL_FLASH", "")
+    if flag == "0":
+        return False
+    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
+        return False
+    if _plan_blocks(q_shape, k_shape, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K) is None:
+        return False
+    if flag == "1":
+        return True
+    return jax.default_backend() == "tpu"
