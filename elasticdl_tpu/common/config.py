@@ -103,6 +103,11 @@ class JobConfig:
     # Elastic linear LR scaling: on membership change, scale the (injected)
     # learning rate by alive_workers/num_workers (see training/lr_modulation)
     scale_lr_with_workers: bool = False
+    # >1: workers run K train steps per XLA dispatch (Trainer.train_many,
+    # lax.scan over a stacked batch group) — amortizes host->device dispatch
+    # latency; loss/step-time telemetry becomes per-group, preemption checks
+    # happen at group boundaries.
+    steps_per_dispatch: int = 1
     # Async host->device batch prefetch depth (0 disables; see data/prefetch)
     prefetch_batches: int = 2
     # Wire dtype for float batch features ("" = native, "bfloat16" halves
@@ -134,6 +139,10 @@ class JobConfig:
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
+    # Multi-slice: per-axis DCN (across-slice) factors, named form only
+    # ("data=2" = data-parallel across 2 slices). mesh_shape then describes
+    # ONE slice's ICI layout; see parallel/mesh.build_hybrid_mesh.
+    dcn_mesh_shape: str = ""
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = False            # jax.checkpoint the forward pass
@@ -273,6 +282,32 @@ class JobConfig:
 
     def replace(self, **kw: Any) -> "JobConfig":
         return dataclasses.replace(self, **kw)
+
+    def dcn_axes_sizes(self) -> Dict[str, int]:
+        """Parse `dcn_mesh_shape` (named form only; {} when unset)."""
+        if not self.dcn_mesh_shape:
+            return {}
+        if "=" not in self.dcn_mesh_shape:
+            raise ValueError(
+                f"dcn_mesh_shape must use the named form 'data=2', got "
+                f"{self.dcn_mesh_shape!r}"
+            )
+        sizes: Dict[str, int] = {}
+        for part in self.dcn_mesh_shape.split(","):
+            name, _, size = part.partition("=")
+            name = name.strip()
+            if not name or not size.strip().isdigit() or int(size) < 1:
+                raise ValueError(
+                    f"dcn_mesh_shape entry {part!r} is not name=positive-size "
+                    f"(got dcn_mesh_shape={self.dcn_mesh_shape!r})"
+                )
+            if name in sizes:
+                raise ValueError(
+                    f"dcn_mesh_shape names axis {name!r} twice: "
+                    f"{self.dcn_mesh_shape!r}"
+                )
+            sizes[name] = int(size)
+        return sizes
 
     def mesh_axes_sizes(self, n_devices: int) -> Dict[str, int]:
         """Resolve `mesh_shape` against an actual device count.

@@ -31,6 +31,7 @@ preprocessing.hashing) bounds the table like the reference's Hashing layer.
 
 from __future__ import annotations
 
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -41,6 +42,49 @@ from elasticdl_tpu.common.constants import MeshAxis
 from elasticdl_tpu.common.log_utils import default_logger
 
 logger = default_logger(__name__)
+
+
+@jax.custom_vjp
+def gather_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
+    """`table[ids]` whose BACKWARD is a sorted segment-sum instead of XLA's
+    scatter-add.
+
+    Why: on TPU, XLA lowers the take-VJP's unsorted scatter-add essentially
+    row-serially — measured round 3 (honest timing): 213k-row gather from a
+    2.6M x 16 table runs at 46M rows/s, but its backward scatter at 0.18M
+    rows/s, making the embedding UPDATE ~250x slower than the lookup and
+    binding the whole DeepFM step. Sorting the ids first (argsort is a fast
+    TPU sort) and accumulating with `segment_sum(indices_are_sorted=True)`
+    gives XLA a contiguous, vectorizable update pattern. Toggle with
+    EDL_EMB_SCATTER=xla to fall back to the plain take (bench comparison)."""
+    return jnp.take(table, ids, axis=0)
+
+
+def _gather_rows_fwd(table, ids):
+    return gather_rows(table, ids), (
+        ids, jnp.empty((0,), table.dtype), table.shape[0],
+    )
+
+
+def _gather_rows_bwd(res, ct):
+    ids, proto, num_rows = res
+    flat = ids.reshape(-1)
+    cf = ct.reshape(-1, ct.shape[-1]).astype(jnp.float32)
+    order = jnp.argsort(flat)
+    d_table = jax.ops.segment_sum(
+        cf[order], flat[order], num_segments=num_rows,
+        indices_are_sorted=True,
+    )
+    return d_table.astype(proto.dtype), None
+
+
+gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
+
+
+def _take(table: jax.Array, ids: jax.Array) -> jax.Array:
+    if os.environ.get("EDL_EMB_SCATTER", "sorted") == "xla":
+        return jnp.take(table, ids, axis=0)
+    return gather_rows(table, ids)
 
 # Table rows are padded to a multiple of this so every device of any mesh up
 # to this many chips gets an equal shard (shard_map needs even shards).
@@ -80,7 +124,7 @@ def embedding_lookup(
     safe_ids = jnp.where(in_range, ids, 0)
 
     if mode == "auto" or not axes:
-        out = jnp.take(table, safe_ids, axis=0)
+        out = _take(table, safe_ids)
         return jnp.where(in_range[..., None], out, 0.0)
 
     if mode != "manual":
@@ -103,7 +147,7 @@ def embedding_lookup(
             "lookup for this mesh (align the vocab via padded_vocab for the "
             "manual schedule)", table.shape[0], n_shards,
         )
-        out = jnp.take(table, safe_ids, axis=0)
+        out = _take(table, safe_ids)
         return jnp.where(in_range[..., None], out, 0.0)
 
     ids2d = safe_ids.reshape(safe_ids.shape[0], -1)  # (B, L)
@@ -116,7 +160,7 @@ def embedding_lookup(
         local = all_ids - offset
         owned = (local >= 0) & (local < table_shard.shape[0])
         part = jnp.where(
-            owned[..., None], table_shard[jnp.where(owned, local, 0)], 0.0
+            owned[..., None], _take(table_shard, jnp.where(owned, local, 0)), 0.0
         )  # (B, L, D)
         out = jax.lax.psum_scatter(
             part, data_ax, scatter_dimension=0, tiled=True

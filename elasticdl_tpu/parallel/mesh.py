@@ -44,6 +44,84 @@ def build_mesh(
     return Mesh(dev_array, names)
 
 
+def build_hybrid_mesh(
+    ici_axis_sizes: Dict[str, int],
+    dcn_axis_sizes: Dict[str, int],
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Multi-slice mesh: each named axis is the product of its ICI (within-
+    slice) and DCN (across-slice) factors, with the DCN factor slowest-
+    varying — collectives on such an axis decompose hierarchically (XLA
+    reduces within each slice over ICI first, then once across slices over
+    DCN), which is the standard TPU multi-pod recipe: put data-parallel
+    across slices ({"data": n_slices} in `dcn_axis_sizes`) and keep
+    model/seq sharding inside a slice's ICI.
+
+    Replaces the reference's flat NCCL/Gloo world (reference:
+    elasticdl/python/collective_ops/ + Horovod ring over whatever network
+    exists) with an explicitly two-tier fabric. On real multi-slice TPU the
+    device order comes from `mesh_utils.create_hybrid_device_mesh` (honors
+    slice_index); elsewhere (CPU meshes, single slice) the same layout is
+    built by grouping `devices` into contiguous per-slice blocks.
+    """
+    names = tuple(
+        dict.fromkeys(tuple(ici_axis_sizes) + tuple(dcn_axis_sizes))
+    )
+    ici = tuple(int(ici_axis_sizes.get(a, 1)) for a in names)
+    dcn = tuple(int(dcn_axis_sizes.get(a, 1)) for a in names)
+    devices = list(devices if devices is not None else jax.devices())
+    total = int(np.prod(ici)) * int(np.prod(dcn))
+    if total != len(devices):
+        raise ValueError(
+            f"hybrid mesh ici={dict(ici_axis_sizes)} x "
+            f"dcn={dict(dcn_axis_sizes)} needs {total} devices, "
+            f"have {len(devices)}"
+        )
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn, devices=devices, allow_split_physical_axes=True,
+        )
+    except Exception:
+        # virtual/CPU devices carry no slice topology: contiguous blocks of
+        # prod(ici) devices act as slices, then per-axis (dcn_i, ici_i)
+        # pairs collapse into one axis with dcn slowest-varying
+        arr = np.asarray(devices).reshape(dcn + ici)
+        n = len(names)
+        perm = [k for i in range(n) for k in (i, n + i)]
+        dev_array = arr.transpose(perm).reshape(
+            tuple(d * s for d, s in zip(dcn, ici))
+        )
+    return Mesh(dev_array, names)
+
+
+def build_job_mesh(cfg, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """The mesh a job's config asks for: flat (`--mesh_shape`) or hybrid
+    multi-slice (`--dcn_mesh_shape` names the across-slice factors, and
+    `--mesh_shape` then describes ONE slice's ICI layout). The single entry
+    point used by the worker and cohort paths."""
+    devices = list(devices if devices is not None else jax.devices())
+    dcn = cfg.dcn_axes_sizes()
+    if dcn:
+        n_slices = int(np.prod(list(dcn.values())))
+        if len(devices) % n_slices:
+            raise ValueError(
+                f"dcn_mesh_shape {cfg.dcn_mesh_shape!r} implies {n_slices} "
+                f"slices, which does not divide {len(devices)} devices"
+            )
+        per_slice = len(devices) // n_slices
+        ici = (
+            cfg.mesh_axes_sizes(per_slice)
+            if cfg.mesh_shape else {MeshAxis.DATA: per_slice}
+        )
+        return build_hybrid_mesh(ici, dcn, devices)
+    return build_mesh(
+        cfg.mesh_axes_sizes(len(devices)) if cfg.mesh_shape else None,
+        devices,
+    )
+
+
 def data_axis(mesh: Mesh) -> str:
     return MeshAxis.DATA if MeshAxis.DATA in mesh.axis_names else mesh.axis_names[0]
 
@@ -100,6 +178,30 @@ def shard_batch(mesh: Mesh, batch, partition=None):
             if spec is not None else default
         )
         out[key] = jax.tree_util.tree_map(put_with(sh), value)
+    return out
+
+
+def shard_batch_stack(mesh: Mesh, batches, partition=None):
+    """Stack K host batches into one pytree with a leading step axis —
+    leaves (K, B, ...), device_put as P(None, <batch spec>) — for
+    `Trainer.train_many` (one dispatch runs all K steps via lax.scan)."""
+    default_spec = P(data_axis(mesh))
+
+    def spec_for(key):
+        if partition and partition.get(key) is not None:
+            return prune_spec(mesh, partition[key])
+        return default_spec
+
+    out = {}
+    for key in batches[0]:
+        sh = NamedSharding(mesh, P(None, *spec_for(key)))
+
+        def put(*leaves, _sh=sh):
+            return jax.device_put(
+                np.stack([np.asarray(l) for l in leaves]), _sh
+            )
+
+        out[key] = jax.tree_util.tree_map(put, *(b[key] for b in batches))
     return out
 
 

@@ -79,15 +79,11 @@ class CohortWorker:
     def _build(self) -> None:
         import jax
 
-        from elasticdl_tpu.parallel.mesh import build_mesh
+        from elasticdl_tpu.parallel.mesh import build_job_mesh
         from elasticdl_tpu.training.trainer import Trainer
 
         self._spec = ModelSpec.from_config(self.cfg)
-        self._mesh = build_mesh(
-            self.cfg.mesh_axes_sizes(len(jax.devices()))
-            if self.cfg.mesh_shape else None,
-            jax.devices(),
-        )
+        self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(
             self._spec, self._mesh, remat=self.cfg.remat,
             seed=self.cfg.shuffle_seed,

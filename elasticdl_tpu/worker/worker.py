@@ -53,6 +53,13 @@ class Worker:
         self._pending_lr = None       # set by heartbeat thread, applied by run loop
         self._last_known_workers = 0  # latest alive count (register/heartbeat)
         self._global_step = 0         # train steps run by this worker
+        # Plain-int mirror of state.model_version, maintained by the MAIN
+        # thread at state creation/restore and after each step/group. The
+        # heartbeat thread must read THIS, never state.model_version:
+        # int(state.step) blocks on the in-flight donated computation, so a
+        # multi-second dispatch (train_many groups, big compiles) would
+        # silently stall heartbeats until the master declares us dead.
+        self._model_version = 0
         self._profile_state = "idle"  # idle -> active -> done (jax.profiler)
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
 
@@ -81,17 +88,13 @@ class Worker:
         )
 
     def _build_trainer(self) -> None:
-        from elasticdl_tpu.parallel.mesh import build_mesh, data_axis
+        from elasticdl_tpu.parallel.mesh import build_job_mesh, data_axis
         from elasticdl_tpu.training.trainer import Trainer
         import jax
 
         self._spec = ModelSpec.from_config(self.cfg)
         if self._mesh is None:
-            self._mesh = build_mesh(
-                self.cfg.mesh_axes_sizes(len(jax.devices()))
-                if self.cfg.mesh_shape
-                else None
-            )
+            self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(
             self._spec, self._mesh, remat=self.cfg.remat, seed=self.cfg.shuffle_seed
         )
@@ -185,6 +188,7 @@ class Worker:
                         self._last_known_workers or self.cfg.num_workers,
                         self.cfg.num_workers,
                     )
+        self._model_version = self._state.model_version
 
     def _maybe_checkpoint(self, force: bool = False) -> None:
         """Step-interval checkpointing (reference: --checkpoint_steps), plus
@@ -226,10 +230,10 @@ class Worker:
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
-                version = self._state.model_version if self._state is not None else 0
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
-                        worker_id=self.worker_id, model_version=version
+                        worker_id=self.worker_id,
+                        model_version=self._model_version,
                     ),
                     timeout=10,
                 )
@@ -319,6 +323,9 @@ class Worker:
         self._profile_state = "done"
 
     def _run_training_task(self, task: pb.Task) -> Dict[str, float]:
+        if self.cfg.steps_per_dispatch > 1:
+            return self._run_training_task_grouped(
+                task, self.cfg.steps_per_dispatch)
         svc = self._data_service(pb.TRAINING)
         loss_sum, loss_count = 0.0, 0
         records_done = 0
@@ -341,6 +348,7 @@ class Worker:
             step_time_sum += time.perf_counter() - t0
             loss_count += 1
             self._global_step += 1
+            self._model_version += 1
             # mask sums the real (non-padding) records this batch applied
             records_done += int(batch["mask"].sum())
         return {
@@ -350,6 +358,61 @@ class Worker:
             "step_time_sum": step_time_sum,
             "interrupted": interrupted,
         }
+
+    def _run_training_task_grouped(self, task: pb.Task, k: int) -> Dict[str, float]:
+        """--steps_per_dispatch > 1: buffer k host batches, run them as ONE
+        XLA dispatch (Trainer.train_many lax.scan). Exactly-once accounting
+        is unchanged — a group's records count as applied only after its
+        dispatch's loss is read back, and preemption stops at a group
+        boundary so the drain report covers whole groups. A trailing partial
+        group falls back to single train_steps (two compiled programs total,
+        not one per remainder length)."""
+        import jax.numpy as jnp
+
+        from elasticdl_tpu.data.prefetch import _wire_cast
+        from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
+        svc = self._data_service(pb.TRAINING)
+        stats = {"loss_sum": 0.0, "loss_count": 0, "records_done": 0,
+                 "step_time_sum": 0.0, "interrupted": False}
+        self._mid_training_task = True
+        buf = []
+
+        def flush():
+            if not buf:
+                return
+            self._maybe_profile()
+            t0 = time.perf_counter()
+            if len(buf) == k:
+                stacked = shard_batch_stack(
+                    self._mesh, buf, self._spec.batch_partition)
+                self._state, m = self._trainer.train_many(self._state, stacked)
+                stats["loss_sum"] += float(jnp.sum(m["loss"]))
+            else:
+                for b in buf:
+                    self._state, logs = self._trainer.train_step(self._state, b)
+                    stats["loss_sum"] += float(logs["loss"])
+            stats["step_time_sum"] += time.perf_counter() - t0
+            stats["loss_count"] += len(buf)
+            self._global_step += len(buf)
+            self._model_version += len(buf)
+            stats["records_done"] += int(sum(b["mask"].sum() for b in buf))
+            buf.clear()
+
+        for batch in svc.batches(task.shard_name, task.start, task.end):
+            if self._shutdown.is_set():
+                stats["interrupted"] = True
+                break
+            self._ensure_state(batch)
+            # same bf16 wire compression the single-step path gets from
+            # _prefetched (the mask leaf is exempted by _wire_cast itself,
+            # so flush()'s records accounting stays exact)
+            buf.append(_wire_cast(batch, self.cfg.wire_dtype))
+            if len(buf) == k:
+                flush()
+        if not stats["interrupted"]:
+            flush()
+        return stats
 
     def _report_preempted_task(self, task: pb.Task, stats: Dict[str, float]) -> None:
         """Drain protocol for an interrupted training task. Records may only
@@ -397,9 +460,7 @@ class Worker:
                     records_processed=records_done,
                     loss_sum=stats["loss_sum"],
                     loss_count=int(stats["loss_count"]),
-                    model_version=(
-                        self._state.model_version if self._state is not None else 0
-                    ),
+                    model_version=self._model_version,
                 ),
                 timeout=10,
             )
@@ -537,7 +598,7 @@ class Worker:
                     self._save_checkpoint()
                 report.records_processed = task.end - task.start
                 if self._state is not None:
-                    report.model_version = self._state.model_version
+                    report.model_version = self._model_version
             except Exception as e:
                 logger.exception("task %d failed", task.task_id)
                 report.success = False

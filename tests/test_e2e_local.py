@@ -80,6 +80,42 @@ def test_local_job_end_to_end(tmp_path):
     assert manager.all_exited()
 
 
+def test_local_job_with_grouped_dispatch(tmp_path):
+    """--steps_per_dispatch=2: the worker runs batch groups through
+    train_many (one XLA dispatch per 2 minibatches) and the job completes
+    with identical task accounting — 100-record tasks at minibatch 32 leave
+    a 4-batch task = 2 full groups, exercising group flush + the
+    partial-group fallback on the final 4-record batch... (4 batches: 32,32,
+    32,4 → one full group + one partial)."""
+    cfg = job_config(tmp_path, num_workers=1, steps_per_dispatch=2,
+                     wire_dtype="bfloat16")  # grouped path must honor the cast
+    master = Master(cfg)
+    manager = ProcessManager(
+        cfg,
+        membership=master.membership,
+        extra_env=HERMETIC_ENV,
+        log_dir=str(tmp_path / "logs"),
+    )
+    master.start()
+    manager.start_workers()
+    try:
+        ok = master.wait(timeout_s=420)
+        assert ok, (
+            "job did not finish; worker log:\n"
+            + (tmp_path / "logs" / "worker-0.log").read_text()[-4000:]
+        )
+        counts = master.dispatcher.counts()
+        assert counts["finished_training"] == 4
+        assert counts["failed_permanently"] == 0
+        # all 400 records were applied exactly once (grouped accounting)
+        assert master.servicer.mean_training_loss() is not None
+        results = master.evaluation.latest_results()
+        assert "accuracy" in results, results
+    finally:
+        master.shutdown(grace_s=2)
+        manager.stop()
+
+
 def test_profiling_and_step_time_summaries(tmp_path):
     """Round-3 observability (SURVEY §5 tracing): --profile_dir produces
     jax.profiler trace files, and the master's train summary stream carries

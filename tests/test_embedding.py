@@ -26,6 +26,30 @@ def make_table(mesh, V=512, D=16, seed=0):
     return table, sharded
 
 
+def test_gather_rows_sorted_backward_matches_xla(monkeypatch):
+    """gather_rows' sorted-segment-sum backward (the TPU scatter-add fix,
+    round 3 rev 2) must equal the plain take VJP — including duplicate ids
+    (accumulation) and bf16 cotangents."""
+    t = jnp.asarray(np.random.RandomState(0).randn(128, 16), jnp.float32)
+    ids = jnp.asarray([[3, 3, 7], [0, 127, 3]], jnp.int32)  # dup id 3 x3
+
+    g_sorted = jax.grad(lambda t: jnp.sum(emb_ops.gather_rows(t, ids) ** 2))(t)
+    g_xla = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g_sorted), np.asarray(g_xla),
+                               rtol=1e-6)
+
+    tb = t.astype(jnp.bfloat16)
+    gb = jax.grad(
+        lambda t: jnp.sum(emb_ops.gather_rows(t, ids).astype(jnp.float32) ** 2)
+    )(tb)
+    assert gb.dtype == jnp.bfloat16
+
+    # env toggle: EDL_EMB_SCATTER=xla routes _take back to plain jnp.take
+    monkeypatch.setenv("EDL_EMB_SCATTER", "xla")
+    g_env = jax.grad(lambda t: jnp.sum(emb_ops._take(t, ids) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g_env), np.asarray(g_xla), rtol=1e-6)
+
+
 @pytest.mark.parametrize("mesh_name", ["mesh8", "mesh_4x2"])
 @pytest.mark.parametrize("mode", ["manual", "auto"])
 def test_lookup_matches_dense(mesh_name, mode, request):

@@ -51,6 +51,30 @@ def test_loss_decreases(trainer, state0):
     assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
 
 
+def test_train_many_matches_stepwise(mesh8):
+    """lax.scan-of-steps (train_many, one dispatch) must produce the same
+    trajectory as K individual train_step dispatches — same final loss and
+    model_version (dispatch amortization is a pure packaging change)."""
+    from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
+    batches = [synthetic_batch(seed=i) for i in range(6)]
+
+    t1 = Trainer(make_spec(learning_rate=0.01), mesh8, seed=0)
+    s1 = t1.init_state(batches[0])
+    stepwise = []
+    for b in batches:
+        s1, logs = t1.train_step(s1, b)
+        stepwise.append(float(logs["loss"]))
+
+    t2 = Trainer(make_spec(learning_rate=0.01), mesh8, seed=0)
+    s2 = t2.init_state(batches[0])
+    s2, metrics = t2.train_many(s2, shard_batch_stack(mesh8, batches))
+    scanned = [float(x) for x in metrics["loss"]]
+
+    assert s2.model_version == s1.model_version == 6
+    np.testing.assert_allclose(scanned, stepwise, rtol=2e-4, atol=2e-4)
+
+
 def test_eval_metrics(trainer, state0):
     ms = trainer.new_metric_states()
     for i in range(3):
