@@ -68,10 +68,39 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     return out.astype(q.dtype)
 
 
+def _ring_scan(k, v, axis_name: str, manual_axes, consume, carry0):
+    """The shared ring rotation: consume the resident KV block, then rotate
+    KV around the ring with `ppermute` n-1 times, calling
+    `consume(carry, kb, vb, kv_block)` on each visiting block.
+
+    Invariant kept in ONE place for both ring bodies: permute FIRST inside
+    the scan — the resident block was consumed before the scan starts, so
+    only n-1 rotations cross the ring (no discarded final transfer) — and
+    scan carries are marked "varying" over the manual mesh axes like k/v.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+
+    def block(state, _):
+        carry, kb, vb, j = state
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        carry = consume(carry, kb, vb, (idx - j) % n)
+        return (carry, kb, vb, j + 1), None
+
+    mark = lambda x: lax.pcast(x, tuple(manual_axes), to="varying")
+    carry = consume(jax.tree_util.tree_map(mark, carry0), k, v, idx)
+    if n > 1:
+        (carry, _, _, _), _ = lax.scan(
+            block, (carry, k, v, mark(jnp.int32(1))), None, length=n - 1
+        )
+    return carry
+
+
 def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
                             manual_axes=()):
     """Per-shard body (inside shard_map): q,k,v are the LOCAL seq blocks."""
-    n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
@@ -80,8 +109,9 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
 
     q_pos = idx * Lq + jnp.arange(Lq)
 
-    def accumulate(o, m, l, kb, vb, kv_block):
+    def accumulate(carry, kb, vb, kv_block):
         """One online-softmax update against KV block `kv_block`."""
+        o, m, l = carry
         s = jnp.einsum("bqhd,bkhd->bhqk", qf, kb.astype(jnp.float32)) * scale
         if causal:
             kv_pos = kv_block * Lk + jnp.arange(Lk)
@@ -96,28 +126,54 @@ def _ring_attention_sharded(q, k, v, axis_name: str, causal: bool,
         )
         return o_new, m_new, l_new
 
-    def block(carry, _):
-        o, m, l, kb, vb, j = carry
-        # permute FIRST: the resident block was consumed before the scan, so
-        # only n-1 rotations cross the ring (no discarded final transfer)
-        perm = [(i, (i + 1) % n) for i in range(n)]
-        kb = lax.ppermute(kb, axis_name, perm)
-        vb = lax.ppermute(vb, axis_name, perm)
-        o, m, l = accumulate(o, m, l, kb, vb, (idx - j) % n)
-        return (o, m, l, kb, vb, j + 1), None
-
-    # scan carries must be "varying" over the manual mesh axes like k/v are
-    mark = lambda x: lax.pcast(x, tuple(manual_axes), to="varying")
-    o0 = mark(jnp.zeros((B, H, Lq, D), jnp.float32))
-    m0 = mark(jnp.full((B, H, Lq), NEG_BIG, jnp.float32))
-    l0 = mark(jnp.zeros((B, H, Lq), jnp.float32))
-    o, m, l = accumulate(o0, m0, l0, k, v, idx)                # resident block
-    if n > 1:
-        (o, m, l, _, _, _), _ = lax.scan(
-            block, (o, m, l, k, v, mark(jnp.int32(1))), None, length=n - 1
-        )
+    o, m, l = _ring_scan(
+        k, v, axis_name, manual_axes, accumulate,
+        (jnp.zeros((B, H, Lq, D), jnp.float32),
+         jnp.full((B, H, Lq), NEG_BIG, jnp.float32),
+         jnp.zeros((B, H, Lq), jnp.float32)),
+    )
     out = o / jnp.maximum(l, 1e-20)[..., None]                 # (B,H,Lq,D)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)           # (B,Lq,H,D)
+
+
+def _merge_flash_blocks(o1, lse1, o2, lse2):
+    """Combine two flash partials over the same q rows: softmax-weighted by
+    their logsumexps (exact — this is the associative flash-merge). o:
+    (B, Lq, H, D) f32; lse: (B, H, Lq) f32. Fully-masked partials carry
+    lse=NEG_BIG and weight out to 0."""
+    lse_new = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse_new).transpose(0, 2, 1)[..., None]  # (B,Lq,H,1)
+    w2 = jnp.exp(lse2 - lse_new).transpose(0, 2, 1)[..., None]
+    return o1 * w1 + o2 * w2, lse_new
+
+
+def _ring_attention_flash(q, k, v, axis_name: str, causal: bool,
+                          manual_axes=()):
+    """Ring attention whose per-rotation block compute is the Pallas flash
+    kernel (ops/pallas_attention.py): each device streams the visiting KV
+    block through flash_attention_lse with TRACED global offsets (they ride
+    scalar prefetch), then merges partials by logsumexp. Scores never
+    materialize even within a block, unlike the XLA recurrence above."""
+    from elasticdl_tpu.ops.pallas_attention import flash_attention_lse
+
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    q_off = idx * Lq
+
+    def accumulate(carry, kb, vb, kv_block):
+        o2, lse2 = flash_attention_lse(
+            q, kb, vb, causal=causal,
+            q_offset=q_off, kv_offset=kv_block * Lk)
+        return _merge_flash_blocks(*carry, o2.astype(jnp.float32), lse2)
+
+    # zero-weight initial carry: lse=NEG_BIG merges to "no contribution"
+    o, _ = _ring_scan(
+        k, v, axis_name, manual_axes, accumulate,
+        (jnp.zeros((B, Lq, H, D), jnp.float32),
+         jnp.full((B, H, Lq), NEG_BIG, jnp.float32)),
+    )
+    return o.astype(q.dtype)
 
 
 def _ulysses_sharded(q, k, v, axis_name: str, causal: bool):
@@ -159,10 +215,21 @@ def sequence_parallel_attention(
     spec = P(data_ax, axis, None, None)
     manual = tuple(a for a in (data_ax, axis) if a)
     if mode == "ring":
-        body = partial(
-            _ring_attention_sharded, axis_name=axis, causal=causal,
-            manual_axes=manual,
-        )
+        from elasticdl_tpu.ops import pallas_attention
+
+        # shard-LOCAL block shapes decide whether the flash kernel applies
+        seq_shards = mesh.shape[axis]
+        local = (q.shape[0], q.shape[1] // seq_shards) + q.shape[2:]
+        if pallas_attention.can_flash(local, local):
+            body = partial(
+                _ring_attention_flash, axis_name=axis, causal=causal,
+                manual_axes=manual,
+            )
+        else:
+            body = partial(
+                _ring_attention_sharded, axis_name=axis, causal=causal,
+                manual_axes=manual,
+            )
     elif mode == "ulysses":
         body = partial(_ulysses_sharded, axis_name=axis, causal=causal)
     else:

@@ -1,5 +1,5 @@
 """Pallas TPU flash-attention kernel — the fused hot path behind
-`ops.attention.full_attention`.
+`ops.attention.full_attention` and the ring-attention block compute.
 
 Net-new relative to the reference (william-wang/elasticdl has no attention
 anywhere — SURVEY §5 long-context), but central to the rebuild's transformer
@@ -18,15 +18,17 @@ lane minor); `delta = rowsum(do·o)` is recomputed in-kernel from the o/do
 blocks rather than stored.
 
 `q_offset`/`kv_offset` position the local blocks in a GLOBAL sequence for
-causal masking, mirroring `full_attention`'s contract; they must be static
-Python ints here (the Ulysses all-to-all path and unsharded attention use
-offset 0; ring attention keeps its own blockwise-XLA recurrence because its
-offsets are traced per ppermute step).
+causal masking, mirroring `full_attention`'s contract. They enter the kernel
+as SCALAR-PREFETCH values (SMEM), so they may be TRACED — ring attention
+passes a different kv offset each ppermute rotation. `flash_attention_lse`
+additionally returns the logsumexp, which is what lets ring attention merge
+per-block flash results exactly (see ops.attention._ring_attention_flash).
 
 Fully-masked causal blocks are skipped (`pl.when`), giving the ~2x causal
-FLOP saving without dynamic shapes. Fully-masked ROWS (possible only with
-exotic offsets) return 0, unlike the XLA path's finite-NEG_BIG uniform
-softmax — zero is the defensible answer and no real caller produces them.
+FLOP saving without dynamic shapes. Fully-masked ROWS (a q block entirely
+before every kv position) return 0 with lse=NEG_BIG, unlike the XLA path's
+finite-NEG_BIG uniform softmax — zero is the defensible answer, the ring
+merge relies on the NEG_BIG lse, and no real caller consumes such rows.
 """
 
 from __future__ import annotations
@@ -71,13 +73,26 @@ def _causal_p_mask(p, q_start, kv_start, block_q, block_k):
     return jnp.where(kv_pos <= q_pos, p, 0.0) if p is not None else kv_pos <= q_pos
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct that propagates `like`'s varying-mesh-axes set —
+    required for pallas_call outputs inside a shard_map manual region
+    (check_vma insists outputs declare their variance)."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-                scale, causal, q_off, kv_off, block_q, block_k, num_kv):
+def _fwd_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc, m_scr, l_scr, *, scale, causal, block_q, block_k,
+                num_kv):
     i = pl.program_id(2)
     j = pl.program_id(3)
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
 
     @pl.when(j == 0)
     def _init():
@@ -87,8 +102,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
 
     q_start = q_off + i * block_q
     kv_start = kv_off + j * block_k
-    # causal: skip KV blocks entirely above the diagonal
-    live = (not causal) or (kv_start <= q_start + block_q - 1)
+    # causal: skip KV blocks entirely above the diagonal (traced predicate
+    # — offsets come from SMEM, so this is runtime block skipping)
+    live = True if not causal else kv_start <= q_start + block_q - 1
 
     @pl.when(live)
     def _accumulate():
@@ -120,43 +136,51 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
     def _finalize():
         l = l_scr[:, :1]
         o_ref[0, 0] = (acc[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        # lse of a fully-masked row: m stays NEG_BIG and l stays 0 -> the
+        # log floor keeps it at ~NEG_BIG, which the ring merge treats as
+        # "no contribution"
         lse_ref[0, 0] = jnp.broadcast_to(
             m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[2:]
         )
 
 
-def _flash_fwd(qt, kt, vt, *, causal, q_off, kv_off, bq, bk, interpret):
-    """qt/kt/vt: (B, H, T, D)."""
+def _flash_fwd(offs, qt, kt, vt, *, causal, bq, bk, interpret):
+    """offs: (2,) int32 [q_off, kv_off]; qt/kt/vt: (B, H, T, D)."""
     B, H, Tq, D = qt.shape
     Tk = kt.shape[2]
     num_q, num_kv = Tq // bq, Tk // bk
     scale = D ** -0.5
 
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal, q_off=q_off, kv_off=kv_off,
+        _fwd_kernel, scale=scale, causal=causal,
         block_q=bq, block_k=bk, num_kv=num_kv,
     )
-    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
-    out, lse = pl.pallas_call(
-        kernel,
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j, offs: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, offs: (b, h, j, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(B, H, num_q, num_kv),
         in_specs=[q_spec, kv_spec, kv_spec],
         out_specs=[
             q_spec,
-            pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, i, j: (b, h, i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct(qt.shape, qt.dtype),
-            jax.ShapeDtypeStruct((B, H, Tq, _LANE), jnp.float32),
+            pl.BlockSpec((1, 1, bq, _LANE),
+                         lambda b, h, i, j, offs: (b, h, i, 0)),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, D), jnp.float32),
             pltpu.VMEM((bq, _LANE), jnp.float32),
             pltpu.VMEM((bq, _LANE), jnp.float32),
         ],
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            _sds(qt.shape, qt.dtype, qt),
+            _sds((B, H, Tq, _LANE), jnp.float32, qt),
+        ],
         interpret=interpret,
-    )(qt, kt, vt)
+    )(offs, qt, kt, vt)
     return out, lse
 
 
@@ -181,23 +205,29 @@ def _p_and_ds(q, k, v, do, lse, delta, *, scale, causal, q_start, kv_start,
     return p, ds
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-                   dq_acc, delta_scr, *, scale, causal, q_off, kv_off,
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   glse_ref, dq_ref, dq_acc, delta_scr, *, scale, causal,
                    block_q, block_k, num_kv):
     i = pl.program_id(2)
     j = pl.program_id(3)
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
 
     @pl.when(j == 0)
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
         do = do_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)
+        # dL/ds = p*(dp - delta) + g_lse*p = p*(dp - (delta - g_lse)):
+        # the lse cotangent folds into delta (dlse/ds_k = p_k)
         delta_scr[:] = jnp.broadcast_to(
-            jnp.sum(do * o, axis=-1, keepdims=True), delta_scr.shape)
+            jnp.sum(do * o, axis=-1, keepdims=True)
+            - (glse_ref[0, 0, :, :1] if glse_ref is not None else 0.0),
+            delta_scr.shape)
 
     q_start = q_off + i * block_q
     kv_start = kv_off + j * block_k
-    live = (not causal) or (kv_start <= q_start + block_q - 1)
+    live = True if not causal else kv_start <= q_start + block_q - 1
 
     @pl.when(live)
     def _accumulate():
@@ -218,11 +248,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
         dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
-                    q_off, kv_off, block_q, block_k, num_q):
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                    glse_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    causal, block_q, block_k, num_q):
     kv = pl.program_id(2)
     qi = pl.program_id(3)
+    q_off = offs_ref[0]
+    kv_off = offs_ref[1]
 
     @pl.when(qi == 0)
     def _init():
@@ -231,7 +263,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
 
     q_start = q_off + qi * block_q
     kv_start = kv_off + kv * block_k
-    live = (not causal) or (kv_start <= q_start + block_q - 1)
+    live = True if not causal else kv_start <= q_start + block_q - 1
 
     @pl.when(live)
     def _accumulate():
@@ -240,6 +272,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         do = do_ref[0, 0].astype(jnp.float32)
         o = o_ref[0, 0].astype(jnp.float32)
         delta = jnp.sum(do * o, axis=-1, keepdims=True)   # (bq, 1)
+        if glse_ref is not None:
+            delta = delta - glse_ref[0, 0, :, :1]
         p, ds = _p_and_ds(
             q, k, v_ref[0, 0], do, lse_ref[0, 0, :, :1], delta,
             scale=scale, causal=causal, q_start=q_start, kv_start=kv_start,
@@ -259,110 +293,166 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(res, g, *, causal, q_off, kv_off, bq, bk, interpret):
-    qt, kt, vt, ot, lse = res                       # all (B, H, T, D) / lse 4D
+def _flash_bwd(res, g, g_lse, *, causal, bq, bk, interpret):
+    """g: cotangent of out (B, T, H, D); g_lse: cotangent of lse (B, H, Tq)
+    or None (out-only variant)."""
+    offs, qt, kt, vt, ot, lse = res              # (B, H, T, D) / lse 4D
     B, H, Tq, D = qt.shape
     Tk = kt.shape[2]
     num_q, num_kv = Tq // bq, Tk // bk
     scale = D ** -0.5
-    gt = g.transpose(0, 2, 1, 3)                    # (B, H, Tq, D)
+    gt = g.transpose(0, 2, 1, 3)                 # (B, H, Tq, D)
+    with_glse = g_lse is not None
+    extra = ()
+    if with_glse:
+        extra = (jnp.broadcast_to(
+            g_lse.astype(jnp.float32)[..., None], (B, H, Tq, _LANE)),)
 
-    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j: (b, h, i, 0))
-    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j: (b, h, j, 0))
-    lse_spec = pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, i, j: (b, h, i, 0))
+    def dq_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                  *rest):
+        glse_ref, tail = (rest[0], rest[1:]) if with_glse else (None, rest)
+        _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
+                       lse_ref, glse_ref, *tail, scale=scale, causal=causal,
+                       block_q=bq, block_k=bk, num_kv=num_kv)
+
+    q_spec = pl.BlockSpec((1, 1, bq, D), lambda b, h, i, j, offs: (b, h, i, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, D), lambda b, h, i, j, offs: (b, h, j, 0))
+    lse_spec = pl.BlockSpec((1, 1, bq, _LANE),
+                            lambda b, h, i, j, offs: (b, h, i, 0))
 
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, q_off=q_off,
-            kv_off=kv_off, block_q=bq, block_k=bk, num_kv=num_kv),
-        grid=(B, H, num_q, num_kv),
-        in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec],
-        out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct(qt.shape, qt.dtype)],
-        scratch_shapes=[
-            pltpu.VMEM((bq, D), jnp.float32),
-            pltpu.VMEM((bq, _LANE), jnp.float32),
-        ],
+        dq_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, num_q, num_kv),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, q_spec, lse_spec]
+            + ([lse_spec] if with_glse else []),
+            out_specs=[q_spec],
+            scratch_shapes=[
+                pltpu.VMEM((bq, D), jnp.float32),
+                pltpu.VMEM((bq, _LANE), jnp.float32),
+            ],
+        ),
+        out_shape=[_sds(qt.shape, qt.dtype, qt)],
         interpret=interpret,
-    )(qt, kt, vt, ot, gt, lse)[0]
+    )(offs, qt, kt, vt, ot, gt, lse, *extra)[0]
 
     # dk/dv sweep: kv block outer (revisited output), q block inner
-    q_spec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, x, y: (b, h, y, 0))
-    kv_spec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, x, y: (b, h, x, 0))
-    lse_spec2 = pl.BlockSpec((1, 1, bq, _LANE), lambda b, h, x, y: (b, h, y, 0))
+    def dkv_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref,
+                   *rest):
+        glse_ref, tail = (rest[0], rest[1:]) if with_glse else (None, rest)
+        _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, do_ref,
+                        lse_ref, glse_ref, *tail, scale=scale, causal=causal,
+                        block_q=bq, block_k=bk, num_q=num_q)
+
+    q_spec2 = pl.BlockSpec((1, 1, bq, D), lambda b, h, x, y, offs: (b, h, y, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, D), lambda b, h, x, y, offs: (b, h, x, 0))
+    lse_spec2 = pl.BlockSpec((1, 1, bq, _LANE),
+                             lambda b, h, x, y, offs: (b, h, y, 0))
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal, q_off=q_off,
-            kv_off=kv_off, block_q=bq, block_k=bk, num_q=num_q),
-        grid=(B, H, num_kv, num_q),
-        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2, lse_spec2],
-        out_specs=[kv_spec2, kv_spec2],
+        dkv_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H, num_kv, num_q),
+            in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, q_spec2,
+                      lse_spec2] + ([lse_spec2] if with_glse else []),
+            out_specs=[kv_spec2, kv_spec2],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ],
+        ),
         out_shape=[
-            jax.ShapeDtypeStruct(kt.shape, kt.dtype),
-            jax.ShapeDtypeStruct(vt.shape, vt.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((bk, D), jnp.float32),
-            pltpu.VMEM((bk, D), jnp.float32),
+            _sds(kt.shape, kt.dtype, kt),
+            _sds(vt.shape, vt.dtype, vt),
         ],
         interpret=interpret,
-    )(qt, kt, vt, ot, gt, lse)
+    )(offs, qt, kt, vt, ot, gt, lse, *extra)
 
     back = lambda x: x.transpose(0, 2, 1, 3)
-    return back(dq), back(dk), back(dv)
+    return None, back(dq), back(dk), back(dv)
 
 
 # ---------------------------------------------------------------- public
 
 
 @functools.lru_cache(maxsize=None)
-def _make_flash(causal: bool, q_off: int, kv_off: int, bq: int, bk: int,
-                interpret: bool):
-    def _fwd_transposed(q, k, v):
+def _make_flash(causal: bool, bq: int, bk: int, interpret: bool,
+                with_lse: bool):
+    """Returns flash(offs, q, k, v) -> out, or (out, lse(B, H, Tq)) when
+    `with_lse` — the lse variant also backpropagates lse's cotangent (the
+    ring merge differentiates through it)."""
+
+    def _fwd_transposed(offs, q, k, v):
         qt = q.transpose(0, 2, 1, 3)
         kt = k.transpose(0, 2, 1, 3)
         vt = v.transpose(0, 2, 1, 3)
-        out, lse = _flash_fwd(qt, kt, vt, causal=causal, q_off=q_off,
-                              kv_off=kv_off, bq=bq, bk=bk, interpret=interpret)
-        return (qt, kt, vt, out, lse)
+        out, lse = _flash_fwd(offs, qt, kt, vt, causal=causal, bq=bq, bk=bk,
+                              interpret=interpret)
+        return (offs, qt, kt, vt, out, lse)
 
     @jax.custom_vjp
-    def flash(q, k, v):
-        res = _fwd_transposed(q, k, v)
-        return res[3].transpose(0, 2, 1, 3)
+    def flash(offs, q, k, v):
+        res = _fwd_transposed(offs, q, k, v)
+        out = res[4].transpose(0, 2, 1, 3)
+        return (out, res[5][..., 0]) if with_lse else out
 
-    def fwd(q, k, v):
-        res = _fwd_transposed(q, k, v)
-        return res[3].transpose(0, 2, 1, 3), res
+    def fwd(offs, q, k, v):
+        res = _fwd_transposed(offs, q, k, v)
+        out = res[4].transpose(0, 2, 1, 3)
+        return ((out, res[5][..., 0]) if with_lse else out), res
 
-    def bwd(res, g):
-        return _flash_bwd(res, g, causal=causal, q_off=q_off, kv_off=kv_off,
-                          bq=bq, bk=bk, interpret=interpret)
+    def bwd(res, ct):
+        g, g_lse = ct if with_lse else (ct, None)
+        return _flash_bwd(res, g, g_lse, causal=causal, bq=bq, bk=bk,
+                          interpret=interpret)
 
     flash.defvjp(fwd, bwd)
     return flash
 
 
+def flash_attention_lse(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    causal: bool = True,
+    q_offset=0, kv_offset=0,
+    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+) -> Tuple[jax.Array, jax.Array]:
+    """Flash attention over (B, T, H, D) q/k/v returning (out, lse) with
+    lse (B, H, Tq) float32. Offsets may be Python ints OR traced int32
+    scalars (they ride scalar prefetch). Raises ValueError when the shapes
+    can't be blocked — use `can_flash` first."""
+    flash, offs = _plan_call(q, k, causal, q_offset, kv_offset,
+                             block_q, block_k, interpret, with_lse=True)
+    return flash(offs, q, k, v)
+
+
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     causal: bool = True,
-    q_offset: int = 0, kv_offset: int = 0,
+    q_offset=0, kv_offset=0,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ) -> jax.Array:
-    """Flash attention over (B, T, H, D) q/k/v; same contract as
-    `ops.attention.full_attention`. Offsets must be static ints. Raises
-    ValueError when the shapes can't be blocked — use `can_flash` first."""
+    """Same contract as `ops.attention.full_attention` (output only; the
+    cheaper backward — no lse cotangent input)."""
+    flash, offs = _plan_call(q, k, causal, q_offset, kv_offset,
+                             block_q, block_k, interpret, with_lse=False)
+    return flash(offs, q, k, v)
+
+
+def _plan_call(q, k, causal, q_offset, kv_offset, block_q, block_k,
+               interpret, with_lse):
     blocks = _plan_blocks(q.shape, k.shape, block_q, block_k)
     if blocks is None:
         raise ValueError(
             f"flash_attention cannot block Tq={q.shape[1]}, Tk={k.shape[1]} "
             f"(need a power-of-two divisor >= 8)")
     bq, bk = blocks
-    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
-        raise ValueError("flash_attention offsets must be static Python ints")
-    return _make_flash(bool(causal), q_offset, kv_offset, bq, bk,
-                       bool(interpret))(q, k, v)
+    offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(kv_offset, jnp.int32)])
+    return _make_flash(bool(causal), bq, bk, bool(interpret),
+                       bool(with_lse)), offs
 
 
 def _plan_blocks(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
@@ -376,14 +466,14 @@ def _plan_blocks(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
 
 def can_flash(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
               q_offset=0, kv_offset=0) -> bool:
-    """True when flash_attention supports these shapes/offsets AND the
-    backend is TPU (the Mosaic kernel has no CPU/GPU compile path; interpret
-    mode is for tests only). EDL_FLASH=0 force-disables, =1 force-enables
-    (e.g. under force_tpu_interpret_mode in tests)."""
+    """True when flash_attention supports these shapes AND the backend is
+    TPU (the Mosaic kernel has no CPU/GPU compile path; interpret mode is
+    for tests only). EDL_FLASH=0 force-disables, =1 force-enables (e.g.
+    under force_tpu_interpret_mode in tests). Offsets may be traced — they
+    are accepted for API symmetry and ignored."""
+    del q_offset, kv_offset
     flag = os.environ.get("EDL_FLASH", "")
     if flag == "0":
-        return False
-    if not (isinstance(q_offset, int) and isinstance(kv_offset, int)):
         return False
     if _plan_blocks(q_shape, k_shape, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K) is None:
         return False

@@ -133,7 +133,7 @@ def test_can_flash_gating(monkeypatch):
     assert can_flash(shp, shp) == (jax.default_backend() == "tpu")
     monkeypatch.setenv("EDL_FLASH", "1")
     assert can_flash(shp, shp)
-    assert not can_flash(shp, shp, q_offset=jnp.int32(0))  # traced offset
+    assert can_flash(shp, shp, q_offset=jnp.int32(0))      # traced offsets OK
     assert not can_flash((B, 100, H, D), shp)              # unblockable T
     monkeypatch.setenv("EDL_FLASH", "0")
     assert not can_flash(shp, shp)
@@ -153,6 +153,102 @@ def test_full_attention_dispatches_to_flash(monkeypatch):
         got = full_attention(q, k, v, causal=True)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_flash_traced_offsets_match_static():
+    """Offsets ride scalar prefetch, so traced values must behave exactly
+    like Python ints — the contract ring attention depends on."""
+    q, k, v = _qkv(t_q=32, t_k=32, seed=6)
+
+    @jax.jit
+    def with_traced(q, k, v, q_off, kv_off):
+        return flash_attention(q, k, v, causal=True, q_offset=q_off,
+                               kv_offset=kv_off, block_q=16, block_k=16,
+                               interpret=True)
+
+    for q_off, kv_off in [(32, 0), (16, 0), (64, 32)]:
+        static = flash_attention(q, k, v, causal=True, q_offset=q_off,
+                                 kv_offset=kv_off, block_q=16, block_k=16,
+                                 interpret=True)
+        traced = with_traced(q, k, v, jnp.int32(q_off), jnp.int32(kv_off))
+        np.testing.assert_allclose(np.asarray(traced), np.asarray(static),
+                                   atol=1e-6, rtol=1e-6)
+
+
+def test_flash_lse_value_and_gradient():
+    """flash_attention_lse: lse equals logsumexp of the masked scores, and
+    gradients THROUGH lse are exact (the ring merge differentiates the
+    combination weights, which folds g_lse into the kernel's delta)."""
+    from elasticdl_tpu.ops.pallas_attention import flash_attention_lse
+
+    q, k, v = _qkv(t_q=32, t_k=32, seed=7)
+
+    def ref_lse(q, k):
+        scale = q.shape[-1] ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        mask = jnp.arange(k.shape[1])[None, :] <= jnp.arange(q.shape[1])[:, None]
+        s = jnp.where(mask[None, None], s, -1e30)
+        return jax.scipy.special.logsumexp(s, axis=-1)     # (B, H, Tq)
+
+    out, lse = flash_attention_lse(q, k, v, causal=True, block_q=16,
+                                   block_k=16, interpret=True)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_lse(q, k)),
+                               atol=2e-5, rtol=2e-5)
+
+    # a loss that uses BOTH outputs — compare against pure-XLA autodiff
+    def loss_flash(q, k, v):
+        out, lse = flash_attention_lse(q, k, v, causal=True, block_q=16,
+                                       block_k=16, interpret=True)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(lse))
+
+    def loss_ref(q, k, v):
+        return (jnp.sum(full_attention(q, k, v, causal=True) ** 2)
+                + jnp.sum(jnp.sin(ref_lse(q, k))))
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-5, rtol=5e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_flash_matches_full_attention(monkeypatch, causal):
+    """Ring attention with the flash block kernel (EDL_FLASH=1 +
+    force_tpu_interpret_mode on the data x seq CPU mesh) must match
+    unsharded full attention, forward and backward — the lse merge and the
+    traced-offset masking carry the whole correctness burden here."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from elasticdl_tpu.ops.attention import sequence_parallel_attention
+    from elasticdl_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 2, "seq": 4})
+    Bq, Tq, Hq, Dq = 2, 64, 2, 8          # local seq block = 16 rows
+    r = np.random.RandomState(8)
+    mk = lambda: jnp.asarray(r.randn(Bq, Tq, Hq, Dq), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    ref = full_attention(q, k, v, causal=causal)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(full_attention(q, k, v, causal=causal) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("EDL_FLASH", "1")
+    with pltpu.force_tpu_interpret_mode(), jax.set_mesh(mesh):
+        got = jax.jit(
+            lambda q, k, v: sequence_parallel_attention(
+                q, k, v, causal=causal, mode="ring"))(q, k, v)
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(sequence_parallel_attention(
+                q, k, v, causal=causal, mode="ring") ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    for a, b in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
 
 
 def test_flash_rejects_unblockable():
