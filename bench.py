@@ -201,6 +201,37 @@ def bench_embedding_modes(mesh, np):
     opt = optax.sgd(0.1)
     results = {}
     with jax.set_mesh(mesh):
+        # quantify the round-3 scatter fix: the same auto-mode update with
+        # the plain XLA scatter-add backward vs the default sorted
+        # segment-sum custom VJP (ops/embedding.gather_rows)
+        for scatter in ("sorted", "xla"):
+            os.environ["EDL_EMB_SCATTER"] = scatter
+            try:
+                opt_state = opt.init(table)
+
+                @jax.jit
+                def sstep(t, s, i):
+                    g = jax.grad(
+                        lambda tt: jnp.sum(
+                            emb_ops.embedding_lookup(tt, i, mode="auto") ** 2
+                        )
+                    )(t)
+                    up, s = opt.update(g, s)
+                    return optax.apply_updates(t, up), s
+
+                sbox = [sstep(table, opt_state, ids)]
+                float(jnp.sum(sbox[0][0][:1]))
+
+                def supd(i):
+                    sbox[0] = sstep(sbox[0][0], sbox[0][1], ids)
+
+                n, dt = timed_loop(
+                    supd, lambda: float(jnp.sum(sbox[0][0][:1])), 5)
+                results[f"update_rows_per_sec_{scatter}_scatter"] = round(
+                    n * B * L / dt, 1)
+            finally:
+                os.environ.pop("EDL_EMB_SCATTER", None)
+
         for mode in ("manual", "auto"):
             # summed output: a scalar readback that depends on every lookup
             look = jax.jit(

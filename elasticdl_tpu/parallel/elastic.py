@@ -112,17 +112,33 @@ def make_global_batch(mesh, batch: Any, partition=None) -> Any:
             x.shape, sharding, lambda idx: x[idx]
         )
 
-    default = mesh_lib.batch_sharding(mesh)
-    if not partition:
-        return jax.tree_util.tree_map(lambda x: put(x, default), batch)
     out = {}
     for key, value in batch.items():
-        spec = partition.get(key)
-        sh = (
-            NamedSharding(mesh, mesh_lib.prune_spec(mesh, spec))
-            if spec is not None else default
-        )
+        sh = NamedSharding(mesh, mesh_lib.batch_key_spec(mesh, key, partition))
         out[key] = jax.tree_util.tree_map(lambda x, s=sh: put(x, s), value)
+    return out
+
+
+def make_global_batch_stack(mesh, batches, partition=None) -> Any:
+    """K identical-on-every-process host batches -> one global pytree with
+    a leading step axis (leaves (K, B, ...), sharded P(None, <batch spec>))
+    for `Trainer.train_many` — the multi-process twin of
+    `mesh.shard_batch_stack`, assembled per-device like make_global_batch."""
+    if jax.process_count() == 1:
+        return mesh_lib.shard_batch_stack(mesh, batches, partition)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def put(leaves, spec):
+        x = np.stack([np.asarray(l) for l in leaves])
+        sh = NamedSharding(mesh, P(None, *spec))
+        return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
+
+    out = {}
+    for key in batches[0]:
+        spec = mesh_lib.batch_key_spec(mesh, key, partition)
+        out[key] = jax.tree_util.tree_map(
+            lambda *ls, s=spec: put(ls, s), *(b[key] for b in batches))
     return out
 
 

@@ -151,6 +151,16 @@ def table_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P(mesh.axis_names, None))
 
 
+def batch_key_spec(mesh: Mesh, key: str, partition) -> P:
+    """THE per-key batch sharding rule, shared by every batch-placement
+    path (shard_batch, shard_batch_stack, elastic.make_global_batch and its
+    stack twin): a `partition` override for the key (pruned to the mesh's
+    axes) or the default P(data_axis)."""
+    if partition and partition.get(key) is not None:
+        return prune_spec(mesh, partition[key])
+    return P(data_axis(mesh))
+
+
 def shard_batch(mesh: Mesh, batch, partition=None):
     """Device-put a host batch (pytree of np arrays) with batch sharding.
 
@@ -159,8 +169,6 @@ def shard_batch(mesh: Mesh, batch, partition=None):
     P('data','seq') — see the transformer zoo's batch_partition). Leaves
     already resident with the right sharding pass through untouched (the
     DevicePrefetcher hands the trainer pre-sharded batches)."""
-    default = batch_sharding(mesh)
-
     def put_with(sh):
         def put(x):
             if isinstance(x, jax.Array) and x.sharding == sh:
@@ -169,14 +177,10 @@ def shard_batch(mesh: Mesh, batch, partition=None):
         return put
 
     if not partition:
-        return jax.tree_util.tree_map(put_with(default), batch)
+        return jax.tree_util.tree_map(put_with(batch_sharding(mesh)), batch)
     out = {}
     for key, value in batch.items():
-        spec = partition.get(key)
-        sh = (
-            NamedSharding(mesh, prune_spec(mesh, spec))
-            if spec is not None else default
-        )
+        sh = NamedSharding(mesh, batch_key_spec(mesh, key, partition))
         out[key] = jax.tree_util.tree_map(put_with(sh), value)
     return out
 
@@ -185,16 +189,10 @@ def shard_batch_stack(mesh: Mesh, batches, partition=None):
     """Stack K host batches into one pytree with a leading step axis —
     leaves (K, B, ...), device_put as P(None, <batch spec>) — for
     `Trainer.train_many` (one dispatch runs all K steps via lax.scan)."""
-    default_spec = P(data_axis(mesh))
-
-    def spec_for(key):
-        if partition and partition.get(key) is not None:
-            return prune_spec(mesh, partition[key])
-        return default_spec
-
     out = {}
     for key in batches[0]:
-        sh = NamedSharding(mesh, P(None, *spec_for(key)))
+        spec = batch_key_spec(mesh, key, partition)
+        sh = NamedSharding(mesh, P(None, *spec))
 
         def put(*leaves, _sh=sh):
             return jax.device_put(

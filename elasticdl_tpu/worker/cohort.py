@@ -28,7 +28,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -40,6 +40,7 @@ from elasticdl_tpu.parallel.elastic import (
     CohortContext,
     context_from_env,
     make_global_batch,
+    make_global_batch_stack,
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import MasterStub, make_channel
@@ -71,6 +72,11 @@ class CohortWorker:
         self._shutdown = threading.Event()
         self._job_done = False
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
+        # Plain-int mirror of state.model_version for the heartbeat thread:
+        # int(state.step) blocks on the in-flight donated computation (see
+        # worker.py's identically-named field), which would stall heartbeats
+        # for the length of a dispatch.
+        self._model_version = 0
         self.worker_id = -1
 
     # ------------------------------------------------------------------ #
@@ -150,6 +156,7 @@ class CohortWorker:
                     "cohort resumed from checkpoint at step %d",
                     self._last_ckpt_step,
                 )
+        self._model_version = self._state.model_version
         if self.ctx.num_processes != self.cfg.num_processes:
             # Dynamic resizing does NOT change the effective global batch in
             # cohort mode: every generation consumes the same
@@ -191,10 +198,10 @@ class CohortWorker:
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
-                version = self._state.model_version if self._state is not None else 0
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
-                        worker_id=self.worker_id, model_version=version
+                        worker_id=self.worker_id,
+                        model_version=self._model_version,
                     ),
                     timeout=10,
                 )
@@ -297,26 +304,74 @@ class CohortWorker:
         loss_sum, loss_count = 0.0, 0
         step_time_sum = 0.0
         metric_states = None
+        k = max(1, self.cfg.steps_per_dispatch)
+        buf: List[Any] = []   # host batches awaiting one grouped dispatch
+
+        def flush_training_group():
+            """Run the buffered host batches: one train_many dispatch for a
+            full k-group (every process dispatches the identical program —
+            collective), single steps for a trailing partial (so only two
+            compiled programs exist, not one per remainder length)."""
+            nonlocal loss_sum, loss_count, step_time_sum
+            if not buf:
+                return
+            import jax.numpy as jnp
+
+            # batch assembly stays OUTSIDE the timed region — step_time_ms
+            # has always meant dispatch + device compute, and host-side
+            # stack/H2D would otherwise read as a phantom slowdown
+            if len(buf) == k and k > 1:
+                stacked = make_global_batch_stack(
+                    self._mesh, buf, self._spec.batch_partition
+                )
+                t0 = time.perf_counter()
+                self._state, m = self._trainer.train_many(self._state, stacked)
+                if self.ctx.is_leader:
+                    loss_sum += float(jnp.sum(m["loss"]))
+            else:
+                globals_ = [
+                    make_global_batch(self._mesh, b, self._spec.batch_partition)
+                    for b in buf
+                ]
+                t0 = time.perf_counter()
+                for gb in globals_:
+                    self._state, logs = self._trainer.train_step(
+                        self._state, gb)
+                    if self.ctx.is_leader:
+                        loss_sum += float(logs["loss"])
+            if self.ctx.is_leader:
+                # the leader's float() forced the collective dispatch(es):
+                # wall time covers dispatch + device compute cohort-wide
+                step_time_sum += time.perf_counter() - t0
+                loss_count += len(buf)
+            self._model_version += len(buf)
+            buf.clear()
+
+        from elasticdl_tpu.data.prefetch import _wire_cast
+
         for host_batch in svc.batches(shard, start, end):
+            # same bf16 wire compression the single-process worker applies
+            # (mask exempted by _wire_cast; cohort reports count by span,
+            # not mask, so accounting is unaffected either way)
+            host_batch = _wire_cast(host_batch, self.cfg.wire_dtype)
+            if task_type == pb.TRAINING:
+                if self._state is None:
+                    self._ensure_state(make_global_batch(
+                        self._mesh, host_batch, self._spec.batch_partition))
+                buf.append(host_batch)
+                if len(buf) == k:
+                    flush_training_group()
+                continue
             batch = make_global_batch(
                 self._mesh, host_batch, self._spec.batch_partition
             )
             self._ensure_state(batch)
-            if task_type == pb.TRAINING:
-                t0 = time.perf_counter()
-                self._state, logs = self._trainer.train_step(self._state, batch)
-                if self.ctx.is_leader:
-                    # float() forces the collective step: wall time covers
-                    # dispatch + device compute across the whole cohort
-                    loss_sum += float(logs["loss"])
-                    step_time_sum += time.perf_counter() - t0
-                    loss_count += 1
-            else:
-                if metric_states is None:
-                    metric_states = self._trainer.new_metric_states()
-                metric_states = self._trainer.eval_step(
-                    self._state, batch, metric_states
-                )
+            if metric_states is None:
+                metric_states = self._trainer.new_metric_states()
+            metric_states = self._trainer.eval_step(
+                self._state, batch, metric_states
+            )
+        flush_training_group()   # trailing partial group (single steps)
 
         if flags & FLAG_CHECKPOINT:
             mngr = self._checkpoint_manager()

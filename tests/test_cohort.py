@@ -100,6 +100,20 @@ def test_cohort_job_end_to_end(tmp_path):
     assert os.path.exists(tmp_path / "export" / "params.msgpack")
 
 
+def test_cohort_grouped_dispatch_end_to_end(tmp_path):
+    """--steps_per_dispatch=2 in COHORT mode: both processes run the same
+    train_many scan over the stacked global batch (one collective dispatch
+    per 2 minibatches); a 512-record task at minibatch 64 = 8 batches = 4
+    full groups; task accounting and loss reporting unchanged."""
+    cfg = job_config(tmp_path, steps_per_dispatch=2, wire_dtype="bfloat16")
+    counts = run_job(cfg, tmp_path)
+    assert counts["finished_training"] == 4
+    assert counts["failed_permanently"] == 0
+    log = all_logs(tmp_path)
+    assert "distributed world v0 up: process 0/2" in log
+    assert "distributed world v0 up: process 1/2" in log
+
+
 def test_cohort_member_kill_relaunches_and_resumes(tmp_path):
     cfg = job_config(
         tmp_path,
