@@ -100,15 +100,18 @@ class CohortWorker:
             paths = {
                 pb.TRAINING: self.cfg.training_data,
                 pb.EVALUATION: self.cfg.validation_data or self.cfg.training_data,
+                pb.PREDICTION: self.cfg.prediction_data,
             }
+            mode = {
+                pb.TRAINING: "training",
+                pb.EVALUATION: "evaluation",
+                pb.PREDICTION: "prediction",
+            }[task_type]
             reader = create_data_reader(
                 paths[task_type], self.cfg.data_reader,
                 **self.cfg.data_reader_params,
             )
-            parse = self._spec.dataset_fn(
-                "training" if task_type == pb.TRAINING else "evaluation",
-                reader.metadata,
-            )
+            parse = self._spec.dataset_fn(mode, reader.metadata)
             from elasticdl_tpu.parallel.mesh import data_axis
 
             multiple = dict(
@@ -262,6 +265,29 @@ class CohortWorker:
     # ------------------------------------------------------------------ #
     # collective task execution (every process)
 
+    def _process_predictions(self, outputs, host_batch) -> None:
+        """Collective: allgather the sharded prediction outputs so the
+        leader holds the full batch, then run the user's processor there
+        (reference parity: BasePredictionOutputsProcessor.process(outputs,
+        worker_id) per worker — the cohort IS one logical worker, so its
+        predictions flow through one processor on the leader)."""
+        processor = self._spec.prediction_outputs_processor
+        if processor is None:
+            return
+        import jax
+
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            # collective — every process participates, leader consumes
+            full = multihost_utils.process_allgather(outputs)
+        else:
+            full = jax.device_get(outputs)
+        if not self.ctx.is_leader:
+            return
+        valid = np.asarray(host_batch["mask"]) > 0
+        processor.process(np.asarray(full)[valid], self.worker_id)
+
     def _run_task(self, ctrl: List[int]) -> None:
         import jax
 
@@ -366,11 +392,15 @@ class CohortWorker:
                 self._mesh, host_batch, self._spec.batch_partition
             )
             self._ensure_state(batch)
-            if metric_states is None:
-                metric_states = self._trainer.new_metric_states()
-            metric_states = self._trainer.eval_step(
-                self._state, batch, metric_states
-            )
+            if task_type == pb.PREDICTION:
+                outputs = self._trainer.predict_step(self._state, batch)
+                self._process_predictions(outputs, host_batch)
+            else:
+                if metric_states is None:
+                    metric_states = self._trainer.new_metric_states()
+                metric_states = self._trainer.eval_step(
+                    self._state, batch, metric_states
+                )
         flush_training_group()   # trailing partial group (single steps)
 
         if flags & FLAG_CHECKPOINT:
@@ -463,6 +493,18 @@ class CohortWorker:
                     if op == OP_DONE:
                         self._export_final_model()
                     break
+            processor = (
+                self._spec.prediction_outputs_processor if self._spec else None
+            )
+            if processor is not None:
+                # only the leader's processor ever received outputs, but
+                # close() on every process is harmless and guarantees the
+                # leader's buffered tail is flushed (base-class contract)
+                try:
+                    processor.close()
+                except Exception:
+                    logger.exception(
+                        "prediction outputs processor close failed")
             self._shutdown.set()
             if self.ctx.is_leader:
                 try:

@@ -52,10 +52,15 @@ class NpyPredictionOutputsProcessor(BasePredictionOutputsProcessor):
 
     def __init__(self, out_dir: str) -> None:
         self.out_dir = os.path.abspath(out_dir)
-        os.makedirs(self.out_dir, exist_ok=True)
         self._n = 0
+        self._made_dir = False  # deferred: ModelSpec constructs processors
+        # for every job type, and a training job must not mkdir as a side
+        # effect (or crash in a read-only cwd)
 
     def process(self, predictions: Any, worker_id: int) -> None:
+        if not self._made_dir:
+            os.makedirs(self.out_dir, exist_ok=True)
+            self._made_dir = True
         path = os.path.join(
             self.out_dir,
             f"predictions_worker{worker_id}_p{os.getpid()}_{self._n:06d}.npy",

@@ -117,5 +117,20 @@ def dataset_fn(mode, metadata):
     return parsing.criteo_batch_parser(num_dense=NUM_DENSE, num_cat=NUM_CAT)
 
 
+def prediction_outputs_processor():
+    """Prediction-job hook (reference zoo modules exposed the same factory):
+    streams each minibatch's outputs to EDL_PREDICT_OUT (default
+    ./predictions) as per-worker .npy files."""
+    import os
+
+    from elasticdl_tpu.worker.prediction_outputs_processor import (
+        NpyPredictionOutputsProcessor,
+    )
+
+    return NpyPredictionOutputsProcessor(
+        os.environ.get("EDL_PREDICT_OUT", "predictions")
+    )
+
+
 def eval_metrics_fn():
     return {"auc": metrics_lib.AUC(), "accuracy": metrics_lib.Accuracy()}

@@ -46,12 +46,12 @@ def job_config(tmp_path, **overrides):
 
 
 def run_job(cfg, tmp_path, mid_job=None, timeout_s=420, return_all=False,
-            resize_ckpt_timeout_s=30.0, observer=None):
+            resize_ckpt_timeout_s=30.0, observer=None, extra_env=None):
     master = Master(cfg)
     manager = ProcessManager(
         cfg,
         membership=master.membership,
-        extra_env=HERMETIC_ENV,
+        extra_env={**HERMETIC_ENV, **(extra_env or {})},
         log_dir=str(tmp_path / "logs"),
         job_finished_fn=master.dispatcher.finished,
         # production wiring (client/local.py): planned resizes quiesce via
@@ -112,6 +112,34 @@ def test_cohort_grouped_dispatch_end_to_end(tmp_path):
     log = all_logs(tmp_path)
     assert "distributed world v0 up: process 0/2" in log
     assert "distributed world v0 up: process 1/2" in log
+
+
+@pytest.mark.parametrize("num_processes", [1, 2])
+def test_cohort_prediction_job(tmp_path, num_processes):
+    """Prediction jobs end-to-end in BOTH worker flavors. Cohort mode was a
+    round-3 gap (_data_service only knew train/eval, so prediction-only
+    with num_processes>1 crashed): every process runs predict_step on the
+    global batch, outputs allgather to the leader, and the zoo's
+    prediction_outputs_processor writes them — exactly once across the
+    job. num_processes=1 drives the plain worker's prediction path through
+    the same harness."""
+    import numpy as np
+
+    out_dir = tmp_path / "preds"
+    cfg = job_config(
+        tmp_path,
+        job_type="prediction_only",
+        prediction_data="synthetic://criteo?n=512&shards=2",
+        records_per_task=256,
+        num_processes=num_processes,
+    )
+    counts = run_job(
+        cfg, tmp_path, extra_env={"EDL_PREDICT_OUT": str(out_dir)})
+    assert counts["failed_permanently"] == 0
+    files = sorted(glob.glob(str(out_dir / "*.npy")))
+    assert files, all_logs(tmp_path)[-2000:]
+    total = sum(np.load(f).shape[0] for f in files)
+    assert total == 512  # every record predicted exactly once, none padded
 
 
 def test_cohort_member_kill_relaunches_and_resumes(tmp_path):
