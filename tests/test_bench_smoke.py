@@ -54,6 +54,22 @@ def test_run_steps_counts_scan_steps(bench, mesh8, monkeypatch):
     assert dt > 0
 
 
+def test_time_to_auc_leg_smoke(bench, mesh8, monkeypatch):
+    """The north-star-miniature leg: real reader -> parser -> train_many ->
+    eval loop must actually LEARN the synthetic stream (tiny sizes; the
+    real leg runs on the chip). A destroyed label signal (parser or
+    synthetic-stream regression) fails here instead of burning the full
+    leg budget and passing vacuously."""
+    monkeypatch.setattr(bench, "BATCH", 64)
+    monkeypatch.setattr(bench, "FIELD_VOCAB", 100)
+    # keep the budget small so a non-learning regression fails fast
+    monkeypatch.setattr(bench, "LEG_TIMEOUT_S", 90)
+    res = bench.bench_time_to_auc(mesh8, np, target=0.65)
+    assert res["reached"], res
+    assert res["auc"] > res["initial_auc"], res
+    assert res["seconds_to_auc"] >= 0.0
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)

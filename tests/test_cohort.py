@@ -114,6 +114,21 @@ def test_cohort_grouped_dispatch_end_to_end(tmp_path):
     assert "distributed world v0 up: process 1/2" in log
 
 
+def test_cohort_evaluation_only_job(tmp_path):
+    """evaluation_only in cohort mode: eval tasks stream through every
+    process's eval_step, metric states merge master-side, AUC comes back."""
+    cfg = job_config(
+        tmp_path,
+        job_type="evaluation_only",
+        validation_data="synthetic://criteo?n=512&shards=2",
+        records_per_task=256,
+    )
+    master, manager, counts = run_job(cfg, tmp_path, return_all=True)
+    assert counts["failed_permanently"] == 0
+    results = master.evaluation.latest_results()
+    assert "auc" in results and "loss" in results, results
+
+
 @pytest.mark.parametrize("num_processes", [1, 2])
 def test_cohort_prediction_job(tmp_path, num_processes):
     """Prediction jobs end-to-end in BOTH worker flavors. Cohort mode was a
