@@ -333,10 +333,13 @@ def bench_time_to_auc(mesh, np, target=0.75):
     steps = group
     initial_auc = auc = eval_auc(state)
     t0 = time.perf_counter()
-    # budget against the LEG subprocess's total timeout (measured from
-    # process start), not from t0 — compile + first eval already spent an
-    # unknown slice of it, and overrunning gets the whole result hard-killed
-    deadline = _PROC_T0 + 0.85 * LEG_TIMEOUT_S
+    # budget against the timeout this process will actually be KILLED at
+    # (the parent passes its possibly-BUDGET_S-clipped value via env),
+    # measured from process start — compile + first eval already spent an
+    # unknown slice of it, and overrunning loses the whole result
+    kill_s = float(os.environ.get(
+        "EDL_BENCH_EFFECTIVE_TIMEOUT_S", LEG_TIMEOUT_S))
+    deadline = _PROC_T0 + 0.85 * kill_s
     while auc < target and time.perf_counter() < deadline:
         state, m = trainer.train_many(
             state, shard_batch_stack(mesh, take_group()))
@@ -523,6 +526,11 @@ def main():
                     [sys.executable, os.path.abspath(__file__), "--leg", leg],
                     capture_output=True,
                     timeout=timeout_s,
+                    # the child budgets open-ended loops (time_to_auc)
+                    # against the timeout it will actually be killed at —
+                    # which may be clipped below LEG_TIMEOUT_S by BUDGET_S
+                    env={**os.environ,
+                         "EDL_BENCH_EFFECTIVE_TIMEOUT_S": str(int(timeout_s))},
                 )
                 line = proc.stdout.decode().strip().splitlines()[-1]
                 return json.loads(line)

@@ -60,13 +60,19 @@ def test_time_to_auc_leg_smoke(bench, mesh8, monkeypatch):
     real leg runs on the chip). A destroyed label signal (parser or
     synthetic-stream regression) fails here instead of burning the full
     leg budget and passing vacuously."""
+    import time
+
     monkeypatch.setattr(bench, "BATCH", 64)
     monkeypatch.setattr(bench, "FIELD_VOCAB", 100)
-    # keep the budget small so a non-learning regression fails fast
-    monkeypatch.setattr(bench, "LEG_TIMEOUT_S", 90)
+    # bounded budget so a non-learning regression fails in minutes, anchored
+    # NOW so compile time already spent by other tests can't eat the window
+    monkeypatch.setattr(bench, "LEG_TIMEOUT_S", 300)
+    monkeypatch.setattr(bench, "_PROC_T0", time.perf_counter())
     res = bench.bench_time_to_auc(mesh8, np, target=0.65)
     assert res["reached"], res
-    assert res["auc"] > res["initial_auc"], res
+    # >= : the FIRST compiled group may already clear the target, in which
+    # case the loop never runs and auc == initial_auc legitimately
+    assert res["auc"] >= res["initial_auc"], res
     assert res["seconds_to_auc"] >= 0.0
 
 
