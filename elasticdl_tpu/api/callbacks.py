@@ -51,6 +51,15 @@ class JobContext:
             return {}
         return self._evaluation.latest_results()
 
+    def set_learning_rate(self, lr: float) -> None:
+        """Push a job-wide LR override to every worker via the heartbeat
+        stream; workers apply it at their next task boundary (needs the zoo
+        optimizer built through lr_modulation.modulated). Overrides any
+        worker-local elastic LR scaling."""
+        logger.info("callback set learning rate to %g", lr)
+        if self._servicer is not None:
+            self._servicer.set_learning_rate(lr)
+
 
 class Callback:
     """Optional base class; the master calls set_context before any hook."""
@@ -133,3 +142,68 @@ class EarlyStopping(Callback):
                 self.ctx.stop_training(reason)
             else:
                 logger.warning("EarlyStopping fired without context: %s", reason)
+
+
+class ReduceLROnPlateau(Callback):
+    """Halve (by `factor`) the job-wide learning rate when a monitored eval
+    metric plateaus — the Keras callback the reference's zoo modules could
+    return, rebuilt on the master's eval stream + heartbeat LR push.
+
+    Requires the zoo optimizer to be built via `lr_modulation.modulated`
+    (injected hyperparams), like elastic LR scaling does; `initial_lr` seeds
+    the schedule since the master never sees the optimizer state.
+    """
+
+    def __init__(
+        self,
+        initial_lr: float,
+        monitor: str = "loss",
+        mode: str = "auto",
+        factor: float = 0.5,
+        patience: int = 2,
+        min_delta: float = 0.0,
+        min_lr: float = 0.0,
+    ):
+        if not (0.0 < factor < 1.0):
+            raise ValueError(f"factor must be in (0, 1), got {factor}")
+        if mode not in ("auto", "min", "max"):
+            raise ValueError(f"mode must be auto|min|max, got {mode!r}")
+        if mode == "auto":
+            mode = "min" if ("loss" in monitor or "error" in monitor) else "max"
+        self.monitor = monitor
+        self.mode = mode
+        self.factor = factor
+        self.patience = patience
+        self.min_delta = abs(min_delta)
+        self.min_lr = min_lr
+        self.lr = float(initial_lr)
+        self.best: float = math.inf if mode == "min" else -math.inf
+        self.wait = 0
+
+    def _improved(self, value: float) -> bool:
+        if self.mode == "min":
+            return value < self.best - self.min_delta
+        return value > self.best + self.min_delta
+
+    def on_eval_result(self, model_version: int, results: Dict[str, float]) -> None:
+        value = results.get(self.monitor)
+        if value is None:
+            logger.warning(
+                "ReduceLROnPlateau monitors %r but eval results have %s",
+                self.monitor, sorted(results),
+            )
+            return
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+            return
+        self.wait += 1
+        if self.wait >= self.patience and self.lr > self.min_lr:
+            self.lr = max(self.min_lr, self.lr * self.factor)
+            self.wait = 0
+            logger.info(
+                "ReduceLROnPlateau: %s plateaued at %.6g (best %.6g); "
+                "lr -> %g", self.monitor, value, self.best, self.lr,
+            )
+            if self.ctx is not None:
+                self.ctx.set_learning_rate(self.lr)

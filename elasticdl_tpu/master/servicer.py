@@ -41,6 +41,7 @@ class MasterServicer:
         self._loss_sum = 0.0
         self._loss_count = 0
         self._checkpoint_requested = set()  # worker ids that should checkpoint
+        self._lr_override = 0.0             # 0 = no master-pushed LR
         self._shutdown = False
 
     # ------------------------------------------------------------------ #
@@ -116,7 +117,13 @@ class MasterServicer:
             should_checkpoint=should_ckpt,
             shutdown=self._shutdown or not known,
             job_done=self._dispatcher.finished(),
+            learning_rate=self._lr_override,
         )
+
+    def set_learning_rate(self, lr: float) -> None:
+        """Master-side LR override, delivered to every worker on its next
+        heartbeat (job callbacks — ReduceLROnPlateau — call this)."""
+        self._lr_override = float(lr)
 
     def GetJobStatus(self, request, context):
         counts = self._dispatcher.counts()

@@ -75,15 +75,25 @@ class CohortContext:
 
     def broadcast_ints(self, values: Sequence[int]) -> np.ndarray:
         """Leader -> all: small int64 control vector (the cohort's task/
-        checkpoint protocol rides this)."""
+        checkpoint/LR protocol rides this).
+
+        Shipped as int32 HALVES: with jax_enable_x64 off (the default,
+        everywhere in this repo), an int64 array entering
+        broadcast_one_to_all is canonicalized to int32 — silently wrapping
+        anything past 2^31 (float64 LR bit-patterns; record spans of a
+        Criteo-1TB-sized file). Splitting each value into two int32s keeps
+        the full 64 bits across the wire."""
         from jax.experimental import multihost_utils
 
-        arr = np.asarray(values, np.int64)
-        return np.asarray(
+        arr = np.ascontiguousarray(np.asarray(values, np.int64))
+        halves = arr.view(np.int32)            # (2n,), little-endian pairs
+        out = np.asarray(
             multihost_utils.broadcast_one_to_all(
-                arr, is_source=self.is_leader
-            )
+                halves, is_source=self.is_leader
+            ),
+            dtype=np.int32,
         )
+        return np.ascontiguousarray(out).view(np.int64)
 
     def barrier(self, name: str) -> None:
         from jax.experimental import multihost_utils

@@ -103,6 +103,23 @@ def get_learning_rate(opt_state: Any) -> Optional[float]:
     return get_hyperparam(opt_state, "learning_rate")
 
 
+def apply_learning_rate(trainer, state, lr: float):
+    """Set `lr` on `state` via the trainer, tolerating a zoo optimizer that
+    was not built through `modulated(...)` — a pushed/rescaled LR reaching
+    such a job is a config mismatch that must log, not kill the worker.
+    Returns the (possibly unchanged) state. Shared by worker and cohort."""
+    import logging
+
+    try:
+        return trainer.set_learning_rate(state, lr)
+    except KeyError:
+        logging.getLogger(__name__).warning(
+            "ignoring LR %.6g: optimizer has no injected learning_rate "
+            "(use lr_modulation.modulated)", lr,
+        )
+        return state
+
+
 def linear_scale(base_lr: float, alive_workers: int, base_workers: int) -> float:
     """Linear-scaling rule for elastic membership changes (the sync-DP analog
     of the reference's staleness modulation): LR tracks the live worker

@@ -49,10 +49,22 @@ from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 logger = default_logger(__name__)
 
-# control vector: [op, task_id, task_type, shard_idx, start, end, flags, eval_job]
+# control vector:
+#   [op, task_id, task_type, shard_idx, start, end, flags, eval_job, lr_bits]
+# lr_bits = float64 bit-pattern of the master-pushed LR override (0 = none);
+# riding the broadcast keeps every process applying the same LR at the same
+# task boundary (SPMD lockstep).
 OP_NOOP, OP_TASK, OP_DONE, OP_ABORT = 0, 1, 2, 3
 FLAG_CHECKPOINT = 1
-CTRL_LEN = 8
+CTRL_LEN = 9
+
+
+def _lr_to_bits(lr: float) -> int:
+    return 0 if not lr else int(np.float64(lr).view(np.int64))
+
+
+def _bits_to_lr(bits: int) -> float:
+    return 0.0 if not bits else float(np.int64(bits).view(np.float64))
 
 
 
@@ -77,6 +89,8 @@ class CohortWorker:
         # worker.py's identically-named field), which would stall heartbeats
         # for the length of a dispatch.
         self._model_version = 0
+        self._pushed_lr = 0.0         # leader: last LR override from heartbeat
+        self._applied_push_lr = 0.0   # all: last override applied to state
         self.worker_id = -1
 
     # ------------------------------------------------------------------ #
@@ -218,6 +232,10 @@ class CohortWorker:
                     # the save itself is collective and happens at the task
                     # boundary on every process
                     self._ckpt_requested = True
+                if resp.learning_rate > 0:
+                    # rides the next control vector (lr_bits) so every
+                    # process applies it at the same task boundary
+                    self._pushed_lr = resp.learning_rate
             except Exception as e:
                 logger.warning("cohort heartbeat failed: %s", e)
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
@@ -232,7 +250,7 @@ class CohortWorker:
             )
         except Exception as e:
             logger.warning("cohort get_task failed: %s", e)
-            return [OP_NOOP, 0, 0, 0, 0, 0, 0, 0]
+            return [OP_NOOP] + [0] * (CTRL_LEN - 1)
         if resp.job_done:
             self._job_done = True
             return [OP_DONE] + [0] * (CTRL_LEN - 1)
@@ -260,6 +278,7 @@ class CohortWorker:
             task.start, task.end,
             FLAG_CHECKPOINT if due else 0,
             task.eval_job_id,
+            _lr_to_bits(self._pushed_lr),
         ]
 
     # ------------------------------------------------------------------ #
@@ -291,7 +310,22 @@ class CohortWorker:
     def _run_task(self, ctrl: List[int]) -> None:
         import jax
 
-        _, task_id, task_type, shard_idx, start, end, flags, eval_job = ctrl
+        _, task_id, task_type, shard_idx, start, end, flags, eval_job, lr_bits = ctrl
+        pushed_lr = _bits_to_lr(lr_bits)
+        if pushed_lr > 0 and pushed_lr != self._applied_push_lr and \
+                self._state is not None:
+            from elasticdl_tpu.training.lr_modulation import (
+                apply_learning_rate,
+            )
+
+            # every process applies the identical override at the identical
+            # task boundary (the ctrl broadcast carries it); a non-modulated
+            # optimizer logs instead of crashing — deterministically on all
+            # processes, so lockstep holds either way
+            self._state = apply_learning_rate(
+                self._trainer, self._state, pushed_lr)
+            self._applied_push_lr = pushed_lr
+            logger.info("applied master-pushed LR %g", pushed_lr)
         if task_type == pb.SAVE_MODEL:
             # The master's final exclusive save task: a collective checkpoint
             # (every process writes its addressable shards), leader reports.

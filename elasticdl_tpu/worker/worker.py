@@ -51,6 +51,7 @@ class Worker:
         self._mid_training_task = False
         self._base_lr = None          # injected LR at init (elastic scaling)
         self._pending_lr = None       # set by heartbeat thread, applied by run loop
+        self._pushed_lr = 0.0         # last master-pushed LR override seen
         self._last_known_workers = 0  # latest alive count (register/heartbeat)
         self._global_step = 0         # train steps run by this worker
         # Plain-int mirror of state.model_version, maintained by the MAIN
@@ -177,12 +178,14 @@ class Worker:
                 logger.info(
                     "resumed from checkpoint at step %d", self._last_ckpt_step
                 )
-                if self.cfg.scale_lr_with_workers and self._base_lr:
+                if (self.cfg.scale_lr_with_workers and self._base_lr
+                        and not self._pushed_lr):
                     from elasticdl_tpu.training.lr_modulation import linear_scale
 
                     # the restored opt_state may carry an LR scaled for a
                     # membership that no longer exists; re-derive it from the
-                    # CURRENT worker count seen at registration
+                    # CURRENT worker count seen at registration (unless a
+                    # master LR push is active — it wins)
                     self._pending_lr = linear_scale(
                         self._base_lr,
                         self._last_known_workers or self.cfg.num_workers,
@@ -254,6 +257,15 @@ class Worker:
                     self._on_membership_change(
                         resp.membership_version, resp.num_workers
                     )
+                if (
+                    resp.learning_rate > 0
+                    and resp.learning_rate != self._pushed_lr
+                ):
+                    # master-pushed LR override (ReduceLROnPlateau): applied
+                    # at the next task boundary, AFTER any elastic rescale
+                    # set above — the push is job-global and wins
+                    self._pushed_lr = resp.learning_rate
+                    self._pending_lr = resp.learning_rate
             except Exception as e:  # master gone → stop
                 logger.warning("heartbeat failed: %s", e)
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
@@ -269,11 +281,18 @@ class Worker:
             "membership v%d -> v%d", self._membership_version, new_version
         )
         self._membership_version = new_version
-        if self.cfg.scale_lr_with_workers and self._base_lr and num_workers:
+        if (
+            self.cfg.scale_lr_with_workers and self._base_lr and num_workers
+            and not self._pushed_lr
+        ):
             from elasticdl_tpu.training.lr_modulation import linear_scale
 
             # applied by the run loop at the next task boundary (the
-            # heartbeat thread must not swap state mid-train-step)
+            # heartbeat thread must not swap state mid-train-step). An
+            # active master push (ReduceLROnPlateau) wins over the elastic
+            # rescale — without this guard a membership bump would silently
+            # revert the plateau reduction and the push could never re-fire
+            # (resp.learning_rate == self._pushed_lr stays true)
             self._pending_lr = linear_scale(
                 self._base_lr, num_workers, self.cfg.num_workers
             )
@@ -556,10 +575,14 @@ class Worker:
             task = resp.task
             pending_lr, self._pending_lr = self._pending_lr, None
             if pending_lr is not None and self._state is not None:
-                self._state = self._trainer.set_learning_rate(
-                    self._state, pending_lr
+                from elasticdl_tpu.training.lr_modulation import (
+                    apply_learning_rate,
                 )
-                logger.info("elastic LR scaled to %.6g", pending_lr)
+
+                self._state = apply_learning_rate(
+                    self._trainer, self._state, pending_lr
+                )
+                logger.info("runtime LR set to %.6g", pending_lr)
             elif pending_lr is not None:
                 # state not built yet: keep it pending for the next loop
                 self._pending_lr = pending_lr

@@ -90,7 +90,10 @@ def loss(labels, outputs):
 
 
 def optimizer(**kwargs):
-    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))
+    from elasticdl_tpu.training import lr_modulation
+
+    return lr_modulation.modulated(
+        optax.adam, learning_rate=float(kwargs.get("learning_rate", 1e-3)))
 
 
 # CSV column order of the UCI adult dataset.

@@ -95,10 +95,14 @@ def loss(labels, outputs):
 
 
 def optimizer(**kwargs):
-    lr = float(kwargs.get("learning_rate", 0.1))
-    return optax.chain(
-        optax.add_decayed_weights(float(kwargs.get("weight_decay", 1e-4))),
-        optax.sgd(lr, momentum=0.9, nesterov=True),
+    from elasticdl_tpu.training import lr_modulation
+
+    return lr_modulation.modulated(
+        lambda learning_rate: optax.chain(
+            optax.add_decayed_weights(float(kwargs.get("weight_decay", 1e-4))),
+            optax.sgd(learning_rate, momentum=0.9, nesterov=True),
+        ),
+        learning_rate=float(kwargs.get("learning_rate", 0.1)),
     )
 
 

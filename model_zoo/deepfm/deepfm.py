@@ -92,7 +92,11 @@ def loss(labels, outputs):
 
 
 def optimizer(**kwargs):
-    return optax.adam(float(kwargs.get("learning_rate", 1e-3)))
+    from elasticdl_tpu.training import lr_modulation
+
+    # modulated: runtime LR control (elastic rescale / master pushes)
+    return lr_modulation.modulated(
+        optax.adam, learning_rate=float(kwargs.get("learning_rate", 1e-3)))
 
 
 def dataset_fn(mode, metadata):

@@ -50,7 +50,15 @@ def loss(labels, outputs):
 
 
 def optimizer(**kwargs):
-    return optax.sgd(float(kwargs.get("learning_rate", 0.01)), momentum=0.9)
+    # modulated: LR lives in the optimizer STATE (injected hyperparams), so
+    # elastic rescaling and master-pushed overrides (ReduceLROnPlateau)
+    # change it at runtime with no retrace
+    from elasticdl_tpu.training import lr_modulation
+
+    return lr_modulation.modulated(
+        lambda learning_rate: optax.sgd(learning_rate, momentum=0.9),
+        learning_rate=float(kwargs.get("learning_rate", 0.01)),
+    )
 
 
 def dataset_fn(mode, metadata):

@@ -113,9 +113,14 @@ def loss(labels, outputs):
 
 
 def optimizer(**kwargs):
-    return optax.adamw(
-        float(kwargs.get("learning_rate", 3e-4)),
-        weight_decay=float(kwargs.get("weight_decay", 0.01)),
+    from elasticdl_tpu.training import lr_modulation
+
+    return lr_modulation.modulated(
+        lambda learning_rate: optax.adamw(
+            learning_rate,
+            weight_decay=float(kwargs.get("weight_decay", 0.01)),
+        ),
+        learning_rate=float(kwargs.get("learning_rate", 3e-4)),
     )
 
 

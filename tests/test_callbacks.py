@@ -18,12 +18,16 @@ class RecordingCtx:
     def __init__(self):
         self.stops = []
         self.ckpts = []
+        self.lrs = []
 
     def stop_training(self, reason=""):
         self.stops.append(reason)
 
     def request_checkpoint(self, worker_id=0):
         self.ckpts.append(worker_id)
+
+    def set_learning_rate(self, lr):
+        self.lrs.append(lr)
 
 
 def test_early_stopping_max_mode_patience():
@@ -52,6 +56,62 @@ def test_early_stopping_min_mode_and_missing_metric():
     cb.on_eval_result(2, {"accuracy": 0.5})  # missing metric: warned, ignored
     cb.on_eval_result(3, {"loss": 0.995})    # within min_delta: no improvement
     assert ctx.stops and not ctx.ckpts
+
+
+def test_reduce_lr_on_plateau():
+    from elasticdl_tpu.api.callbacks import ReduceLROnPlateau
+
+    cb = ReduceLROnPlateau(initial_lr=0.1, monitor="loss", factor=0.5,
+                           patience=2, min_lr=0.02)
+    ctx = RecordingCtx()
+    cb.set_context(ctx)
+    assert cb.mode == "min"
+    cb.on_eval_result(1, {"loss": 1.0})
+    cb.on_eval_result(2, {"loss": 0.8})    # improving: no action
+    cb.on_eval_result(3, {"loss": 0.9})    # wait=1
+    cb.on_eval_result(4, {"loss": 0.85})   # wait=2 -> reduce
+    assert ctx.lrs == [0.05]
+    cb.on_eval_result(5, {"loss": 0.9})    # wait=1 (reset after reduce)
+    cb.on_eval_result(6, {"loss": 0.9})    # wait=2 -> reduce, clamped later
+    assert ctx.lrs == [0.05, 0.025]
+    cb.on_eval_result(7, {"loss": 0.9})
+    cb.on_eval_result(8, {"loss": 0.9})    # would go below min_lr: clamp
+    assert ctx.lrs == [0.05, 0.025, 0.02]
+    cb.on_eval_result(9, {"loss": 0.9})
+    cb.on_eval_result(10, {"loss": 0.9})   # at min_lr: no further pushes
+    assert ctx.lrs == [0.05, 0.025, 0.02]
+    # a missing metric is warned and ignored, state unchanged
+    cb.on_eval_result(11, {"auc": 0.5})
+    assert ctx.lrs == [0.05, 0.025, 0.02]
+
+
+def test_reduce_lr_validates_args():
+    from elasticdl_tpu.api.callbacks import ReduceLROnPlateau
+
+    with pytest.raises(ValueError, match="factor"):
+        ReduceLROnPlateau(initial_lr=0.1, factor=1.5)
+    with pytest.raises(ValueError, match="mode"):
+        ReduceLROnPlateau(initial_lr=0.1, mode="sideways")
+
+
+def test_zoo_optimizers_support_runtime_lr():
+    """Every zoo optimizer that plateau-pushes/elastic-scaling should reach
+    must carry the injected learning_rate hyperparam (resnet50 deliberately
+    uses a fixed warmup-cosine schedule instead)."""
+    import importlib
+
+    from elasticdl_tpu.training import lr_modulation
+
+    for name in ("mnist.mnist_cnn", "deepfm.deepfm", "deepfm.xdeepfm",
+                 "census.wide_deep", "cifar10.resnet",
+                 "transformer.transformer_lm"):
+        module = importlib.import_module("model_zoo." + name)
+        tx = module.optimizer()
+        state = tx.init({"w": np.zeros((2,), np.float32)})
+        assert lr_modulation.get_learning_rate(state) is not None, name
+        state2 = lr_modulation.set_learning_rate(state, 0.123)
+        # float32 storage in the optimizer state
+        assert abs(lr_modulation.get_learning_rate(state2) - 0.123) < 1e-6
 
 
 def test_job_context_stop_training_hits_dispatcher():

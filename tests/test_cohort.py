@@ -114,6 +114,28 @@ def test_cohort_grouped_dispatch_end_to_end(tmp_path):
     assert "distributed world v0 up: process 1/2" in log
 
 
+def test_cohort_master_lr_push_applies_on_all_processes(tmp_path):
+    """ReduceLROnPlateau's transport, end-to-end in cohort mode: the master
+    sets an LR override; it rides a heartbeat to the leader, then the ctrl
+    broadcast (as float64 bits in int32 halves) to every process, which all
+    apply it at the same task boundary."""
+    cfg = job_config(tmp_path)
+    fired = {"done": False}
+
+    def push_lr(master, manager):
+        # once the job is visibly underway, push the override
+        if not fired["done"] and master.dispatcher.counts()["doing"] > 0:
+            master.servicer.set_learning_rate(5e-4)
+            fired["done"] = True
+
+    counts = run_job(cfg, tmp_path, observer=push_lr)
+    assert counts["failed_permanently"] == 0
+    assert fired["done"]
+    log = all_logs(tmp_path)
+    # both processes applied it (one log line per process)
+    assert log.count("applied master-pushed LR 0.0005") == 2, log[-2000:]
+
+
 def test_cohort_evaluation_only_job(tmp_path):
     """evaluation_only in cohort mode: eval tasks stream through every
     process's eval_step, metric states merge master-side, AUC comes back."""

@@ -1,0 +1,34 @@
+"""Cohort control-vector wire format: 64-bit values must survive the
+broadcast (jax canonicalizes int64 arrays to int32 when x64 is off — the
+int32-halves encoding in CohortContext.broadcast_ints is what prevents
+silent wrap of float64 LR bit-patterns and >2^31 record spans)."""
+
+import numpy as np
+
+from elasticdl_tpu.parallel.elastic import CohortContext
+from elasticdl_tpu.worker.cohort import _bits_to_lr, _lr_to_bits
+
+
+def test_lr_bits_round_trip():
+    for lr in (1e-8, 3e-4, 0.05, 0.1, 1.0, 123.456):
+        assert _bits_to_lr(_lr_to_bits(lr)) == lr
+    assert _lr_to_bits(0.0) == 0
+    assert _bits_to_lr(0) == 0.0
+
+
+def test_broadcast_ints_keeps_64_bits():
+    """Single-process broadcast (leader is source and sink) must round-trip
+    values far beyond int32 — the exact payloads the cohort protocol
+    carries: LR bit-patterns (~4.6e18) and Criteo-1TB-scale spans."""
+    ctx = CohortContext("localhost:0", num_processes=1, process_id=0)
+    vec = [
+        1, 0, 2, 7,
+        4_370_000_000,            # > 2^31: record span of a 1TB criteo file
+        4_380_000_000,
+        0, -1,
+        _lr_to_bits(0.05),        # 4587366580439587226
+    ]
+    out = ctx.broadcast_ints(vec)
+    assert out.dtype == np.int64
+    assert [int(x) for x in out] == vec
+    assert _bits_to_lr(int(out[-1])) == 0.05

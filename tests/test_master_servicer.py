@@ -115,6 +115,18 @@ def test_heartbeat_and_membership(master_stack):
     assert resp2.task.task_id == resp.task.task_id
 
 
+def test_heartbeat_carries_lr_override(master_stack):
+    """ReduceLROnPlateau's push path: servicer.set_learning_rate shows up in
+    every subsequent HeartbeatResponse (0 until set)."""
+    stub, dispatcher, membership, *_, servicer = master_stack
+    r0 = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w0"))
+    h = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r0.worker_id))
+    assert h.learning_rate == 0.0
+    servicer.set_learning_rate(5e-4)
+    h2 = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r0.worker_id))
+    assert abs(h2.learning_rate - 5e-4) < 1e-12
+
+
 def test_wait_when_drained(master_stack):
     stub, dispatcher, *_ = master_stack
     r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
