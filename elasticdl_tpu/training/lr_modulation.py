@@ -108,12 +108,12 @@ def apply_learning_rate(trainer, state, lr: float):
     was not built through `modulated(...)` — a pushed/rescaled LR reaching
     such a job is a config mismatch that must log, not kill the worker.
     Returns the (possibly unchanged) state. Shared by worker and cohort."""
-    import logging
+    from elasticdl_tpu.common.log_utils import default_logger
 
     try:
         return trainer.set_learning_rate(state, lr)
     except KeyError:
-        logging.getLogger(__name__).warning(
+        default_logger(__name__).warning(
             "ignoring LR %.6g: optimizer has no injected learning_rate "
             "(use lr_modulation.modulated)", lr,
         )

@@ -90,6 +90,7 @@ class CohortWorker:
         # for the length of a dispatch.
         self._model_version = 0
         self._pushed_lr = 0.0         # leader: last LR override from heartbeat
+        self._ctrl_pushed_lr = 0.0    # all: latest override from the ctrl vector
         self._applied_push_lr = 0.0   # all: last override applied to state
         self.worker_id = -1
 
@@ -307,25 +308,32 @@ class CohortWorker:
         valid = np.asarray(host_batch["mask"]) > 0
         processor.process(np.asarray(full)[valid], self.worker_id)
 
-    def _run_task(self, ctrl: List[int]) -> None:
-        import jax
-
-        _, task_id, task_type, shard_idx, start, end, flags, eval_job, lr_bits = ctrl
-        pushed_lr = _bits_to_lr(lr_bits)
+    def _maybe_apply_ctrl_lr(self) -> None:
+        """Apply the latest ctrl-carried LR override once state exists.
+        Called at the task boundary AND after _ensure_state: a relaunched
+        cohort builds state lazily from a pre-push checkpoint (stale LR in
+        its opt_state), and must not run its whole first task on it. Every
+        process reaches the same call sites with the same ctrl value, so
+        lockstep holds; a non-modulated optimizer logs instead of crashing
+        (deterministically on all processes)."""
+        pushed_lr = self._ctrl_pushed_lr
         if pushed_lr > 0 and pushed_lr != self._applied_push_lr and \
                 self._state is not None:
             from elasticdl_tpu.training.lr_modulation import (
                 apply_learning_rate,
             )
 
-            # every process applies the identical override at the identical
-            # task boundary (the ctrl broadcast carries it); a non-modulated
-            # optimizer logs instead of crashing — deterministically on all
-            # processes, so lockstep holds either way
             self._state = apply_learning_rate(
                 self._trainer, self._state, pushed_lr)
             self._applied_push_lr = pushed_lr
             logger.info("applied master-pushed LR %g", pushed_lr)
+
+    def _run_task(self, ctrl: List[int]) -> None:
+        import jax
+
+        _, task_id, task_type, shard_idx, start, end, flags, eval_job, lr_bits = ctrl
+        self._ctrl_pushed_lr = _bits_to_lr(lr_bits)
+        self._maybe_apply_ctrl_lr()
         if task_type == pb.SAVE_MODEL:
             # The master's final exclusive save task: a collective checkpoint
             # (every process writes its addressable shards), leader reports.
@@ -418,6 +426,7 @@ class CohortWorker:
                 if self._state is None:
                     self._ensure_state(make_global_batch(
                         self._mesh, host_batch, self._spec.batch_partition))
+                    self._maybe_apply_ctrl_lr()
                 buf.append(host_batch)
                 if len(buf) == k:
                     flush_training_group()
@@ -426,6 +435,7 @@ class CohortWorker:
                 self._mesh, host_batch, self._spec.batch_partition
             )
             self._ensure_state(batch)
+            self._maybe_apply_ctrl_lr()
             if task_type == pb.PREDICTION:
                 outputs = self._trainer.predict_step(self._state, batch)
                 self._process_predictions(outputs, host_batch)

@@ -251,6 +251,44 @@ def test_ring_flash_matches_full_attention(monkeypatch, causal):
                                    atol=2e-4, rtol=2e-4)
 
 
+def test_ulysses_flash_matches_full_attention(monkeypatch):
+    """Ulysses + flash: the all-to-all re-shard hands each device the FULL
+    sequence for H/n heads, and its local full_attention dispatches to the
+    kernel (static offset 0) under EDL_FLASH=1 — must match unsharded
+    attention forward and backward."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    from elasticdl_tpu.ops.attention import sequence_parallel_attention
+    from elasticdl_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh({"data": 2, "seq": 4})
+    Bq, Tq, Hq, Dq = 2, 64, 4, 8          # heads % seq_shards == 0
+    r = np.random.RandomState(9)
+    mk = lambda: jnp.asarray(r.randn(Bq, Tq, Hq, Dq), jnp.float32)
+    q, k, v = mk(), mk(), mk()
+
+    ref = full_attention(q, k, v, causal=True)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(full_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+
+    monkeypatch.setenv("EDL_FLASH", "1")
+    with pltpu.force_tpu_interpret_mode(), jax.set_mesh(mesh):
+        got = jax.jit(
+            lambda q, k, v: sequence_parallel_attention(
+                q, k, v, causal=True, mode="ulysses"))(q, k, v)
+        g_got = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(sequence_parallel_attention(
+                q, k, v, causal=True, mode="ulysses") ** 2),
+            argnums=(0, 1, 2)))(q, k, v)
+
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               atol=3e-5, rtol=3e-5)
+    for a, b in zip(g_got, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
 def test_flash_rejects_unblockable():
     q, k, v = _qkv(t_q=100, t_k=64)
     with pytest.raises(ValueError, match="cannot block"):
