@@ -114,12 +114,14 @@ def test_cohort_grouped_dispatch_end_to_end(tmp_path):
     assert "distributed world v0 up: process 1/2" in log
 
 
-def test_cohort_master_lr_push_applies_on_all_processes(tmp_path):
-    """ReduceLROnPlateau's transport, end-to-end in cohort mode: the master
-    sets an LR override; it rides a heartbeat to the leader, then the ctrl
-    broadcast (as float64 bits in int32 halves) to every process, which all
-    apply it at the same task boundary."""
-    cfg = job_config(tmp_path)
+@pytest.mark.parametrize("num_processes", [1, 2])
+def test_master_lr_push_applies(tmp_path, num_processes):
+    """ReduceLROnPlateau's transport, end-to-end in both worker flavors:
+    the master sets an LR override; a heartbeat carries it to the worker
+    (plain mode, applied at the next task boundary) or to the cohort
+    leader, then the ctrl broadcast (float64 bits in int32 halves) to
+    every process, which all apply it at the same boundary."""
+    cfg = job_config(tmp_path, num_processes=num_processes)
     fired = {"done": False}
 
     def push_lr(master, manager):
@@ -132,8 +134,11 @@ def test_cohort_master_lr_push_applies_on_all_processes(tmp_path):
     assert counts["failed_permanently"] == 0
     assert fired["done"]
     log = all_logs(tmp_path)
-    # both processes applied it (one log line per process)
-    assert log.count("applied master-pushed LR 0.0005") == 2, log[-2000:]
+    if num_processes == 2:
+        # both cohort processes applied it (one log line per process)
+        assert log.count("applied master-pushed LR 0.0005") == 2, log[-2000:]
+    else:
+        assert "runtime LR set to 0.0005" in log, log[-2000:]
 
 
 def test_cohort_evaluation_only_job(tmp_path):
