@@ -12,8 +12,18 @@ host's PCIe (BASELINE.md round-3 breakdown).
 Prints exactly ONE JSON line:
   {"metric": ..., "value": N, "unit": "samples/s/chip", "vs_baseline": N, ...}
 Extra keys: per-config sweep (`configs`), embedding engine modes
-(`embedding_rows_per_sec`), pipeline numbers. EDL_BENCH_FAST=1 skips the
-sweep (headline + pipeline only).
+(`embedding_rows_per_sec`), pipeline numbers, and — on TPU — MFU/roofline
+fields: every model leg reports analytic FLOPs (XLA cost analysis of the
+lowered step) -> achieved TFLOP/s -> `mfu_pct` vs the chip's bf16 peak;
+the HBM-bound embedding leg reports effective GB/s vs the HBM roofline
+instead. EDL_BENCH_FAST=1 skips the sweep (headline + pipeline only).
+
+Wedge-proofing (round-3 postmortem: both official artifacts were lost to a
+hung `jax.devices()`): a subprocess device probe with a hard timeout runs
+FIRST; if the TPU tunnel is wedged the JSON line prints within ~80 s
+carrying the error plus a jax-free host-pipeline measurement. All legs are
+clamped to one global BUDGET_S deadline measured from process start.
+EDL_BENCH_CPU=1 re-points every leg at the CPU backend (dev only).
 """
 
 from __future__ import annotations
@@ -51,6 +61,62 @@ SCAN_STEPS = int(os.environ.get("EDL_BENCH_SCAN_STEPS", "32"))
 # re-based in BASELINE.md's round log.
 MIN_WALL_S = float(os.environ.get("EDL_BENCH_MIN_WALL_S", "2.5"))
 
+# Chip rooflines for MFU / HBM-utilization reporting (device_kind substring
+# -> (peak bf16 dense TFLOP/s, HBM GB/s), public spec-sheet numbers; first
+# match wins, so more specific kinds come first). Override with
+# EDL_PEAK_TFLOPS / EDL_PEAK_HBM_GBPS. MFU here = achieved-FLOPs(analytic,
+# from the lowered HLO's cost analysis) / bf16 peak — the portable yardstick
+# SURVEY §6 asks for since the reference publishes no absolute numbers.
+TPU_PEAKS = (
+    ("v6", (918.0, 1640.0)),      # Trillium / v6e
+    ("v5p", (459.0, 2765.0)),
+    ("v5", (197.0, 819.0)),       # v5e / "TPU v5 lite"
+    ("v4", (275.0, 1228.0)),
+    ("v3", (123.0, 900.0)),
+    ("v2", (46.0, 700.0)),
+)
+
+
+def _chip_peaks():
+    """(peak_tflops, peak_hbm_gbps) for this backend; each element is None
+    off-TPU with no override (MFU would be meaningless on the CPU mesh).
+    The two env overrides apply independently — they feed disjoint
+    consumers (_mfu_fields uses only the FLOP peak, the embedding leg only
+    the HBM peak)."""
+    import jax
+
+    tf_env = os.environ.get("EDL_PEAK_TFLOPS")
+    bw_env = os.environ.get("EDL_PEAK_HBM_GBPS")
+    tf = float(tf_env) if tf_env else None
+    bw = float(bw_env) if bw_env else None
+    if (tf is None or bw is None) and jax.default_backend() == "tpu":
+        kind = jax.devices()[0].device_kind.lower()
+        dtf, dbw = next(
+            (peaks for key, peaks in TPU_PEAKS if key in kind),
+            (197.0, 819.0),   # unknown TPU: assume v5e-class
+        )
+        tf = dtf if tf is None else tf
+        bw = dbw if bw is None else bw
+    return tf, bw
+
+
+def _mfu_fields(flops_per_step: float, step_s: float, n_chips: int = 1) -> dict:
+    """MFU/roofline keys for a leg, empty off-TPU or when costing failed.
+    `flops_per_step` is the GLOBAL (whole-mesh) analytic count from the
+    pre-partitioning lowered HLO, so achieved TFLOP/s and MFU are
+    normalized PER CHIP to compare against the single-chip peak."""
+    peak_tf, _ = _chip_peaks()
+    if not flops_per_step or not step_s:
+        return {}
+    achieved_tf = flops_per_step / step_s / 1e12 / max(1, n_chips)
+    out = {
+        "gflops_per_step": round(flops_per_step / 1e9, 3),
+        "achieved_tflops_per_chip": round(achieved_tf, 3),
+    }
+    if peak_tf:
+        out["mfu_pct"] = round(100.0 * achieved_tf / peak_tf, 3)
+    return out
+
 
 def timed_loop(dispatch, readback, n0, max_iters=100_000):
     """Run `dispatch(i)` n times then `readback()` (must force completion of
@@ -74,7 +140,8 @@ def _run_steps(trainer, mesh, batches):
     jitted steps per dispatch (lax.scan over a stacked batch pytree), so the
     per-dispatch tunnel cost (~10-70 ms here) is amortized across K real
     train steps — the honest chip number, not the dispatch rate. Returns
-    (total_steps, seconds)."""
+    (total_steps, seconds, analytic flops per step from the lowered HLO —
+    global across the mesh; 0.0 when costing failed)."""
     from elasticdl_tpu.parallel.mesh import shard_batch_stack
 
     reps = -(-SCAN_STEPS // len(batches))
@@ -95,8 +162,12 @@ def _run_steps(trainer, mesh, batches):
 
     dispatch(0)
     readback()      # compile + warmup
+    try:
+        cost = trainer.train_step_cost(state_box[0], batches[0])
+    except Exception:
+        cost = {"flops": 0.0}
     n, dt = timed_loop(dispatch, readback, 2)
-    return n * SCAN_STEPS, dt
+    return n * SCAN_STEPS, dt, cost["flops"]
 
 
 def _make_trainer(mesh, module_name, fn_module, model_params=None):
@@ -133,22 +204,25 @@ def bench_deepfm(mesh, np):
             },
             "labels": r.randint(0, 2, (BATCH,)).astype(np.int32),
         })
-    n, dt = _run_steps(trainer, mesh, batches)
-    return BATCH * n / dt
+    n, dt, flops_step = _run_steps(trainer, mesh, batches)
+    return BATCH * n / dt, _mfu_fields(flops_step, dt / n,
+                                       int(mesh.devices.size))
 
 
 def bench_config(mesh, np, name, batch, make_batches, model_params=None):
-    """One parity config: steady-state samples/s + step ms on the chip."""
+    """One parity config: steady-state samples/s + step ms + MFU on the
+    chip."""
     from elasticdl_tpu.common.model_utils import load_module
 
     module, _ = load_module(os.path.join(REPO_ROOT, "model_zoo"),
                             name + ".custom_model")
     trainer = _make_trainer(mesh, name.rsplit(".", 1)[0], module, model_params)
-    n, dt = _run_steps(trainer, mesh, make_batches(np, batch))
+    n, dt, flops_step = _run_steps(trainer, mesh, make_batches(np, batch))
     return {
         "samples_per_sec": round(batch * n / dt, 1),
         "step_ms": round(1e3 * dt / n, 3),
         "batch": batch,
+        **_mfu_fields(flops_step, dt / n, int(mesh.devices.size)),
     }
 
 
@@ -269,6 +343,25 @@ def bench_embedding_modes(mesh, np):
                 "lookup_rows_per_sec": round(lookup_rps, 1),
                 "update_rows_per_sec": round(update_rps, 1),
             }
+
+    # Embedding is HBM-bound, not FLOP-bound, so its roofline is bandwidth:
+    # analytic bytes/row (f32, D floats) — lookup touches 2 rows' worth
+    # (table read + output write), a full SGD update ~5 (fwd gather 2 +
+    # grad-segment read 1 + table read-modify-write 2). Utilization against
+    # the chip's HBM peak says how far the engine is from the roof.
+    _, peak_bw = _chip_peaks()
+    row_bytes = D * 4
+    for mode in ("manual", "auto"):
+        r = results[mode]
+        r["lookup_hbm_gbps"] = round(
+            r["lookup_rows_per_sec"] * 2 * row_bytes / 1e9, 2)
+        r["update_hbm_gbps"] = round(
+            r["update_rows_per_sec"] * 5 * row_bytes / 1e9, 2)
+        if peak_bw:
+            r["lookup_hbm_util_pct"] = round(
+                100.0 * r["lookup_hbm_gbps"] / peak_bw, 2)
+            r["update_hbm_util_pct"] = round(
+                100.0 * r["update_hbm_gbps"] / peak_bw, 2)
     return results
 
 
@@ -358,6 +451,44 @@ def bench_time_to_auc(mesh, np, target=0.75):
     }
 
 
+def bench_host_pipeline(np):
+    """Host half of the input path ONLY — disk → contiguous span read →
+    binary decode — with no JAX backend touched anywhere (verified: the
+    reader/parser/task-data-service modules contain zero jax calls). This is
+    the wedged-tunnel fallback: when `jax.devices()` hangs (observed rounds
+    3-4), the driver still gets a real measured number for the half of the
+    system that doesn't need the chip."""
+    import tempfile
+
+    from elasticdl_tpu.data import parsing as parsing_lib
+    from elasticdl_tpu.data.reader import FixedLenBinDataReader
+    from elasticdl_tpu.worker.task_data_service import TaskDataService
+
+    n_pipe = BATCH * 24
+    r = np.random.RandomState(7)
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, "criteo.cbin")
+        with open(path, "wb") as f:
+            f.write(parsing_lib.criteo_bin_encode(
+                r.randint(0, 2, n_pipe).astype(np.int32),
+                r.rand(n_pipe, 13).astype(np.float32),
+                r.randint(0, 1 << 31, (n_pipe, 26)).astype(np.int32),
+            ))
+        reader = FixedLenBinDataReader(
+            path, record_bytes=parsing_lib.criteo_bin_record_bytes()
+        )
+        svc = TaskDataService(
+            reader, parsing_lib.criteo_bin_batch_parser(), BATCH
+        )
+        for _ in svc.batches(path, 0, BATCH):        # warm page cache
+            pass
+        t1 = time.perf_counter()
+        for _ in svc.batches(path, 0, n_pipe):
+            pass
+        host_sps = n_pipe / (time.perf_counter() - t1)
+    return {"pipeline_host_samples_per_sec": round(host_sps, 1)}
+
+
 def bench_pipeline(mesh, np):
     """FULL input path: fixed-width .cbin shard on disk → contiguous span
     read → memcpy-speed binary decode → async H2D with bf16 wire cast. Text
@@ -424,13 +555,14 @@ def _run_leg(leg, mesh, np):
         import jax
 
         n_chips = len(jax.devices())
-        headline = bench_deepfm(mesh, np)
+        headline, mfu = bench_deepfm(mesh, np)
         pipeline_sps, host_sps = bench_pipeline(mesh, np)
         return {
             "value": round(headline / n_chips, 1),
             "pipeline_samples_per_sec": round(pipeline_sps, 1),
             "pipeline_host_samples_per_sec": round(host_sps, 1),
             "n_chips": n_chips,
+            **mfu,
         }
     if leg == "mnist_cnn":
         return bench_config(
@@ -491,14 +623,65 @@ SWEEP_LEGS = (
     "mnist_cnn", "cifar10_resnet20", "resnet50_imagenet",
     "census_wide_deep", "embedding", "transformer_lm", "time_to_auc",
 )
-LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "600"))
+LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
 # against their OWN kill deadline (see bench_time_to_auc)
 _PROC_T0 = time.perf_counter()
-# Global wall-clock budget: once exceeded, remaining sweep legs are skipped
-# (recorded as such) so a wedged TPU tunnel can't stretch the bench to
-# n_legs x timeout — the driver still gets its JSON line in bounded time.
-BUDGET_S = int(os.environ.get("EDL_BENCH_BUDGET_S", "2400"))
+# GLOBAL wall-clock budget, measured from process start and covering
+# EVERYTHING (probe + headline + retries + sweep): once the deadline nears,
+# remaining legs are skipped (recorded as such) and the JSON line prints.
+# Round 3 lesson: the old budget only capped the sweep, so two 600 s wedged
+# headline attempts pushed past the driver's own timeout and the round lost
+# its BENCH record entirely. The driver's kill fired somewhere past ~1300 s
+# in round 3, so the default keeps the worst case (last leg launched just
+# under the deadline minus its clamped timeout, plus the 20 s print reserve)
+# comfortably below that.
+BUDGET_S = int(os.environ.get("EDL_BENCH_BUDGET_S", "1100"))
+# Fail-fast tunnel probe: `jax.devices()` in a throwaway subprocess. A live
+# tunnel answers in ~5-20 s; the round-3/4 wedge hangs it forever.
+PROBE_TIMEOUT_S = int(os.environ.get("EDL_BENCH_PROBE_TIMEOUT_S", "75"))
+
+
+def _remaining_s():
+    return BUDGET_S - (time.perf_counter() - _PROC_T0)
+
+
+def _probe_tunnel():
+    """(n_devices, platform) via a subprocess jax.devices(), or an error
+    string if the probe dies/hangs — without wedging THIS process."""
+    import subprocess
+
+    try:
+        snippet = (
+            "import os, jax, json\n"
+            "if os.environ.get('EDL_BENCH_CPU') == '1':\n"
+            "    import jax._src.xla_bridge as xb\n"
+            "    xb._backend_factories.pop('axon', None)\n"
+            "    jax.config.update('jax_platforms', 'cpu')\n"
+            "ds = jax.devices()\n"
+            "print(json.dumps({'n': len(ds), 'platform': ds[0].platform}))\n"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", snippet],
+            capture_output=True, timeout=PROBE_TIMEOUT_S,
+        )
+    except subprocess.TimeoutExpired:
+        # the one signature that actually means a wedged tunnel
+        return None, (
+            f"device probe failed: jax.devices() did not answer within "
+            f"{PROBE_TIMEOUT_S}s — TPU tunnel wedged"
+        )
+    try:
+        info = json.loads(proc.stdout.decode().strip().splitlines()[-1])
+        return (info["n"], info["platform"]), None
+    except Exception as e:
+        # probe crashed / printed garbage: an environment or code bug, NOT a
+        # wedge — say so, with the child's stderr, instead of mislabeling it
+        tail = proc.stderr.decode(errors="replace").strip()[-300:]
+        return None, (
+            f"device probe crashed ({type(e).__name__}, child rc="
+            f"{proc.returncode}): {tail}"
+        )
 
 
 def main():
@@ -509,8 +692,21 @@ def main():
 
     from elasticdl_tpu.parallel.mesh import build_mesh
 
+    if os.environ.get("EDL_BENCH_CPU") == "1":
+        # Development/wedged-tunnel escape hatch: run every leg on the CPU
+        # backend (numbers are NOT chip numbers). Same repoint as
+        # tests/conftest.py — pop the axon factory BEFORE any jax.devices().
+        import jax._src.xla_bridge as _xb
+
+        _xb._backend_factories.pop("axon", None)
+        jax.config.update("jax_platforms", "cpu")
+
     if len(sys.argv) >= 3 and sys.argv[1] == "--leg":
         # subprocess mode: one leg, one JSON line
+        if sys.argv[2] == "host_pipeline":
+            # jax-free leg: must not touch jax.devices() (wedged-tunnel path)
+            print(json.dumps(bench_host_pipeline(np)))
+            return
         mesh = build_mesh({"data": len(jax.devices())})
         print(json.dumps(_run_leg(sys.argv[2], mesh, np)))
         return
@@ -520,6 +716,11 @@ def main():
     def leg_subprocess(leg, timeout_s, retries=0):
         err = "unknown"
         for attempt in range(retries + 1):
+            # clamp every attempt to the global deadline (+ keep a 20 s
+            # reserve so the final JSON always prints before any driver kill)
+            timeout_s = min(timeout_s, _remaining_s() - 20)
+            if timeout_s < 30:
+                return {"error": f"skipped: bench budget ({BUDGET_S}s) spent"}
             proc = None
             try:
                 proc = subprocess.run(
@@ -550,24 +751,57 @@ def main():
                       file=sys.stderr, flush=True)
         return {"error": err[:500]}
 
+    baseline = os.environ.get("EDL_BENCH_BASELINE")
+    baseline = float(baseline) if baseline else DEFAULT_BASELINE
+
+    # Fail-fast tunnel probe (round-3 postmortem): if jax.devices() hangs,
+    # emit the JSON line IMMEDIATELY with the error plus a real host-side
+    # measurement, instead of burning the whole driver timeout on doomed
+    # 420 s leg attempts.
+    probe, probe_err = _probe_tunnel()
+    if probe is None:
+        print(f"[bench] {probe_err}", file=sys.stderr, flush=True)
+        host = leg_subprocess("host_pipeline", 180)
+        result = {
+            "metric": "deepfm_train_samples_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "samples/s/chip",
+            "vs_baseline": 0.0,
+            "error": probe_err,
+            "pipeline_host_samples_per_sec": host.get(
+                "pipeline_host_samples_per_sec", 0.0
+            ),
+            "note": (
+                "chip legs not run: device backend unreachable; host-side "
+                "input pipeline measured jax-free. Last good chip numbers: "
+                "BASELINE.md round log."
+            ),
+        }
+        print(json.dumps(result))
+        return
+    n_dev, platform = probe
+    print(f"[bench] device probe ok: {n_dev} x {platform}",
+          file=sys.stderr, flush=True)
+
     # The headline runs in a subprocess too (timeout + one retry): the
-    # sandbox's TPU tunnel can wedge (observed round 3 — jax.devices() hung
-    # for new clients after a killed heavy compile), and the driver must
-    # always get its one JSON line back.
+    # tunnel can wedge mid-round, and the driver must always get its one
+    # JSON line back.
     head = leg_subprocess("headline_pipeline", LEG_TIMEOUT_S, retries=1)
     result = {
         "metric": "deepfm_train_samples_per_sec_per_chip",
         "value": head.get("value", 0.0),
         "unit": "samples/s/chip",
+        "platform": platform,
         "pipeline_samples_per_sec": head.get("pipeline_samples_per_sec", 0.0),
         "pipeline_host_samples_per_sec": head.get(
             "pipeline_host_samples_per_sec", 0.0
         ),
     }
+    for extra in ("gflops_per_step", "achieved_tflops_per_chip", "mfu_pct"):
+        if extra in head:
+            result[extra] = head[extra]
     if "error" in head:
         result["error"] = head["error"]
-    baseline = os.environ.get("EDL_BENCH_BASELINE")
-    baseline = float(baseline) if baseline else DEFAULT_BASELINE
     result["vs_baseline"] = (
         round(result["value"] / baseline, 3) if baseline else 1.0
     )
@@ -576,17 +810,14 @@ def main():
         # Each sweep leg runs in its OWN subprocess with a hard timeout: one
         # stuck leg must not take the whole bench down, and the chip is
         # released between legs.
-        t_start = time.perf_counter()
         configs = {}
         for leg in SWEEP_LEGS:
-            elapsed = time.perf_counter() - t_start
-            if elapsed > BUDGET_S:
-                configs[leg] = {"error": f"skipped: bench budget ({BUDGET_S}s) spent"}
+            if _remaining_s() < 90:
+                configs[leg] = {
+                    "error": f"skipped: bench budget ({BUDGET_S}s) spent"}
                 continue
             print(f"[bench] leg {leg}...", file=sys.stderr, flush=True)
-            configs[leg] = leg_subprocess(
-                leg, min(LEG_TIMEOUT_S, max(60, BUDGET_S - elapsed))
-            )
+            configs[leg] = leg_subprocess(leg, LEG_TIMEOUT_S)
         result["embedding_rows_per_sec"] = configs.pop("embedding", None)
         result["configs"] = configs
 

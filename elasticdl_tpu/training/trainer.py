@@ -265,14 +265,40 @@ class Trainer:
         round-trip per minibatch — SURVEY §3.3; through this sandbox's TPU
         tunnel one dispatch costs ~10-70 ms, dwarfing small steps). Returns
         (new_state, metrics stacked over the K steps)."""
+        self._ensure_train_many()
+        with jax.set_mesh(self.mesh):
+            return self._train_many(state, stacked_batch)
+
+    def _ensure_train_many(self) -> None:
+        """Build the scan-of-step program once."""
         if self._train_many is None:
             raw = self._raw_train_step()
             self._train_many = jax.jit(
                 lambda s, stacked: jax.lax.scan(raw, s, stacked),
                 donate_argnums=(0,),
             )
+
+    def train_step_cost(self, state: TrainState, batch) -> Dict[str, float]:
+        """XLA cost analysis of ONE train step (the scan body `train_many`
+        runs K times per dispatch): {'flops', 'bytes accessed'} from the
+        lowered (pre-optimization) HLO — no compile or execution, so it
+        costs milliseconds. The SINGLE step is costed deliberately: XLA's
+        cost analysis counts a `lax.scan` (while-loop) body ONCE regardless
+        of trip count, so costing the train_many program would be ambiguous
+        per-step. Matmul/conv FLOPs are exact (fusion never changes them);
+        'bytes accessed' counts every pre-fusion intermediate and so
+        upper-bounds real HBM traffic. This is the analytic numerator for
+        the MFU the bench reports."""
+        if self._train_step is None:
+            self._train_step = self._build_train_step()
+        batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            return self._train_many(state, stacked_batch)
+            ca = self._train_step.lower(state, batch).cost_analysis()
+        d = ca if isinstance(ca, dict) else (ca[0] if ca else {})
+        return {
+            "flops": float(d.get("flops", 0.0)),
+            "bytes accessed": float(d.get("bytes accessed", 0.0)),
+        }
 
     def set_learning_rate(self, state: TrainState, lr: float) -> TrainState:
         """Runtime LR change with no retrace — requires the zoo optimizer to

@@ -49,9 +49,12 @@ def test_run_steps_counts_scan_steps(bench, mesh8, monkeypatch):
     )
     trainer = bench._make_trainer(mesh8, "census.wide_deep", module)
     batches = bench._census_batches(np, 16)
-    n, dt = bench._run_steps(trainer, mesh8, batches)
+    n, dt, flops_step = bench._run_steps(trainer, mesh8, batches)
     assert n % 4 == 0 and n >= 4
     assert dt > 0
+    # analytic per-step FLOPs from the lowered HLO: the MFU numerator must
+    # be real (wide_deep's matmuls alone are well past 1 kFLOP/step)
+    assert flops_step > 1e3
 
 
 def test_time_to_auc_leg_smoke(bench, mesh8, monkeypatch):
