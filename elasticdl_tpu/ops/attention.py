@@ -48,10 +48,18 @@ def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     On TPU this dispatches to the Pallas flash kernel
     (ops/pallas_attention.py) when shapes/offsets allow — 3-6x faster
     fwd+bwd on a v5e and O(T) memory instead of the materialized (B,H,T,T)
-    score matrix. EDL_FLASH=0 forces this XLA fallback everywhere."""
+    score matrix. EDL_FLASH=0 forces this XLA fallback everywhere.
+
+    Backend-divergence caveat: for a FULLY-masked row (possible only with
+    offset geometries where kv_offset > q_offset + Tq - 1) the kernel
+    returns zeros while this XLA path returns the uniform softmax over
+    NEG_BIG scores. No in-tree caller produces such rows (the
+    sequence-parallel paths always include the diagonal); external callers
+    passing exotic offsets should not rely on either value."""
     from elasticdl_tpu.ops import pallas_attention
 
-    if pallas_attention.can_flash(q.shape, k.shape, q_offset, kv_offset):
+    if pallas_attention.can_flash(q.shape, k.shape, q_offset, kv_offset,
+                                  dtype=q.dtype):
         return pallas_attention.flash_attention(
             q, k, v, causal=causal, q_offset=q_offset, kv_offset=kv_offset)
     scale = q.shape[-1] ** -0.5
@@ -220,7 +228,7 @@ def sequence_parallel_attention(
         # shard-LOCAL block shapes decide whether the flash kernel applies
         seq_shards = mesh.shape[axis]
         local = (q.shape[0], q.shape[1] // seq_shards) + q.shape[2:]
-        if pallas_attention.can_flash(local, local):
+        if pallas_attention.can_flash(local, local, dtype=q.dtype):
             body = partial(
                 _ring_attention_flash, axis_name=axis, causal=causal,
                 manual_axes=manual,

@@ -53,18 +53,49 @@ DEFAULT_BLOCK_Q = 1024
 DEFAULT_BLOCK_K = 1024
 
 
-def pick_block(t: int, target: int) -> Optional[int]:
-    """Largest power-of-two block <= target that divides t (>= 8 sublanes
-    for a float32 tile). None when t has no such divisor: caller falls back
-    to the XLA path rather than padding."""
+def pick_block(t: int, target: int, min_block: int = 8) -> Optional[int]:
+    """Largest power-of-two block <= target that divides t. `min_block` is
+    the dtype's sublane tile: 8 for float32, 16 for bfloat16 (Mosaic tiles
+    (8,128)/(16,128) respectively — a 16-sublane dtype with an 8-row block
+    fails to compile on real TPU, which interpret-mode tests can't catch).
+    None when t has no such divisor: caller falls back to the XLA path
+    rather than padding."""
     b = 1
     while b * 2 <= min(t, target):
         b *= 2
-    while b >= 8:
+    while b >= min_block:
         if t % b == 0:
             return b
         b //= 2
     return None
+
+
+def _min_block(dtype) -> int:
+    """Sublane tile floor for the q/k/v dtype (None -> assume float32):
+    Mosaic tiles are (8,128) for 4-byte, (16,128) for 2-byte, (32,128) for
+    1-byte dtypes."""
+    if dtype is None:
+        return 8
+    itemsize = jnp.dtype(dtype).itemsize
+    if itemsize == 1:
+        return 32
+    if itemsize == 2:
+        return 16
+    return 8
+
+
+def _interpret_active() -> bool:
+    """True inside `pltpu.force_tpu_interpret_mode()` (tests run the Mosaic
+    kernel on CPU there)."""
+    try:
+        from jax._src import config as _jax_config
+
+        return (
+            _jax_config.pallas_tpu_interpret_mode_context_manager.value
+            is not None
+        )
+    except Exception:
+        return False
 
 
 def _causal_p_mask(p, q_start, kv_start, block_q, block_k):
@@ -443,11 +474,13 @@ def flash_attention(
 
 def _plan_call(q, k, causal, q_offset, kv_offset, block_q, block_k,
                interpret, with_lse):
-    blocks = _plan_blocks(q.shape, k.shape, block_q, block_k)
+    blocks = _plan_blocks(q.shape, k.shape, block_q, block_k,
+                          dtype=q.dtype)
     if blocks is None:
         raise ValueError(
             f"flash_attention cannot block Tq={q.shape[1]}, Tk={k.shape[1]} "
-            f"(need a power-of-two divisor >= 8)")
+            f"dtype={q.dtype} (need a power-of-two divisor >= "
+            f"{_min_block(q.dtype)})")
     bq, bk = blocks
     offs = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                       jnp.asarray(kv_offset, jnp.int32)])
@@ -456,27 +489,33 @@ def _plan_call(q, k, causal, q_offset, kv_offset, block_q, block_k,
 
 
 def _plan_blocks(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
-                 block_q: int, block_k: int) -> Optional[Tuple[int, int]]:
-    bq = pick_block(q_shape[1], block_q)
-    bk = pick_block(k_shape[1], block_k)
+                 block_q: int, block_k: int,
+                 dtype=None) -> Optional[Tuple[int, int]]:
+    mb = _min_block(dtype)
+    bq = pick_block(q_shape[1], block_q, mb)
+    bk = pick_block(k_shape[1], block_k, mb)
     if bq is None or bk is None:
         return None
     return bq, bk
 
 
 def can_flash(q_shape: Tuple[int, ...], k_shape: Tuple[int, ...],
-              q_offset=0, kv_offset=0) -> bool:
-    """True when flash_attention supports these shapes AND the backend is
-    TPU (the Mosaic kernel has no CPU/GPU compile path; interpret mode is
-    for tests only). EDL_FLASH=0 force-disables, =1 force-enables (e.g.
-    under force_tpu_interpret_mode in tests). Offsets may be traced — they
-    are accepted for API symmetry and ignored."""
+              q_offset=0, kv_offset=0, dtype=None) -> bool:
+    """True when flash_attention supports these shapes/dtype AND a backend
+    that can run the Mosaic kernel is active: real TPU, or CPU inside
+    `force_tpu_interpret_mode` (tests). EDL_FLASH=0 force-disables;
+    EDL_FLASH=1 force-enables but ONLY on those backends — on plain CPU/GPU
+    the kernel has no compile path, so forcing it there would crash rather
+    than fall back. Offsets may be traced — they are accepted for API
+    symmetry and ignored."""
     del q_offset, kv_offset
     flag = os.environ.get("EDL_FLASH", "")
     if flag == "0":
         return False
-    if _plan_blocks(q_shape, k_shape, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K) is None:
+    if _plan_blocks(q_shape, k_shape, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                    dtype=dtype) is None:
         return False
+    runnable = jax.default_backend() == "tpu" or _interpret_active()
     if flag == "1":
-        return True
+        return runnable
     return jax.default_backend() == "tpu"

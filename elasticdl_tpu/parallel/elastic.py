@@ -19,6 +19,7 @@ shards of the global batch via `make_global_batch`.
 from __future__ import annotations
 
 import os
+from collections.abc import Mapping
 from typing import Any, Dict, Optional, Sequence
 
 import jax
@@ -122,6 +123,12 @@ def make_global_batch(mesh, batch: Any, partition=None) -> Any:
             x.shape, sharding, lambda idx: x[idx]
         )
 
+    if not isinstance(batch, Mapping):
+        # non-dict host batch (bare array / tuple pytree): per-key partition
+        # overrides can't apply, so the whole tree takes the default batch
+        # spec — mirrors shard_batch's partition=None path
+        sh = NamedSharding(mesh, mesh_lib.batch_key_spec(mesh, "", partition))
+        return jax.tree_util.tree_map(lambda x: put(x, sh), batch)
     out = {}
     for key, value in batch.items():
         sh = NamedSharding(mesh, mesh_lib.batch_key_spec(mesh, key, partition))
@@ -144,6 +151,12 @@ def make_global_batch_stack(mesh, batches, partition=None) -> Any:
         sh = NamedSharding(mesh, P(None, *spec))
         return jax.make_array_from_callback(x.shape, sh, lambda idx: x[idx])
 
+    if not isinstance(batches[0], Mapping):
+        # non-dict batches: default data spec on every leaf (matches
+        # make_global_batch / mesh.shard_batch_stack fallbacks)
+        spec = mesh_lib.batch_key_spec(mesh, "", partition)
+        return jax.tree_util.tree_map(
+            lambda *ls: put(ls, spec), *batches)
     out = {}
     for key in batches[0]:
         spec = mesh_lib.batch_key_spec(mesh, key, partition)

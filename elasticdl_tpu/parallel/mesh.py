@@ -13,6 +13,7 @@ The mesh is the single source of truth for parallelism; everything downstream
 
 from __future__ import annotations
 
+from collections.abc import Mapping
 from typing import Dict, Optional, Sequence
 
 import jax
@@ -176,7 +177,9 @@ def shard_batch(mesh: Mesh, batch, partition=None):
             return jax.device_put(x, sh)
         return put
 
-    if not partition:
+    if not partition or not isinstance(batch, Mapping):
+        # per-key overrides only apply to dict batches; a bare-array/tuple
+        # batch takes the default data sharding on every leaf
         return jax.tree_util.tree_map(put_with(batch_sharding(mesh)), batch)
     out = {}
     for key, value in batch.items():
@@ -189,6 +192,17 @@ def shard_batch_stack(mesh: Mesh, batches, partition=None):
     """Stack K host batches into one pytree with a leading step axis —
     leaves (K, B, ...), device_put as P(None, <batch spec>) — for
     `Trainer.train_many` (one dispatch runs all K steps via lax.scan)."""
+    if not isinstance(batches[0], Mapping):
+        # non-dict batches: default data spec on every leaf (matches
+        # shard_batch's fallback)
+        sh = NamedSharding(mesh, P(None, *batch_key_spec(mesh, "", partition)))
+
+        def put_all(*leaves):
+            return jax.device_put(
+                np.stack([np.asarray(l) for l in leaves]), sh
+            )
+
+        return jax.tree_util.tree_map(put_all, *batches)
     out = {}
     for key in batches[0]:
         spec = batch_key_spec(mesh, key, partition)

@@ -422,11 +422,14 @@ class Worker:
             if self._shutdown.is_set():
                 stats["interrupted"] = True
                 break
-            self._ensure_state(batch)
             # same bf16 wire compression the single-step path gets from
             # _prefetched (the mask leaf is exempted by _wire_cast itself,
-            # so flush()'s records accounting stays exact)
-            buf.append(_wire_cast(batch, self.cfg.wire_dtype))
+            # so flush()'s records accounting stays exact); cast BEFORE
+            # _ensure_state so both code paths trace/init with identical
+            # feature dtypes
+            batch = _wire_cast(batch, self.cfg.wire_dtype)
+            self._ensure_state(batch)
+            buf.append(batch)
             if len(buf) == k:
                 flush()
         if not stats["interrupted"]:

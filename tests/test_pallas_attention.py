@@ -127,16 +127,39 @@ def test_flash_rectangular_and_uneven_blocks():
 
 
 def test_can_flash_gating(monkeypatch):
+    from jax.experimental.pallas import tpu as pltpu
+
     shp = (B, T, H, D)
-    # CPU backend: off by default, EDL_FLASH=1 forces on, =0 forces off
+    # CPU backend: off by default; EDL_FLASH=1 forces on ONLY where the
+    # Mosaic kernel can actually run (TPU or interpret mode) — on plain
+    # CPU/GPU it must stay off so full_attention falls back instead of
+    # crashing in a backend with no Mosaic compile path; =0 forces off
     monkeypatch.delenv("EDL_FLASH", raising=False)
     assert can_flash(shp, shp) == (jax.default_backend() == "tpu")
     monkeypatch.setenv("EDL_FLASH", "1")
-    assert can_flash(shp, shp)
-    assert can_flash(shp, shp, q_offset=jnp.int32(0))      # traced offsets OK
-    assert not can_flash((B, 100, H, D), shp)              # unblockable T
+    assert can_flash(shp, shp) == (jax.default_backend() == "tpu")
+    with pltpu.force_tpu_interpret_mode():
+        assert can_flash(shp, shp)
+        assert can_flash(shp, shp, q_offset=jnp.int32(0))  # traced offsets OK
+        assert not can_flash((B, 100, H, D), shp)          # unblockable T
     monkeypatch.setenv("EDL_FLASH", "0")
-    assert not can_flash(shp, shp)
+    with pltpu.force_tpu_interpret_mode():
+        assert not can_flash(shp, shp)
+
+
+def test_can_flash_bfloat16_tiling(monkeypatch):
+    """bfloat16 Mosaic tiles are (16,128): a T whose largest pow-2 divisor
+    is 8 blocks fine in float32 but must be refused in bfloat16 (it would
+    fail to compile on real TPU — interpret mode can't catch that)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    monkeypatch.setenv("EDL_FLASH", "1")
+    shp24 = (B, 24, H, D)   # largest pow-2 divisor: 8
+    shp32 = (B, 32, H, D)   # 32 >= 16: fine in both dtypes
+    with pltpu.force_tpu_interpret_mode():
+        assert can_flash(shp24, shp24, dtype=jnp.float32)
+        assert not can_flash(shp24, shp24, dtype=jnp.bfloat16)
+        assert can_flash(shp32, shp32, dtype=jnp.bfloat16)
 
 
 def test_full_attention_dispatches_to_flash(monkeypatch):
