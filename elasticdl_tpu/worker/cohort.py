@@ -19,9 +19,17 @@ lockstep by construction.
 Elasticity = cohort re-formation (SURVEY §7 hard-part 1): any member dying
 makes the coordination service fail the others; the whole cohort exits and
 the process manager relaunches it; the new world restores from the latest
-checkpoint and re-leases at the task boundary. SIGTERM therefore exits
-immediately (EX_TEMPFAIL) instead of draining — a drain would deadlock
-followers blocked on the next broadcast.
+checkpoint and re-leases at the task boundary.
+
+SIGTERM (planned preemption): a FOLLOWER exits immediately (EX_TEMPFAIL) —
+it cannot drain, because the leader would keep broadcasting control vectors
+it no longer answers. The LEADER, however, drains collectively: it finishes
+the in-flight task, then broadcasts OP_ABORT|FLAG_CHECKPOINT so every
+process joins one final collective save before exiting EX_TEMPFAIL — the
+relaunched cohort restores at the pre-kill step, so a planned preemption
+redoes at most the records of one partially-reported task instead of
+`steps_per_dispatch x checkpoint_steps` worth of work (see
+`request_preempt`).
 """
 
 from __future__ import annotations
@@ -84,6 +92,7 @@ class CohortWorker:
         self._shutdown = threading.Event()
         self._job_done = False
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
+        self._preempt = False         # leader: SIGTERM drain requested
         # Plain-int mirror of state.model_version for the heartbeat thread:
         # int(state.step) blocks on the in-flight donated computation (see
         # worker.py's identically-named field), which would stall heartbeats
@@ -241,8 +250,29 @@ class CohortWorker:
                 logger.warning("cohort heartbeat failed: %s", e)
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
+    def request_preempt(self) -> bool:
+        """Leader SIGTERM hook (signal-handler safe: sets a flag, no I/O).
+        Returns True when this process can drain the cohort — the next
+        control vector becomes OP_ABORT|FLAG_CHECKPOINT, a COLLECTIVE save
+        every process joins before exiting EX_TEMPFAIL. Returns False on
+        followers (caller should exit immediately; see module docstring).
+        The in-flight task completes first, so the drain window is bounded
+        by one task — within k8s's default 30 s grace for the task sizes
+        the dispatcher hands out, and a lost race just degrades to the
+        old relaunch-and-restore path."""
+        if not self.ctx.is_leader:
+            return False
+        self._preempt = True
+        return True
+
     def _lease_control(self) -> List[int]:
         """Leader: turn the next master response into a control vector."""
+        if self._preempt and not self._shutdown.is_set():
+            logger.info("leader preempted: draining cohort via collective "
+                        "checkpoint")
+            ctrl = [OP_ABORT] + [0] * (CTRL_LEN - 1)
+            ctrl[6] = FLAG_CHECKPOINT
+            return ctrl
         if self._shutdown.is_set():
             return [OP_DONE if self._job_done else OP_ABORT] + [0] * (CTRL_LEN - 1)
         try:
@@ -501,6 +531,24 @@ class CohortWorker:
 
     # ------------------------------------------------------------------ #
 
+    def _install_sigterm_drain(self) -> None:
+        """(Re-)install the preemption handler AFTER world formation:
+        `jax.distributed.initialize` registers its own C++ SIGTERM handler
+        (xla preemption_notifier), silently replacing anything the
+        entrypoint installed earlier — so the drain handler must be
+        installed here to win. No-op off the main thread."""
+        import signal
+        import sys as _sys
+
+        def _on_sigterm(*_):
+            if not self.request_preempt():
+                _sys.exit(ExitCode.COHORT_EVICTED)
+
+        try:
+            signal.signal(signal.SIGTERM, _on_sigterm)
+        except ValueError:
+            pass
+
     def run(self) -> int:
         try:
             self.ctx.initialize()
@@ -511,6 +559,7 @@ class CohortWorker:
                 self.ctx.num_processes,
             )
             return ExitCode.WORLD_FORM_FAILED
+        self._install_sigterm_drain()
         try:
             self._build()
             if self.ctx.is_leader:
@@ -534,6 +583,17 @@ class CohortWorker:
                     self._run_task(ctrl)
                     continue
                 if op in (OP_DONE, OP_ABORT):
+                    if op == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT:
+                        # preemption drain: one final collective save so the
+                        # relaunched cohort resumes at the pre-kill step
+                        mngr = self._checkpoint_manager()
+                        if mngr is not None and self._state is not None:
+                            mngr.save(self._state, wait=True)
+                            self._last_ckpt_step = self._state.model_version
+                            logger.info(
+                                "preemption checkpoint saved at step %d",
+                                self._last_ckpt_step,
+                            )
                     if op == OP_DONE:
                         self._export_final_model()
                     break
@@ -565,5 +625,20 @@ class CohortWorker:
 
 
 def run_cohort(cfg: JobConfig) -> int:
+    """Build a CohortWorker with full SIGTERM wiring and run it: before
+    world formation the handler is a plain EX_TEMPFAIL exit (nothing to
+    drain yet); run() upgrades it to the leader drain after
+    `jax.distributed.initialize` (which would otherwise clobber it — see
+    `_install_sigterm_drain`). The one cohort entrypoint: anything that
+    constructs CohortWorker directly gets no pre-formation handler."""
+    import signal
+    import sys
+
     worker = CohortWorker(cfg)
+    try:
+        signal.signal(
+            signal.SIGTERM, lambda *_: sys.exit(ExitCode.COHORT_EVICTED)
+        )
+    except ValueError:
+        pass  # not the main thread (tests driving run_cohort in-process)
     return worker.run()

@@ -20,13 +20,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     # EDL_PROCESS_ID marks a cohort member even when dynamic resizing has
     # shrunk the world to 1 process (cfg.num_processes is the ORIGINAL size)
     if cfg.num_processes > 1 or "EDL_PROCESS_ID" in os.environ:
-        # SPMD cohort member: no drain on SIGTERM — a draining leader would
-        # deadlock followers blocked on the next control broadcast; exit
-        # EX_TEMPFAIL so the manager relaunches the whole cohort, which
-        # restores from the last checkpoint (worker/cohort.py).
+        # SPMD cohort member. SIGTERM: the leader drains collectively
+        # (finish the in-flight task, broadcast OP_ABORT|FLAG_CHECKPOINT,
+        # every process joins one final save, exit EX_TEMPFAIL); a follower
+        # cannot drain — it exits EX_TEMPFAIL immediately and the manager
+        # relaunches the cohort from the last checkpoint. All the signal
+        # wiring lives in run_cohort/CohortWorker (worker/cohort.py).
         from elasticdl_tpu.worker.cohort import run_cohort
 
-        signal.signal(signal.SIGTERM, lambda *_: sys.exit(75))
         return run_cohort(cfg)
     worker = Worker(cfg)
     # k8s preemption delivers SIGTERM with a grace period; drain + checkpoint

@@ -210,6 +210,44 @@ def test_cohort_member_kill_relaunches_and_resumes(tmp_path):
     assert "cohort resumed from checkpoint at step" in log, log[-3000:]
 
 
+def test_cohort_leader_sigterm_drains_via_checkpoint(tmp_path):
+    """Planned preemption (SIGTERM to the LEADER): instead of dying with
+    work since the last interval checkpoint lost, the leader broadcasts
+    OP_ABORT|FLAG_CHECKPOINT — a collective save every process joins — and
+    the relaunched cohort resumes at exactly the pre-kill step. Interval
+    checkpoints are disabled (checkpoint_steps=0) so the ONLY checkpoint on
+    disk is the drain's: resuming from it proves the drain worked."""
+    import re
+
+    cfg = job_config(
+        tmp_path,
+        training_data="synthetic://criteo?n=8192&shards=8",
+        records_per_task=1024,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=0,   # no interval saves: drain is the only source
+    )
+
+    def sigterm_leader(master, manager):
+        if master.dispatcher.counts()["finished_training"] < 2:
+            return False
+        wp = manager._procs.get(0)
+        if wp is None or wp.proc.poll() is not None:
+            return False
+        wp.proc.terminate()   # SIGTERM: the k8s-preemption shape
+        return True
+
+    counts = run_job(cfg, tmp_path, mid_job=sigterm_leader)
+    assert counts["finished_training"] == 8
+    assert counts["failed_permanently"] == 0
+    log = all_logs(tmp_path)
+    assert "leader preempted: draining cohort via collective checkpoint" in log
+    saved = re.search(r"preemption checkpoint saved at step (\d+)", log)
+    resumed = re.search(r"cohort resumed from checkpoint at step (\d+)", log)
+    assert saved and resumed, log[-3000:]
+    # the restored step IS the pre-kill step: nothing trained was redone
+    assert resumed.group(1) == saved.group(1), (saved.group(), resumed.group())
+
+
 def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
     """Dynamic world resizing, scale-in: a member dies with the relaunch
     budget already spent — instead of stalling/failing, the cohort re-forms
