@@ -326,6 +326,12 @@ class ODPSDataReader(AbstractDataReader):
     env like the reference: ODPS_PROJECT_NAME / ODPS_ACCESS_ID /
     ODPS_ACCESS_KEY / ODPS_ENDPOINT. Records are yielded as the reader's row
     tuples encoded CSV-style, keeping the dataset_fn contract byte-oriented.
+
+    Verification status: exercised only against a MOCKED pyodps
+    (tests/test_data.py) — this sandbox has no MaxCompute credentials, so
+    the reader has never run against a live table. The mock mirrors the
+    open_reader/tunnel API surface, but treat the first real-table run as
+    unproven territory and validate row counts before trusting a job.
     """
 
     ENV_VARS = (

@@ -1,0 +1,150 @@
+"""Cluster-gated k8s integration test: the REAL KubectlApi against a REAL
+cluster (SURVEY §4 — the reference's CI ran actual minikube jobs; the unit
+suite's scripted-watch tests can't prove kubectl flag/stream compatibility).
+
+Skipped unless `kubectl` is on PATH and can reach a cluster within 10 s —
+i.e. it runs on a developer machine with minikube/kind/a test cluster and is
+skipped (not absent) in sandboxes without one. The worker pod's command is
+patched to a plain `sleep` (EDL_K8S_TEST_IMAGE, default busybox:stable): the
+subject under test is the manager's create -> watch -> kill -> watch-driven
+relaunch loop, not worker training.
+"""
+
+import os
+import shutil
+import subprocess
+import time
+import uuid
+
+import pytest
+
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.common.constants import PodStatus
+from elasticdl_tpu.master.k8s_instance_manager import K8sInstanceManager
+
+NAMESPACE = os.environ.get("EDL_K8S_TEST_NAMESPACE", "default")
+IMAGE = os.environ.get("EDL_K8S_TEST_IMAGE", "busybox:stable")
+
+
+_PROBE_CACHE = []
+
+
+def _cluster_reason():
+    """Skip reason, or '' when a cluster is reachable. Evaluated lazily at
+    test RUNTIME (not collection — the kubectl probe can take the full 10 s
+    request timeout on a machine with kubectl but no cluster) and cached."""
+    if _PROBE_CACHE:
+        return _PROBE_CACHE[0]
+    if shutil.which("kubectl") is None:
+        reason = "kubectl not on PATH"
+    else:
+        try:
+            proc = subprocess.run(
+                ["kubectl", "get", "namespaces", "--request-timeout=10s"],
+                capture_output=True, timeout=20,
+            )
+            reason = "" if proc.returncode == 0 else (
+                "no reachable cluster: "
+                + proc.stderr.decode(errors="replace").strip()[-200:]
+            )
+        except Exception as e:
+            reason = f"kubectl probe failed: {e}"
+    _PROBE_CACHE.append(reason)
+    return reason
+
+
+@pytest.fixture()
+def k8s_cluster():
+    reason = _cluster_reason()
+    if reason:
+        pytest.skip(reason)
+
+
+def _sleep_pod(cfg, worker_id, pod_name=""):
+    from elasticdl_tpu.client.k8s import JOB_LABEL
+
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name or f"{cfg.job_name}-worker-{worker_id}",
+            "namespace": cfg.namespace,
+            "labels": {
+                JOB_LABEL: cfg.job_name,
+                "app": "elasticdl-tpu",
+                "role": "worker",
+            },
+        },
+        "spec": {
+            "restartPolicy": "Never",
+            "containers": [{
+                "name": "worker",
+                "image": IMAGE,
+                "command": ["sh", "-c", "sleep 3600"],
+            }],
+        },
+    }
+
+
+def _wait_for(cond, timeout_s):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return True
+        time.sleep(0.5)
+    return False
+
+
+def test_pod_kill_drives_watch_relaunch(monkeypatch, k8s_cluster):
+    """Create a real pod, kill it with an out-of-band `kubectl delete`, and
+    assert the manager's watch stream (not any timeout) drives a
+    generation-suffixed relaunch that reaches Running again."""
+    import elasticdl_tpu.client.k8s as k8s_client
+
+    monkeypatch.setattr(k8s_client, "render_worker_pod", _sleep_pod)
+    cfg = JobConfig(
+        job_name=f"edl-it-{uuid.uuid4().hex[:8]}",
+        model_def="mnist.mnist_cnn.custom_model",
+        num_workers=1,
+        relaunch_max=2,
+        image_name=IMAGE,
+        namespace=NAMESPACE,
+        job_type="evaluation_only",
+    )
+    mgr = K8sInstanceManager(cfg)
+    try:
+        mgr.start_workers()
+        # image pulls on a cold cluster can take a while
+        assert _wait_for(
+            lambda: mgr.statuses().get(0) == PodStatus.RUNNING, 180
+        ), f"gen-0 pod never reached Running: {mgr.statuses()}"
+
+        pod0 = f"{cfg.job_name}-worker-0-g0"
+        subprocess.run(
+            ["kubectl", "-n", NAMESPACE, "delete", "pod", pod0,
+             "--wait=false", "--request-timeout=30s"],
+            check=True, capture_output=True, timeout=60,
+        )
+
+        # watch-driven: DELETED event -> _on_pod_death -> relaunch as -g1
+        assert _wait_for(
+            lambda: mgr.statuses().get(0) == PodStatus.RUNNING
+            and mgr._gen.get(0) == 1,
+            180,
+        ), f"relaunch never reached Running: {mgr.statuses()}, gen={mgr._gen}"
+
+        get = subprocess.run(
+            ["kubectl", "-n", NAMESPACE, "get", "pod",
+             f"{cfg.job_name}-worker-0-g1", "-o", "jsonpath={.status.phase}",
+             "--request-timeout=30s"],
+            capture_output=True, timeout=60,
+        )
+        assert get.returncode == 0 and get.stdout.decode() == "Running"
+    finally:
+        mgr.stop()
+        subprocess.run(
+            ["kubectl", "-n", NAMESPACE, "delete", "pods", "-l",
+             f"{k8s_client.JOB_LABEL}={cfg.job_name}", "--wait=false",
+             "--request-timeout=30s"],
+            capture_output=True, timeout=60,
+        )
