@@ -136,6 +136,11 @@ class JobConfig:
     relaunch_max: int = 3               # reference: --relaunch_pod_max_num
     task_timeout_s: float = 600.0
     worker_heartbeat_s: float = 10.0
+    # No successful master RPC for this long -> the worker assumes the
+    # master is permanently gone and exits EX_TEMPFAIL (a live instance
+    # manager relaunches it; a truly orphaned worker frees its resources
+    # instead of spinning on a dead address forever). 0 disables.
+    master_unreachable_timeout_s: float = 300.0
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2

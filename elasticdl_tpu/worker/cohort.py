@@ -93,6 +93,8 @@ class CohortWorker:
         self._job_done = False
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
         self._preempt = False         # leader: SIGTERM drain requested
+        self._last_master_ok = time.monotonic()  # leader: last successful RPC
+        self._master_lost = False
         # Plain-int mirror of state.model_version for the heartbeat thread:
         # int(state.step) blocks on the in-flight donated computation (see
         # worker.py's identically-named field), which would stall heartbeats
@@ -216,11 +218,32 @@ class CohortWorker:
             timeout=30,
         )
         self.worker_id = resp.worker_id
+        self._last_master_ok = time.monotonic()
         logger.info(
             "cohort leader registered as worker %d (%d processes, %d devices)",
             self.worker_id, self.ctx.num_processes,
             len(__import__("jax").devices()),
         )
+
+    def _master_unreachable(self) -> bool:
+        """Leader-only, from RPC-failure paths: True (and flips the
+        shutdown that turns the next control vector into OP_ABORT, taking
+        the WHOLE cohort down EX_TEMPFAIL) when no master RPC has succeeded
+        for master_unreachable_timeout_s. Without this a cohort whose
+        master's process tree died keeps spinning on a dead address forever
+        — observed as orphan worker processes surviving for hours."""
+        limit = self.cfg.master_unreachable_timeout_s
+        if limit <= 0 or time.monotonic() - self._last_master_ok < limit:
+            return False
+        if not self._master_lost:
+            self._master_lost = True
+            logger.error(
+                "no successful master RPC for %.0fs (limit %.0fs): master "
+                "presumed gone, aborting cohort (EX_TEMPFAIL)",
+                time.monotonic() - self._last_master_ok, limit,
+            )
+            self._shutdown.set()
+        return True
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -246,8 +269,10 @@ class CohortWorker:
                     # rides the next control vector (lr_bits) so every
                     # process applies it at the same task boundary
                     self._pushed_lr = resp.learning_rate
+                self._last_master_ok = time.monotonic()
             except Exception as e:
                 logger.warning("cohort heartbeat failed: %s", e)
+                self._master_unreachable()
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
     def request_preempt(self) -> bool:
@@ -279,8 +304,17 @@ class CohortWorker:
             resp = self._stub.GetTask(
                 pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
             )
+            self._last_master_ok = time.monotonic()
         except Exception as e:
             logger.warning("cohort get_task failed: %s", e)
+            if self._master_unreachable():
+                # carry FLAG_CHECKPOINT: we sit at a clean task boundary and
+                # the collective save needs no master, so a partitioned-but-
+                # relaunched cohort resumes here instead of redoing up to
+                # checkpoint_steps of work (same path as the SIGTERM drain)
+                ctrl = [OP_ABORT] + [0] * (CTRL_LEN - 1)
+                ctrl[6] = FLAG_CHECKPOINT
+                return ctrl
             return [OP_NOOP] + [0] * (CTRL_LEN - 1)
         if resp.job_done:
             self._job_done = True

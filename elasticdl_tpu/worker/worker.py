@@ -63,6 +63,8 @@ class Worker:
         self._model_version = 0
         self._profile_state = "idle"  # idle -> active -> done (jax.profiler)
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
+        self._last_master_ok = time.monotonic()  # last successful master RPC
+        self._master_lost = False     # unreachable past the config timeout
 
     # ------------------------------------------------------------------ #
     # setup
@@ -83,10 +85,32 @@ class Worker:
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
         self._last_known_workers = resp.num_workers
+        self._last_master_ok = time.monotonic()
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
         )
+
+    def _master_unreachable(self) -> bool:
+        """Called from RPC-failure paths: True (once; also flips
+        _master_lost and _shutdown) when no master RPC has succeeded for
+        master_unreachable_timeout_s — the master is permanently gone, and
+        retrying forever would leave an orphan process spinning on a dead
+        address (observed: cohort members surviving hours after their
+        master's process tree was killed). Exit EX_TEMPFAIL instead: a live
+        manager relaunches us; an orphan frees its chip and memory."""
+        limit = self.cfg.master_unreachable_timeout_s
+        if limit <= 0 or time.monotonic() - self._last_master_ok < limit:
+            return False
+        if not self._master_lost:
+            self._master_lost = True
+            logger.error(
+                "no successful master RPC for %.0fs (limit %.0fs): master "
+                "presumed gone, exiting EX_TEMPFAIL",
+                time.monotonic() - self._last_master_ok, limit,
+            )
+            self._shutdown.set()
+        return True
 
     def _build_trainer(self) -> None:
         from elasticdl_tpu.parallel.mesh import build_job_mesh, data_axis
@@ -266,8 +290,10 @@ class Worker:
                     # set above — the push is job-global and wins
                     self._pushed_lr = resp.learning_rate
                     self._pending_lr = resp.learning_rate
-            except Exception as e:  # master gone → stop
+                self._last_master_ok = time.monotonic()
+            except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
+                self._master_unreachable()
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
     def _on_membership_change(self, new_version: int, num_workers: int = 0) -> None:
@@ -567,8 +593,11 @@ class Worker:
                 resp = self._stub.GetTask(
                     pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
                 )
+                self._last_master_ok = time.monotonic()
             except Exception as e:
                 logger.warning("get_task failed: %s; retrying", e)
+                if self._master_unreachable():
+                    break
                 time.sleep(2)
                 continue
             if resp.job_done:
@@ -677,8 +706,9 @@ class Worker:
             pass
         # A preempted worker exits non-zero (EX_TEMPFAIL) so the instance
         # manager relaunches it and recovers its lease immediately; clean
-        # job-done exits return 0.
-        return 75 if self._preempted else 0
+        # job-done exits return 0. A lost master is also EX_TEMPFAIL: under
+        # a live manager that means relaunch; orphaned, it frees the process.
+        return 75 if (self._preempted or self._master_lost) else 0
 
     def _export_final_model(self) -> None:
         """Job-end serving export (reference: model_handler → SavedModel at

@@ -248,6 +248,40 @@ def test_cohort_leader_sigterm_drains_via_checkpoint(tmp_path):
     assert resumed.group(1) == saved.group(1), (saved.group(), resumed.group())
 
 
+def test_cohort_lease_aborts_when_master_lost(tmp_path):
+    """Leader unit test for orphan cleanup: once no master RPC has
+    succeeded for master_unreachable_timeout_s, the next lease becomes
+    OP_ABORT (taking the whole cohort down EX_TEMPFAIL) instead of NOOP
+    retries forever — a cohort whose master's process tree died must not
+    survive it indefinitely."""
+    from elasticdl_tpu.parallel.elastic import CohortContext
+    from elasticdl_tpu.worker.cohort import (
+        FLAG_CHECKPOINT,
+        OP_ABORT,
+        OP_NOOP,
+        CohortWorker,
+    )
+
+    cfg = job_config(tmp_path, master_unreachable_timeout_s=5.0)
+
+    class DeadStub:
+        def GetTask(self, *a, **k):
+            raise ConnectionError("connection refused")
+
+    w = CohortWorker(cfg, ctx=CohortContext("localhost:1", 2, 0))
+    w._stub = DeadStub()
+    # master answered recently: failures are still transient -> NOOP
+    w._last_master_ok = time.monotonic()
+    assert w._lease_control()[0] == OP_NOOP
+    assert not w._shutdown.is_set()
+    # silent past the limit -> ABORT with a final collective checkpoint
+    # (clean task boundary, the save needs no master), shutdown latched
+    w._last_master_ok = time.monotonic() - 6.0
+    ctrl = w._lease_control()
+    assert ctrl[0] == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT
+    assert w._shutdown.is_set() and w._master_lost
+
+
 def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
     """Dynamic world resizing, scale-in: a member dies with the relaunch
     budget already spent — instead of stalling/failing, the cohort re-forms

@@ -191,3 +191,44 @@ def test_sigterm_preemption_checkpoints_and_resumes(tmp_path):
     finally:
         master.shutdown(grace_s=2)
         manager.stop()
+
+
+def test_worker_exits_when_master_vanishes(tmp_path):
+    """Orphan cleanup: the master's process dies WITHOUT a graceful shutdown
+    heartbeat (grpc server stopped cold, request_shutdown never sent). The
+    worker must not spin on the dead address forever — after
+    master_unreachable_timeout_s with no successful RPC it exits
+    EX_TEMPFAIL. (Observed pre-fix: worker processes surviving hours after
+    their master's tree was SIGKILLed.)"""
+    import threading
+
+    from elasticdl_tpu.worker.worker import Worker
+
+    cfg = job_config(
+        tmp_path,
+        worker_heartbeat_s=0.3,
+        master_unreachable_timeout_s=4.0,
+    )
+    master = Master(cfg)
+    master.start()
+    worker = Worker(cfg)
+    rc = {}
+    t = threading.Thread(target=lambda: rc.update(v=worker.run()), daemon=True)
+    try:
+        t.start()
+        deadline = time.time() + 120
+        while (
+            time.time() < deadline
+            and master.dispatcher.counts()["finished_training"] < 1
+        ):
+            master.membership.reap()
+            master.dispatcher.poke()
+            time.sleep(0.1)
+        assert master.dispatcher.counts()["finished_training"] >= 1
+        # cold stop: no shutdown flag ever reaches the worker
+        master.server.stop(grace=0)
+        t.join(timeout=90)
+        assert not t.is_alive(), "worker did not exit after master vanished"
+        assert rc["v"] == 75, rc
+    finally:
+        master.server.stop(grace=0)
