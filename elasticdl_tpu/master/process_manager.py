@@ -260,6 +260,10 @@ class ProcessManager:
                         wid, code, wp.relaunches + 1, self.cfg.relaunch_max,
                     )
                     with self._lock:
+                        if self._stop.is_set():
+                            # stop() may already have snapshotted _procs for
+                            # its kill loop: a relaunch now would leak
+                            continue
                         self._procs[wid] = self._spawn(
                             wid, relaunches=wp.relaunches + 1
                         )
@@ -293,6 +297,14 @@ class ProcessManager:
         LR unchanged (strong scaling — only per-device slice sizes move)."""
         t0 = time.time()
         with self._lock:
+            if self._stop.is_set():
+                # stop() raced us between teardown and re-form: spawning a
+                # fresh generation now would outlive stop()'s kill loop (it
+                # only waits grace_s for the watcher) and leak workers that
+                # run forever — observed as orphan processes hours after a
+                # test's manager.stop()
+                logger.info("re-formation skipped: manager stopping")
+                return
             self._procs.clear()
             self._world_version += 1
             if new_size != old_size:

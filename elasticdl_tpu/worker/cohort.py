@@ -299,7 +299,13 @@ class CohortWorker:
             ctrl[6] = FLAG_CHECKPOINT
             return ctrl
         if self._shutdown.is_set():
-            return [OP_DONE if self._job_done else OP_ABORT] + [0] * (CTRL_LEN - 1)
+            ctrl = [OP_DONE if self._job_done else OP_ABORT] + [0] * (CTRL_LEN - 1)
+            if self._master_lost:
+                # the heartbeat thread crossed the unreachable limit while a
+                # task was running: same final-collective-save semantics as
+                # the GetTask-path abort below (the save needs no master)
+                ctrl[6] = FLAG_CHECKPOINT
+            return ctrl
         try:
             resp = self._stub.GetTask(
                 pb.GetTaskRequest(worker_id=self.worker_id), timeout=30

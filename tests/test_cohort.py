@@ -227,6 +227,8 @@ def test_cohort_leader_sigterm_drains_via_checkpoint(tmp_path):
         checkpoint_steps=0,   # no interval saves: drain is the only source
     )
 
+    lat = {}  # drain-latency instrumentation (BASELINE.md round log)
+
     def sigterm_leader(master, manager):
         if master.dispatcher.counts()["finished_training"] < 2:
             return False
@@ -234,9 +236,15 @@ def test_cohort_leader_sigterm_drains_via_checkpoint(tmp_path):
         if wp is None or wp.proc.poll() is not None:
             return False
         wp.proc.terminate()   # SIGTERM: the k8s-preemption shape
+        lat["sigterm_t"] = time.time()
         return True
 
-    counts = run_job(cfg, tmp_path, mid_job=sigterm_leader)
+    def observe(master, manager):
+        if "sigterm_t" in lat and "reform_t" not in lat and \
+                manager.reformation_log:
+            lat["reform_t"] = manager.reformation_log[0][0]
+
+    counts = run_job(cfg, tmp_path, mid_job=sigterm_leader, observer=observe)
     assert counts["finished_training"] == 8
     assert counts["failed_permanently"] == 0
     log = all_logs(tmp_path)
@@ -246,6 +254,9 @@ def test_cohort_leader_sigterm_drains_via_checkpoint(tmp_path):
     assert saved and resumed, log[-3000:]
     # the restored step IS the pre-kill step: nothing trained was redone
     assert resumed.group(1) == saved.group(1), (saved.group(), resumed.group())
+    drain_s = lat.get("reform_t", time.time()) - lat["sigterm_t"]
+    print(f"\n[preemption-drain] SIGTERM -> drained+torn-down {drain_s:.2f}s "
+          f"(bounded by the in-flight task + collective save)")
 
 
 def test_cohort_lease_aborts_when_master_lost(tmp_path):
@@ -280,6 +291,10 @@ def test_cohort_lease_aborts_when_master_lost(tmp_path):
     ctrl = w._lease_control()
     assert ctrl[0] == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT
     assert w._shutdown.is_set() and w._master_lost
+    # the heartbeat thread can be the one that crosses the limit (mid-task);
+    # the ensuing shutdown-branch lease must carry the same checkpoint flag
+    ctrl = w._lease_control()
+    assert ctrl[0] == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT
 
 
 def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
