@@ -141,6 +141,14 @@ class JobConfig:
     # manager relaunches it; a truly orphaned worker frees its resources
     # instead of spinning on a dead address forever). 0 disables.
     master_unreachable_timeout_s: float = 300.0
+    # Persistent XLA compilation cache (common/runtime.py): relaunched
+    # workers deserialize the previous generation's executables instead of
+    # paying the 20-40 s TPU recompile on every elastic recovery. Point it
+    # at storage shared across relaunches (e.g. next to checkpoint_dir).
+    compilation_cache_dir: str = ""
+    # <0 keeps JAX's default floor (~1 s: only expensive programs persist);
+    # >=0 overrides it (tests use 0 so test-sized programs cache too).
+    compilation_cache_min_compile_s: float = -1.0
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2

@@ -1,0 +1,41 @@
+"""Per-process JAX runtime setup shared by both worker flavors.
+
+Reference analog: none — upstream's TF2 runtime had no compile step to
+cache. Here it matters doubly: (1) first XLA compilation of a real model on
+TPU is 20-40 s, and (2) elastic recovery RELAUNCHES worker processes
+(process_manager/k8s_instance_manager), so without a persistent cache every
+preemption pays the full recompile on top of restore — measured: cohort
+kill -> first-task-at-new-size was ~10.6 s on the CPU test mesh, most of it
+world re-boot + compile (BASELINE.md round-3 log). With
+`--compilation_cache_dir` the relaunched generation deserializes the
+previous generation's executables instead.
+"""
+
+from __future__ import annotations
+
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+
+def configure_jax_runtime(cfg) -> None:
+    """Apply config-driven JAX process settings. Call before building
+    trainers/meshes (idempotent; safe to call from every entrypoint)."""
+    if cfg.compilation_cache_dir:
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir", cfg.compilation_cache_dir)
+        if cfg.compilation_cache_min_compile_s >= 0:
+            # explicit floor override (tests set 0 so even test-sized
+            # programs cache); production keeps JAX's defaults — writing
+            # every sub-second jit to shared storage is churn, not savings
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                float(cfg.compilation_cache_min_compile_s),
+            )
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        logger.info(
+            "persistent XLA compilation cache at %s",
+            cfg.compilation_cache_dir,
+        )

@@ -111,9 +111,11 @@ class CohortWorker:
     def _build(self) -> None:
         import jax
 
+        from elasticdl_tpu.common.runtime import configure_jax_runtime
         from elasticdl_tpu.parallel.mesh import build_job_mesh
         from elasticdl_tpu.training.trainer import Trainer
 
+        configure_jax_runtime(self.cfg)
         self._spec = ModelSpec.from_config(self.cfg)
         self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(

@@ -113,10 +113,12 @@ class Worker:
         return True
 
     def _build_trainer(self) -> None:
+        from elasticdl_tpu.common.runtime import configure_jax_runtime
         from elasticdl_tpu.parallel.mesh import build_job_mesh, data_axis
         from elasticdl_tpu.training.trainer import Trainer
         import jax
 
+        configure_jax_runtime(self.cfg)
         self._spec = ModelSpec.from_config(self.cfg)
         if self._mesh is None:
             self._mesh = build_job_mesh(self.cfg, jax.devices())

@@ -232,3 +232,67 @@ def test_worker_exits_when_master_vanishes(tmp_path):
         assert rc["v"] == 75, rc
     finally:
         master.server.stop(grace=0)
+
+
+def test_relaunch_reuses_compilation_cache(tmp_path):
+    """--compilation_cache_dir: the killed worker's relaunch deserializes
+    the previous generation's XLA executables instead of recompiling (on a
+    real TPU that is 20-40 s off every elastic recovery). The HIT is what's
+    asserted: the entry set is snapshotted at kill time (generation 1 has
+    compiled its whole train path by then) and must NOT materially grow —
+    a change that makes cache keys generation-dependent (world version or a
+    per-launch seed leaking into the compilation key) would near-double it
+    and is the exact regression this feature exists to prevent."""
+    cache_dir = tmp_path / "xla-cache"
+    cfg = job_config(
+        tmp_path,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=4,
+        compilation_cache_dir=str(cache_dir),
+        compilation_cache_min_compile_s=0.0,   # test-sized programs cache
+    )
+    master = Master(cfg)
+    manager = ProcessManager(
+        cfg,
+        membership=master.membership,
+        extra_env=HERMETIC_ENV,
+        log_dir=str(tmp_path / "logs"),
+        job_finished_fn=master.dispatcher.finished,
+    )
+    master.start()
+    manager.start_workers()
+    entries_at_kill = None
+    deadline = time.time() + 420
+    try:
+        while not master.dispatcher.finished() and time.time() < deadline:
+            master.membership.reap()
+            master.dispatcher.poke()
+            counts = master.dispatcher.counts()
+            if entries_at_kill is None and counts["finished_training"] >= 2:
+                entries_at_kill = set(os.listdir(cache_dir))
+                assert manager.kill_worker(0, relaunch=True)
+            time.sleep(0.2)
+        assert master.dispatcher.finished(), worker_log(tmp_path)[-3000:]
+        assert entries_at_kill, "cache empty at kill: nothing compiled?"
+    finally:
+        master.shutdown(grace_s=2)
+        manager.stop()
+    log = worker_log(tmp_path)
+    assert "persistent XLA compilation cache" in log
+    final = set(os.listdir(cache_dir))
+    # The relaunched generation legitimately compiles utility programs the
+    # first never ran (orbax restore-path slices etc.) — the program that
+    # matters is the train step (`step_fn`, the 20-40 s compile on real
+    # TPU). Entry names are `jit_<name>-<key hash>-cache`: a SECOND
+    # jit_step_fn entry after the relaunch means the cache key became
+    # generation-dependent and the relaunch recompiled — the exact
+    # regression this feature exists to prevent.
+    def step_entries(entries):
+        return {e for e in entries if e.startswith("jit_step_fn-")}
+
+    assert step_entries(entries_at_kill), (
+        "no train-step cache entry at kill time", entries_at_kill)
+    assert step_entries(final) == step_entries(entries_at_kill), (
+        "relaunch produced a new train-step cache key",
+        step_entries(final) - step_entries(entries_at_kill),
+    )
