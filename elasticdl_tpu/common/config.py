@@ -159,6 +159,11 @@ class JobConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"
     remat: bool = False            # jax.checkpoint the forward pass
+    # named jax.checkpoint policy (implies remat): "dots" keeps MXU outputs
+    # and recomputes elementwise, "dots_no_batch" also drops attention
+    # scores, "nothing" recomputes everything (min HBM). "" = full remat
+    # when --remat is set. See training/trainer.resolve_remat_policy.
+    remat_policy: str = ""
 
     # --- addresses / runtime ---
     master_addr: str = f"localhost:{DEFAULT_MASTER_PORT}"
@@ -182,6 +187,12 @@ class JobConfig:
     def validate(self) -> None:
         if not self.model_def:
             raise ValueError("model_def is required (e.g. mnist.mnist_cnn.custom_model)")
+        if self.remat_policy:
+            # fail at submit time, not after TPUs are allocated and the
+            # first train step builds
+            from elasticdl_tpu.training.trainer import resolve_remat_policy
+
+            resolve_remat_policy(self.remat_policy)
         if self.minibatch_size <= 0:
             raise ValueError("minibatch_size must be positive")
         if self.num_workers <= 0:

@@ -78,6 +78,30 @@ def _masked_scalar_loss(loss_fn, labels, outputs, mask):
     return jnp.sum(value * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
+def resolve_remat_policy(name: str):
+    """Map a config-level policy name to a jax.checkpoint policy. "" (full
+    remat: save nothing the policy engine controls) returns None. The menu
+    is the standard HBM/FLOPs trade for long-context training on TPU:
+    `dots` keeps MXU outputs and recomputes the (cheap, VPU) elementwise
+    chain — the usual best trade; `dots_no_batch` additionally drops
+    batch-dim matmul outputs (attention scores) — bigger savings, more
+    recompute; `nothing` recomputes everything — minimum HBM."""
+    if not name:
+        return None
+    policies = {
+        "dots": jax.checkpoint_policies.dots_saveable,
+        "dots_no_batch":
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+    }
+    if name not in policies:
+        raise ValueError(
+            f"unknown remat policy {name!r}; choose from "
+            f"{sorted(policies)} or '' for full remat"
+        )
+    return policies[name]
+
+
 class Trainer:
     """Builds and runs the jitted train/eval/predict steps for one ModelSpec
     on one Mesh."""
@@ -87,11 +111,17 @@ class Trainer:
         spec: ModelSpec,
         mesh: Mesh,
         remat: bool = False,
+        remat_policy: str = "",
         seed: int = 0,
     ):
         self.spec = spec
         self.mesh = mesh
-        self.remat = remat
+        # a named policy implies remat on; "" + remat=True is full remat.
+        # Resolved HERE so a bad name fails at construction, not at the
+        # first train-step build after the job is already running.
+        self.remat = remat or bool(remat_policy)
+        self.remat_policy = remat_policy
+        self._resolved_remat_policy = resolve_remat_policy(remat_policy)
         self.seed = seed
         self.metrics: Dict[str, metrics_lib.Metric] = (
             dict(spec.eval_metrics_fn()) if spec.eval_metrics_fn else {}
@@ -171,6 +201,7 @@ class Trainer:
     def _raw_train_step(self):
         model, tx, loss_fn = self.spec.model, self.spec.optimizer, self.spec.loss
         remat = self.remat
+        remat_policy = self._resolved_remat_policy
 
         def step_fn(state: TrainState, batch):
             features, labels, mask = _split_batch(batch)
@@ -189,7 +220,7 @@ class Trainer:
                 )
 
             if remat:
-                forward = jax.checkpoint(forward)
+                forward = jax.checkpoint(forward, policy=remat_policy)
 
             def compute_loss(params):
                 variables = {"params": params, **state.extra_vars}

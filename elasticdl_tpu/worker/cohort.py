@@ -119,7 +119,7 @@ class CohortWorker:
         self._spec = ModelSpec.from_config(self.cfg)
         self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(
-            self._spec, self._mesh, remat=self.cfg.remat,
+            self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy,
             seed=self.cfg.shuffle_seed,
         )
 
