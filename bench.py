@@ -583,6 +583,26 @@ def _run_leg(leg, mesh, np):
     if leg == "census_wide_deep":
         return bench_config(mesh, np, "census.wide_deep", 4096,
                             _census_batches)
+    if leg == "xdeepfm":
+        # parity config #4b: DeepFM + CIN tower, same Criteo batch shape
+        def criteo_batches(np, batch):
+            out = []
+            for i in range(4):
+                r = np.random.RandomState(200 + i)
+                out.append({
+                    "features": {
+                        "dense": r.rand(batch, 13).astype(np.float32),
+                        "cat": r.randint(0, 1 << 30, (batch, 26)).astype(
+                            np.int32),
+                    },
+                    "labels": r.randint(0, 2, (batch,)).astype(np.int32),
+                })
+            return out
+
+        return bench_config(
+            mesh, np, "deepfm.xdeepfm", 4096, criteo_batches,
+            model_params={"field_vocab": FIELD_VOCAB},
+        )
     if leg == "embedding":
         return bench_embedding_modes(mesh, np)
     if leg == "time_to_auc":
@@ -619,9 +639,15 @@ def _run_leg(leg, mesh, np):
     raise SystemExit(f"unknown leg {leg!r}")
 
 
+# Ordered by evidence priority, not logical grouping: the global deadline
+# skips TRAILING legs when budget runs dry, so the legs that have never
+# appeared in a valid BENCH record (embedding scatter fix, flash speedup,
+# the time-to-AUC north-star miniature — round-3 verdict items 2/5) run
+# first, and resnet50 — whose killed staging+compile is what wedged the
+# tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "mnist_cnn", "cifar10_resnet20", "resnet50_imagenet",
-    "census_wide_deep", "embedding", "transformer_lm", "time_to_auc",
+    "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
+    "census_wide_deep", "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
