@@ -164,6 +164,12 @@ class JobConfig:
     # scores, "nothing" recomputes everything (min HBM). "" = full remat
     # when --remat is set. See training/trainer.resolve_remat_policy.
     remat_policy: str = ""
+    # Gradient accumulation: split each minibatch into K micro-batches and
+    # scan forward+backward holding one micro-batch of activations live —
+    # grads are EXACTLY the full-batch step's (masked-weighted), so K is a
+    # pure HBM knob for raising effective batch size. Must divide
+    # minibatch_size.
+    grad_accum_steps: int = 1
 
     # --- addresses / runtime ---
     master_addr: str = f"localhost:{DEFAULT_MASTER_PORT}"
@@ -193,6 +199,15 @@ class JobConfig:
             from elasticdl_tpu.training.trainer import resolve_remat_policy
 
             resolve_remat_policy(self.remat_policy)
+        if self.grad_accum_steps < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        if self.grad_accum_steps > 1 and (
+            self.minibatch_size % self.grad_accum_steps
+        ):
+            raise ValueError(
+                f"grad_accum_steps ({self.grad_accum_steps}) must divide "
+                f"minibatch_size ({self.minibatch_size})"
+            )
         if self.minibatch_size <= 0:
             raise ValueError("minibatch_size must be positive")
         if self.num_workers <= 0:

@@ -78,6 +78,76 @@ def _masked_scalar_loss(loss_fn, labels, outputs, mask):
     return jnp.sum(value * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
+def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
+                       step_rng, accum):
+    """Gradient accumulation: split the batch into `accum` micro-batches
+    along the leading dim, `lax.scan` forward+backward over them holding
+    ONE micro-batch of activations live at a time, and return grads exactly
+    equal to the full-batch step's (so K is a pure HBM knob, not a
+    semantics change).
+
+    Exactness: per-example (vector) losses accumulate masked SUM and count,
+    dividing once at the end — identical to the full batch's weighted mean
+    even with padded rows concentrated in one micro-batch. A user loss that
+    returns a SCALAR is assumed to be a mean over its micro-batch (true of
+    every zoo loss); micro-batches then weigh equally. BatchNorm-style
+    extra_vars thread through the scan (last micro-batch wins, matching K
+    sequential steps); dropout draws per-micro-batch folds of the step
+    rng."""
+
+    def to_micro(x):
+        b = x.shape[0]
+        if b % accum:
+            raise ValueError(
+                f"grad_accum={accum} must divide the batch size {b}")
+        # STRIDED split (row j*K+k -> micro k, slot j), NOT a contiguous
+        # reshape: the batch dim arrives sharded P('data') with each device
+        # holding a contiguous row block, and a contiguous split would put
+        # each micro-batch on only N/K devices — GSPMD then reshards the
+        # whole batch (all-to-all) every step. The strided mapping keeps
+        # every device's rows local in every micro-batch, and grads are
+        # masked-sum/divide-once weighted so the grouping is semantically
+        # irrelevant.
+        return x.reshape((b // accum, accum) + x.shape[1:]).swapaxes(0, 1)
+
+    # mask may be None: pytrees treat None as structure, so the 3-tuple
+    # shape survives the scan with m arriving as None
+    micro = jax.tree_util.tree_map(to_micro, (features, labels, mask))
+
+    def body(carry, mb):
+        g_acc, loss_acc, cnt_acc, vars_c, i = carry
+        f, l, m = mb
+        rng = jax.random.fold_in(step_rng, i)
+
+        def sum_loss(params):
+            variables = {"params": params, **vars_c}
+            outputs, new_vars = forward(variables, f, rng)
+            value = jnp.asarray(loss_fn(l, outputs))
+            if value.ndim == 0:
+                # pre-reduced scalar: weigh micro-batches equally
+                return value, (jnp.float32(1.0), new_vars)
+            v = value.reshape(-1).astype(jnp.float32)
+            mm = (jnp.asarray(m, jnp.float32).reshape(-1) if m is not None
+                  else jnp.ones_like(v))
+            return jnp.sum(v * mm), (jnp.sum(mm), new_vars)
+
+        (s, (cnt, new_vars)), g = jax.value_and_grad(
+            sum_loss, has_aux=True)(state.params)
+        g_acc = jax.tree_util.tree_map(jnp.add, g_acc, g)
+        return (g_acc, loss_acc + s, cnt_acc + cnt, new_vars, i + 1), None
+
+    zeros = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+    (g_sum, loss_sum, cnt, new_vars, _), _ = jax.lax.scan(
+        body,
+        (zeros, jnp.float32(0.0), jnp.float32(0.0), state.extra_vars,
+         jnp.int32(0)),
+        micro,
+    )
+    denom = jnp.maximum(cnt, 1.0)
+    grads = jax.tree_util.tree_map(lambda g: g / denom, g_sum)
+    return loss_sum / denom, new_vars, grads
+
+
 def resolve_remat_policy(name: str):
     """Map a config-level policy name to a jax.checkpoint policy. "" (full
     remat: save nothing the policy engine controls) returns None. The menu
@@ -112,6 +182,7 @@ class Trainer:
         mesh: Mesh,
         remat: bool = False,
         remat_policy: str = "",
+        grad_accum: int = 1,
         seed: int = 0,
     ):
         self.spec = spec
@@ -122,6 +193,9 @@ class Trainer:
         self.remat = remat or bool(remat_policy)
         self.remat_policy = remat_policy
         self._resolved_remat_policy = resolve_remat_policy(remat_policy)
+        if grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
+        self.grad_accum = int(grad_accum)
         self.seed = seed
         self.metrics: Dict[str, metrics_lib.Metric] = (
             dict(spec.eval_metrics_fn()) if spec.eval_metrics_fn else {}
@@ -202,6 +276,7 @@ class Trainer:
         model, tx, loss_fn = self.spec.model, self.spec.optimizer, self.spec.loss
         remat = self.remat
         remat_policy = self._resolved_remat_policy
+        accum = self.grad_accum
 
         def step_fn(state: TrainState, batch):
             features, labels, mask = _split_batch(batch)
@@ -227,9 +302,15 @@ class Trainer:
                 outputs, new_vars = forward(variables, features, step_rng)
                 return _masked_scalar_loss(loss_fn, labels, outputs, mask), new_vars
 
-            (loss_value, new_vars), grads = jax.value_and_grad(
-                compute_loss, has_aux=True
-            )(state.params)
+            if accum > 1:
+                loss_value, new_vars, grads = _accumulated_grads(
+                    forward, loss_fn, state, features, labels, mask,
+                    step_rng, accum,
+                )
+            else:
+                (loss_value, new_vars), grads = jax.value_and_grad(
+                    compute_loss, has_aux=True
+                )(state.params)
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = state.replace(

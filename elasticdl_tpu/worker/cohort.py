@@ -120,6 +120,7 @@ class CohortWorker:
         self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(
             self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy,
+            grad_accum=self.cfg.grad_accum_steps,
             seed=self.cfg.shuffle_seed,
         )
 

@@ -123,7 +123,8 @@ class Worker:
         if self._mesh is None:
             self._mesh = build_job_mesh(self.cfg, jax.devices())
         self._trainer = Trainer(
-            self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy, seed=self.cfg.shuffle_seed
+            self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy,
+            grad_accum=self.cfg.grad_accum_steps, seed=self.cfg.shuffle_seed
         )
 
     def _data_service(self, task_type: int) -> TaskDataService:

@@ -196,3 +196,57 @@ def test_remat_policies(mesh8):
     # remat is FLOPs-for-memory only: the first step's loss is unchanged
     assert nothing_loss == pytest.approx(base_loss, abs=1e-6)
     assert dots_loss == pytest.approx(base_loss, abs=1e-6)
+
+
+def test_grad_accum_matches_full_batch(mesh8):
+    """grad_accum=K is a pure HBM knob: one accumulated step over a batch
+    must produce the full-batch step's grads — including with a mask whose
+    padded rows all land in one micro-batch (the masked-sum / divide-once
+    weighting, not a mean-of-means). SGD + float32 so the param delta IS
+    the grad (-lr*g): the zoo default (adam + bf16 activations) normalizes
+    updates to ~lr, amplifying bf16 reduction-order noise into sign flips
+    on near-zero-grad entries, which would test numerics not semantics."""
+    import jax
+    import optax
+
+    from elasticdl_tpu.common.model_utils import load_module
+
+    mod, _ = load_module("model_zoo", "census.wide_deep.custom_model")
+    spec = ModelSpec(
+        model=mod.custom_model(compute_dtype="float32"),
+        loss=mod.loss,
+        optimizer=optax.sgd(0.1),
+        dataset_fn=None,
+        eval_metrics_fn=None,
+        module_name="census.wide_deep",
+    )
+    rng = np.random.RandomState(0)
+    mask = np.ones((32,), np.float32)
+    mask[24:] = 0.0   # all padding in the final micro-batch (K=4 x 8)
+    batch = {
+        "features": {
+            "dense": rng.rand(32, 5).astype(np.float32),
+            "cat": rng.randint(0, 400, (32, 9)).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, (32,)).astype(np.int32),
+        "mask": mask,
+    }
+
+    def one_step(accum):
+        t = Trainer(spec, mesh8, grad_accum=accum, seed=0)
+        state, logs = t.train_step(t.init_state(batch), batch)
+        return jax.device_get(state.params), float(logs["loss"])
+
+    p1, l1 = one_step(1)
+    p4, l4 = one_step(4)
+    assert l4 == pytest.approx(l1, rel=1e-5)
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat4 = jax.tree_util.tree_leaves(p4)
+    for a, b in zip(flat1, flat4):
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-6)
+
+    with pytest.raises(ValueError):
+        Trainer(spec, mesh8, grad_accum=0)
+    t3 = Trainer(spec, mesh8, grad_accum=5)   # 5 does not divide 32
+    with pytest.raises(ValueError):
+        t3.train_step(t3.init_state(batch), batch)
