@@ -118,3 +118,55 @@ def test_ring_attention_backward_moves_only_kv_blocks(mesh8):
     # permutes move single blocks; nothing gathers the full sequence
     assert biggest <= 2 * block_elems, (biggest, block_elems, sizes)
     assert biggest < full_seq_elems, (biggest, full_seq_elems, sizes)
+
+
+def test_grad_accum_adds_no_resharding_collectives(mesh8):
+    """grad_accum's STRIDED micro-batch split must keep each device's
+    P('data') rows local: the accumulated step may not introduce
+    all-to-all / extra gathers over the accum=1 step (a contiguous split
+    would put each micro-batch on a subset of devices and force GSPMD to
+    reshard the whole batch every step)."""
+    import optax
+
+    from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.parallel.mesh import build_mesh, shard_batch
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    mesh = build_mesh({"data": 8}, list(mesh8.devices.flat))
+    mod, _ = load_module("model_zoo", "census.wide_deep.custom_model")
+    spec = ModelSpec(
+        model=mod.custom_model(compute_dtype="float32"), loss=mod.loss,
+        optimizer=optax.sgd(0.1), dataset_fn=None, eval_metrics_fn=None,
+        module_name="census.wide_deep",
+    )
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "dense": rng.rand(32, 5).astype(np.float32),
+            "cat": rng.randint(0, 400, (32, 9)).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, (32,)).astype(np.int32),
+        "mask": np.ones((32,), np.float32),
+    }
+
+    def coll_counts(accum):
+        t = Trainer(spec, mesh, grad_accum=accum)
+        state = t.init_state(batch)
+        sb = shard_batch(mesh, batch)
+        with jax.set_mesh(mesh):
+            txt = jax.jit(t._raw_train_step()).lower(state, sb).compile(
+            ).as_text()
+        counts = {}
+        for op, _ in collective_sizes(txt):
+            key = op.replace("-start", "").replace("-done", "")
+            counts[key] = counts.get(key, 0) + 1
+        return counts
+
+    base = coll_counts(1)
+    acc = coll_counts(4)
+    assert acc.get("all-to-all", 0) == 0, acc
+    # the split adds no gathers; grad reduction happens ONCE after the scan
+    # (not per micro-batch), so nothing should exceed the accum=1 counts
+    for op, n in acc.items():
+        assert n <= base.get(op, 0), (op, acc, base)
