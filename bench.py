@@ -391,12 +391,14 @@ def bench_time_to_auc(mesh, np, target=0.75):
         reader, deepfm.dataset_fn("training", reader.metadata), BATCH)
     shard = reader.create_shards()[0][0]
 
-    eval_batches = list(svc.batches(shard, n_train, n_train + n_eval))
+    # stacked once: every AUC evaluation is ONE dispatch (eval_many scan)
+    # instead of n_eval/BATCH round trips through the tunnel
+    eval_stacked = shard_batch_stack(
+        mesh, list(svc.batches(shard, n_train, n_train + n_eval)))
 
     def eval_auc(state):
-        ms = trainer.new_metric_states()
-        for b in eval_batches:
-            ms = trainer.eval_step(state, b, ms)
+        ms = trainer.eval_many(
+            state, eval_stacked, trainer.new_metric_states())
         return float(trainer.metric_results(ms)["auc"])
 
     group = 8

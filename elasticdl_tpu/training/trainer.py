@@ -203,6 +203,7 @@ class Trainer:
         self._train_step = None
         self._train_many = None
         self._eval_step = None
+        self._eval_many = None
         self._predict_step = None
 
     # ------------------------------------------------------------------ #
@@ -324,6 +325,9 @@ class Trainer:
         return step_fn
 
     def _build_eval_step(self):
+        return jax.jit(self._raw_eval_step())
+
+    def _raw_eval_step(self):
         model, loss_fn = self.spec.model, self.spec.loss
         metric_items = tuple(self.metrics.items())
 
@@ -347,7 +351,7 @@ class Trainer:
             )
             return new_states
 
-        return jax.jit(step_fn)
+        return step_fn
 
     def _build_predict_step(self):
         model = self.spec.model
@@ -432,6 +436,25 @@ class Trainer:
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
             return self._eval_step(state, batch, metric_states)
+
+    def eval_many(self, state: TrainState, stacked_batch, metric_states):
+        """K eval steps in ONE XLA dispatch: `lax.scan` of the eval step
+        over a stacked batch pytree (build with `mesh.shard_batch_stack`) —
+        the eval-stream twin of `train_many`'s dispatch amortization (the
+        per-dispatch host round trip dominates small eval batches on a slow
+        link). Streaming metric states are the scan carry, so the result is
+        numerically equivalent to K sequential `eval_step` calls (the scan
+        body compiles separately — XLA fusion may round the last bit
+        differently)."""
+        if self._eval_many is None:
+            raw = self._raw_eval_step()
+            self._eval_many = jax.jit(
+                lambda s, stacked, ms: jax.lax.scan(
+                    lambda carry, b: (raw(s, b, carry), None), ms, stacked
+                )[0]
+            )
+        with jax.set_mesh(self.mesh):
+            return self._eval_many(state, stacked_batch, metric_states)
 
     def predict_step(self, state: TrainState, batch):
         if self._predict_step is None:

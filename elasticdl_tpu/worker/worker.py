@@ -541,14 +541,60 @@ class Worker:
             mngr.delete(drain_step)
 
     def _run_evaluation_task(self, task: pb.Task) -> bool:
-        """Returns True if interrupted by shutdown/preemption (no report)."""
+        """Returns True if interrupted by shutdown/preemption (no report).
+
+        Grouped-dispatch shape mirrors _run_training_task_grouped (buffer k
+        host batches, wire-cast before _ensure_state, full groups in one
+        scan dispatch, trailing partial singly) — the two stay structurally
+        parallel on purpose; a change to either's buffering/cast order
+        almost certainly applies to the other."""
+        from elasticdl_tpu.data.prefetch import _wire_cast
+        from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
         svc = self._data_service(pb.EVALUATION)
         states = self._trainer.new_metric_states()
-        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
+        k = max(1, self.cfg.steps_per_dispatch)
+        buf: list = []
+
+        def flush_eval_group():
+            """A full k-group runs as ONE eval_many scan (metric states are
+            the carry — numerically equivalent to sequential steps, though
+            XLA may fuse/round the scan body differently in the last bit);
+            trailing partials run singly so only two compiled programs
+            exist."""
+            nonlocal states
+            if not buf:
+                return
+            if len(buf) == k and k > 1:
+                states = self._trainer.eval_many(
+                    self._state,
+                    shard_batch_stack(
+                        self._mesh, buf, self._spec.batch_partition),
+                    states,
+                )
+            else:
+                for b in buf:
+                    states = self._trainer.eval_step(self._state, b, states)
+            buf.clear()
+
+        # grouped mode buffers HOST batches for the stack (stacking
+        # device-resident prefetched arrays would round-trip D2H); single
+        # mode keeps the async prefetch overlap
+        stream = svc.batches(task.shard_name, task.start, task.end)
+        if k == 1:
+            stream = self._prefetched(stream)
+        for batch in stream:
             if self._shutdown.is_set():
                 return True
+            if k > 1:
+                # the prefetched path applies the wire cast; grouped mode
+                # must match so both trace with identical feature dtypes
+                batch = _wire_cast(batch, self.cfg.wire_dtype)
             self._ensure_state(batch)
-            states = self._trainer.eval_step(self._state, batch, states)
+            buf.append(batch)
+            if len(buf) == k:
+                flush_eval_group()
+        flush_eval_group()
         import jax
 
         msg = pb.ReportEvaluationMetricsRequest(

@@ -250,3 +250,22 @@ def test_grad_accum_matches_full_batch(mesh8):
     t3 = Trainer(spec, mesh8, grad_accum=5)   # 5 does not divide 32
     with pytest.raises(ValueError):
         t3.train_step(t3.init_state(batch), batch)
+
+
+def test_eval_many_matches_stepwise(trainer, state0, mesh8):
+    """eval_many (scan, one dispatch) must be bit-identical to K sequential
+    eval_step calls — metric states are the scan carry."""
+    from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
+    batches = [synthetic_batch(seed=50 + i) for i in range(4)]
+    ms_seq = trainer.new_metric_states()
+    for b in batches:
+        ms_seq = trainer.eval_step(state0, b, ms_seq)
+    ms_scan = trainer.eval_many(
+        state0, shard_batch_stack(mesh8, batches), trainer.new_metric_states()
+    )
+    r_seq = trainer.metric_results(ms_seq)
+    r_scan = trainer.metric_results(ms_scan)
+    assert set(r_seq) == set(r_scan)
+    for k in r_seq:
+        assert np.isclose(r_seq[k], r_scan[k], rtol=1e-6), (k, r_seq, r_scan)
