@@ -488,6 +488,34 @@ class CohortWorker:
             self._model_version += len(buf)
             buf.clear()
 
+        eval_buf: List[Any] = []
+
+        def flush_eval_group(states):
+            """Eval twin of flush_training_group: a full k-group is ONE
+            collective eval_many dispatch on every process; a trailing
+            partial runs as single collective eval_steps."""
+            if not eval_buf:
+                return states
+            if states is None:
+                states = self._trainer.new_metric_states()
+            if len(eval_buf) == k and k > 1:
+                states = self._trainer.eval_many(
+                    self._state,
+                    make_global_batch_stack(
+                        self._mesh, eval_buf, self._spec.batch_partition),
+                    states,
+                )
+            else:
+                for b in eval_buf:
+                    states = self._trainer.eval_step(
+                        self._state,
+                        make_global_batch(
+                            self._mesh, b, self._spec.batch_partition),
+                        states,
+                    )
+            eval_buf.clear()
+            return states
+
         from elasticdl_tpu.data.prefetch import _wire_cast
 
         for host_batch in svc.batches(shard, start, end):
@@ -504,6 +532,17 @@ class CohortWorker:
                 if len(buf) == k:
                     flush_training_group()
                 continue
+            if task_type == pb.EVALUATION and k > 1:
+                # grouped eval: same collective eval_many scan on every
+                # process (metric states carry), mirroring training groups
+                if self._state is None:
+                    self._ensure_state(make_global_batch(
+                        self._mesh, host_batch, self._spec.batch_partition))
+                    self._maybe_apply_ctrl_lr()
+                eval_buf.append(host_batch)
+                if len(eval_buf) == k:
+                    metric_states = flush_eval_group(metric_states)
+                continue
             batch = make_global_batch(
                 self._mesh, host_batch, self._spec.batch_partition
             )
@@ -519,6 +558,7 @@ class CohortWorker:
                     self._state, batch, metric_states
                 )
         flush_training_group()   # trailing partial group (single steps)
+        metric_states = flush_eval_group(metric_states)  # trailing partial
 
         if flags & FLAG_CHECKPOINT:
             mngr = self._checkpoint_manager()

@@ -141,14 +141,18 @@ def test_master_lr_push_applies(tmp_path, num_processes):
         assert "runtime LR set to 0.0005" in log, log[-2000:]
 
 
-def test_cohort_evaluation_only_job(tmp_path):
+@pytest.mark.parametrize("steps_per_dispatch", [1, 2])
+def test_cohort_evaluation_only_job(tmp_path, steps_per_dispatch):
     """evaluation_only in cohort mode: eval tasks stream through every
-    process's eval_step, metric states merge master-side, AUC comes back."""
+    process's eval path (per-batch eval_step, or the grouped eval_many
+    collective scan with --steps_per_dispatch), metric states merge
+    master-side, AUC comes back."""
     cfg = job_config(
         tmp_path,
         job_type="evaluation_only",
         validation_data="synthetic://criteo?n=512&shards=2",
         records_per_task=256,
+        steps_per_dispatch=steps_per_dispatch,
     )
     master, manager, counts = run_job(cfg, tmp_path, return_all=True)
     assert counts["failed_permanently"] == 0
