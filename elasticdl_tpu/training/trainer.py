@@ -205,6 +205,7 @@ class Trainer:
         self._eval_step = None
         self._eval_many = None
         self._predict_step = None
+        self._predict_many = None
 
     # ------------------------------------------------------------------ #
     # State creation
@@ -354,6 +355,9 @@ class Trainer:
         return step_fn
 
     def _build_predict_step(self):
+        return jax.jit(self._raw_predict_step())
+
+    def _raw_predict_step(self):
         model = self.spec.model
 
         def step_fn(state: TrainState, batch):
@@ -361,7 +365,7 @@ class Trainer:
             variables = {"params": state.params, **state.extra_vars}
             return model.apply(variables, features, training=False)
 
-        return jax.jit(step_fn)
+        return step_fn
 
     # ------------------------------------------------------------------ #
     # Public API
@@ -462,6 +466,18 @@ class Trainer:
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
             return self._predict_step(state, batch)
+
+    def predict_many(self, state: TrainState, stacked_batch):
+        """K predict steps in ONE dispatch (`lax.map` over the stacked
+        batch pytree): outputs come back stacked (K, B, ...) — the
+        prediction twin of train_many/eval_many dispatch amortization."""
+        if self._predict_many is None:
+            raw = self._raw_predict_step()
+            self._predict_many = jax.jit(
+                lambda s, stacked: jax.lax.map(lambda b: raw(s, b), stacked)
+            )
+        with jax.set_mesh(self.mesh):
+            return self._predict_many(state, stacked_batch)
 
     def metric_results(self, metric_states) -> Dict[str, float]:
         states = {k: np.asarray(jax.device_get(v)) for k, v in metric_states.items()}

@@ -407,6 +407,37 @@ class Worker:
             "interrupted": interrupted,
         }
 
+    def _grouped_stream(self, stream, k, interrupted):
+        """THE grouped-dispatch scaffold, shared by the training/eval/
+        prediction task paths: yield lists of ready-to-run batches — full
+        k-groups, then one trailing partial. Grouped mode (k > 1) buffers
+        HOST batches (the wire cast is applied BEFORE _ensure_state so
+        every path traces with identical feature dtypes, and the mask leaf
+        is exempted by _wire_cast so record accounting stays exact);
+        k == 1 yields single prefetched (device-resident, pre-cast)
+        batches. On shutdown/preemption `interrupted` (a mutable list) gets
+        a True appended and the stream ends at the group boundary — the
+        trailing partial is NOT yielded, so drain reports cover whole
+        groups only."""
+        from elasticdl_tpu.data.prefetch import _wire_cast
+
+        buf = []
+        if k == 1:
+            stream = self._prefetched(stream)
+        for batch in stream:
+            if self._shutdown.is_set():
+                interrupted.append(True)
+                return
+            if k > 1:
+                batch = _wire_cast(batch, self.cfg.wire_dtype)
+            self._ensure_state(batch)
+            buf.append(batch)
+            if len(buf) == k:
+                yield buf
+                buf = []
+        if buf:
+            yield buf
+
     def _run_training_task_grouped(self, task: pb.Task, k: int) -> Dict[str, float]:
         """--steps_per_dispatch > 1: buffer k host batches, run them as ONE
         XLA dispatch (Trainer.train_many lax.scan). Exactly-once accounting
@@ -417,18 +448,17 @@ class Worker:
         not one per remainder length)."""
         import jax.numpy as jnp
 
-        from elasticdl_tpu.data.prefetch import _wire_cast
         from elasticdl_tpu.parallel.mesh import shard_batch_stack
 
         svc = self._data_service(pb.TRAINING)
         stats = {"loss_sum": 0.0, "loss_count": 0, "records_done": 0,
                  "step_time_sum": 0.0, "interrupted": False}
         self._mid_training_task = True
-        buf = []
+        interrupted: list = []
 
-        def flush():
-            if not buf:
-                return
+        for buf in self._grouped_stream(
+            svc.batches(task.shard_name, task.start, task.end), k, interrupted
+        ):
             self._maybe_profile()
             t0 = time.perf_counter()
             if len(buf) == k:
@@ -445,24 +475,7 @@ class Worker:
             self._global_step += len(buf)
             self._model_version += len(buf)
             stats["records_done"] += int(sum(b["mask"].sum() for b in buf))
-            buf.clear()
-
-        for batch in svc.batches(task.shard_name, task.start, task.end):
-            if self._shutdown.is_set():
-                stats["interrupted"] = True
-                break
-            # same bf16 wire compression the single-step path gets from
-            # _prefetched (the mask leaf is exempted by _wire_cast itself,
-            # so flush()'s records accounting stays exact); cast BEFORE
-            # _ensure_state so both code paths trace/init with identical
-            # feature dtypes
-            batch = _wire_cast(batch, self.cfg.wire_dtype)
-            self._ensure_state(batch)
-            buf.append(batch)
-            if len(buf) == k:
-                flush()
-        if not stats["interrupted"]:
-            flush()
+        stats["interrupted"] = bool(interrupted)
         return stats
 
     def _report_preempted_task(self, task: pb.Task, stats: Dict[str, float]) -> None:
@@ -542,29 +555,19 @@ class Worker:
 
     def _run_evaluation_task(self, task: pb.Task) -> bool:
         """Returns True if interrupted by shutdown/preemption (no report).
-
-        Grouped-dispatch shape mirrors _run_training_task_grouped (buffer k
-        host batches, wire-cast before _ensure_state, full groups in one
-        scan dispatch, trailing partial singly) — the two stay structurally
-        parallel on purpose; a change to either's buffering/cast order
-        almost certainly applies to the other."""
-        from elasticdl_tpu.data.prefetch import _wire_cast
+        Full k-groups run as ONE eval_many scan (metric states are the
+        carry — numerically equivalent to sequential steps); the scaffold
+        (wire cast, buffering, prefetch selection) is _grouped_stream."""
         from elasticdl_tpu.parallel.mesh import shard_batch_stack
 
         svc = self._data_service(pb.EVALUATION)
         states = self._trainer.new_metric_states()
         k = max(1, self.cfg.steps_per_dispatch)
-        buf: list = []
+        interrupted: list = []
 
-        def flush_eval_group():
-            """A full k-group runs as ONE eval_many scan (metric states are
-            the carry — numerically equivalent to sequential steps, though
-            XLA may fuse/round the scan body differently in the last bit);
-            trailing partials run singly so only two compiled programs
-            exist."""
-            nonlocal states
-            if not buf:
-                return
+        for buf in self._grouped_stream(
+            svc.batches(task.shard_name, task.start, task.end), k, interrupted
+        ):
             if len(buf) == k and k > 1:
                 states = self._trainer.eval_many(
                     self._state,
@@ -575,26 +578,8 @@ class Worker:
             else:
                 for b in buf:
                     states = self._trainer.eval_step(self._state, b, states)
-            buf.clear()
-
-        # grouped mode buffers HOST batches for the stack (stacking
-        # device-resident prefetched arrays would round-trip D2H); single
-        # mode keeps the async prefetch overlap
-        stream = svc.batches(task.shard_name, task.start, task.end)
-        if k == 1:
-            stream = self._prefetched(stream)
-        for batch in stream:
-            if self._shutdown.is_set():
-                return True
-            if k > 1:
-                # the prefetched path applies the wire cast; grouped mode
-                # must match so both trace with identical feature dtypes
-                batch = _wire_cast(batch, self.cfg.wire_dtype)
-            self._ensure_state(batch)
-            buf.append(batch)
-            if len(buf) == k:
-                flush_eval_group()
-        flush_eval_group()
+        if interrupted:
+            return True
         import jax
 
         msg = pb.ReportEvaluationMetricsRequest(
@@ -609,22 +594,41 @@ class Worker:
         return False
 
     def _run_prediction_task(self, task: pb.Task) -> bool:
-        """Returns True if interrupted by shutdown/preemption (no report)."""
+        """Returns True if interrupted by shutdown/preemption (no report).
+        Full k-groups run as one predict_many dispatch (outputs come back
+        stacked, fed to the processor per batch in order); the scaffold is
+        _grouped_stream."""
+        import jax
+
+        from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
         svc = self._data_service(pb.PREDICTION)
         processor = self._spec.prediction_outputs_processor
-        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
-            if self._shutdown.is_set():
-                return True
-            self._ensure_state(batch)
-            outputs = self._trainer.predict_step(self._state, batch)
-            if processor is not None:
-                import jax
+        k = max(1, self.cfg.steps_per_dispatch)
+        interrupted: list = []
 
-                valid = batch["mask"] > 0
-                processor.process(
-                    np.asarray(jax.device_get(outputs))[valid], self.worker_id
-                )
-        return False
+        def process(batch, outputs):
+            if processor is None:
+                return
+            valid = np.asarray(batch["mask"]) > 0
+            processor.process(
+                np.asarray(jax.device_get(outputs))[valid], self.worker_id
+            )
+
+        for buf in self._grouped_stream(
+            svc.batches(task.shard_name, task.start, task.end), k, interrupted
+        ):
+            if len(buf) == k and k > 1:
+                stacked = shard_batch_stack(
+                    self._mesh, buf, self._spec.batch_partition)
+                outs = np.asarray(jax.device_get(
+                    self._trainer.predict_many(self._state, stacked)))
+                for b, out in zip(buf, outs):
+                    process(b, out)
+            else:
+                for b in buf:
+                    process(b, self._trainer.predict_step(self._state, b))
+        return bool(interrupted)
 
     # ------------------------------------------------------------------ #
 

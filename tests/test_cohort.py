@@ -160,15 +160,16 @@ def test_cohort_evaluation_only_job(tmp_path, steps_per_dispatch):
     assert "auc" in results and "loss" in results, results
 
 
-@pytest.mark.parametrize("num_processes", [1, 2])
-def test_cohort_prediction_job(tmp_path, num_processes):
+@pytest.mark.parametrize("num_processes,steps_per_dispatch",
+                         [(1, 1), (1, 2), (2, 1)])
+def test_cohort_prediction_job(tmp_path, num_processes, steps_per_dispatch):
     """Prediction jobs end-to-end in BOTH worker flavors. Cohort mode was a
     round-3 gap (_data_service only knew train/eval, so prediction-only
     with num_processes>1 crashed): every process runs predict_step on the
     global batch, outputs allgather to the leader, and the zoo's
     prediction_outputs_processor writes them — exactly once across the
     job. num_processes=1 drives the plain worker's prediction path through
-    the same harness."""
+    the same harness; (1, 2) covers its grouped predict_many dispatch."""
     import numpy as np
 
     out_dir = tmp_path / "preds"
@@ -178,6 +179,7 @@ def test_cohort_prediction_job(tmp_path, num_processes):
         prediction_data="synthetic://criteo?n=512&shards=2",
         records_per_task=256,
         num_processes=num_processes,
+        steps_per_dispatch=steps_per_dispatch,
     )
     counts = run_job(
         cfg, tmp_path, extra_env={"EDL_PREDICT_OUT": str(out_dir)})

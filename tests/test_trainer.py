@@ -269,3 +269,18 @@ def test_eval_many_matches_stepwise(trainer, state0, mesh8):
     assert set(r_seq) == set(r_scan)
     for k in r_seq:
         assert np.isclose(r_seq[k], r_scan[k], rtol=1e-6), (k, r_seq, r_scan)
+
+
+def test_predict_many_matches_stepwise(trainer, state0, mesh8):
+    """predict_many (one dispatch) must return the same outputs as K
+    predict_step calls, stacked in order."""
+    from elasticdl_tpu.parallel.mesh import shard_batch_stack
+
+    batches = [synthetic_batch(n=16, seed=60 + i) for i in range(3)]
+    stacked_out = np.asarray(
+        trainer.predict_many(state0, shard_batch_stack(mesh8, batches)))
+    assert stacked_out.shape == (3, 16, 10)
+    for i, b in enumerate(batches):
+        single = np.asarray(trainer.predict_step(state0, b))
+        np.testing.assert_allclose(stacked_out[i], single, rtol=1e-5,
+                                   atol=1e-6)
