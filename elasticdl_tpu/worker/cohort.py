@@ -488,6 +488,31 @@ class CohortWorker:
             self._model_version += len(buf)
             buf.clear()
 
+        pred_buf: List[Any] = []
+
+        def flush_predict_group():
+            """Prediction twin: a full k-group is ONE collective
+            predict_many dispatch; each batch's (sharded) output slice then
+            allgathers through _process_predictions in order. Trailing
+            partials run as single collective predict_steps."""
+            if not pred_buf:
+                return
+            if len(pred_buf) == k and k > 1:
+                outs = self._trainer.predict_many(
+                    self._state,
+                    make_global_batch_stack(
+                        self._mesh, pred_buf, self._spec.batch_partition),
+                )
+                for i, hb in enumerate(pred_buf):
+                    self._process_predictions(outs[i], hb)
+            else:
+                for hb in pred_buf:
+                    gb = make_global_batch(
+                        self._mesh, hb, self._spec.batch_partition)
+                    self._process_predictions(
+                        self._trainer.predict_step(self._state, gb), hb)
+            pred_buf.clear()
+
         eval_buf: List[Any] = []
 
         def flush_eval_group(states):
@@ -532,16 +557,21 @@ class CohortWorker:
                 if len(buf) == k:
                     flush_training_group()
                 continue
-            if task_type == pb.EVALUATION and k > 1:
-                # grouped eval: same collective eval_many scan on every
-                # process (metric states carry), mirroring training groups
+            if k > 1 and task_type in (pb.EVALUATION, pb.PREDICTION):
+                # grouped eval/prediction: same collective scan dispatch on
+                # every process, mirroring training groups
                 if self._state is None:
                     self._ensure_state(make_global_batch(
                         self._mesh, host_batch, self._spec.batch_partition))
                     self._maybe_apply_ctrl_lr()
-                eval_buf.append(host_batch)
-                if len(eval_buf) == k:
-                    metric_states = flush_eval_group(metric_states)
+                if task_type == pb.EVALUATION:
+                    eval_buf.append(host_batch)
+                    if len(eval_buf) == k:
+                        metric_states = flush_eval_group(metric_states)
+                else:
+                    pred_buf.append(host_batch)
+                    if len(pred_buf) == k:
+                        flush_predict_group()
                 continue
             batch = make_global_batch(
                 self._mesh, host_batch, self._spec.batch_partition
@@ -559,6 +589,7 @@ class CohortWorker:
                 )
         flush_training_group()   # trailing partial group (single steps)
         metric_states = flush_eval_group(metric_states)  # trailing partial
+        flush_predict_group()                            # trailing partial
 
         if flags & FLAG_CHECKPOINT:
             mngr = self._checkpoint_manager()

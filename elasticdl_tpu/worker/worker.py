@@ -621,10 +621,12 @@ class Worker:
             if len(buf) == k and k > 1:
                 stacked = shard_batch_stack(
                     self._mesh, buf, self._spec.batch_partition)
-                outs = np.asarray(jax.device_get(
-                    self._trainer.predict_many(self._state, stacked)))
-                for b, out in zip(buf, outs):
-                    process(b, out)
+                outs_dev = self._trainer.predict_many(self._state, stacked)
+                if processor is not None:
+                    # D2H only when someone consumes the outputs
+                    outs = np.asarray(jax.device_get(outs_dev))
+                    for b, out in zip(buf, outs):
+                        process(b, out)
             else:
                 for b in buf:
                     process(b, self._trainer.predict_step(self._state, b))

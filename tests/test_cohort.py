@@ -161,7 +161,7 @@ def test_cohort_evaluation_only_job(tmp_path, steps_per_dispatch):
 
 
 @pytest.mark.parametrize("num_processes,steps_per_dispatch",
-                         [(1, 1), (1, 2), (2, 1)])
+                         [(1, 1), (1, 2), (2, 1), (2, 2)])
 def test_cohort_prediction_job(tmp_path, num_processes, steps_per_dispatch):
     """Prediction jobs end-to-end in BOTH worker flavors. Cohort mode was a
     round-3 gap (_data_service only knew train/eval, so prediction-only
