@@ -44,8 +44,11 @@ if REPO_ROOT not in sys.path:
 # sandbox's TPU tunnel and are void. Override with EDL_BENCH_BASELINE.
 DEFAULT_BASELINE = 260_000.0
 
-BATCH = 8192
-FIELD_VOCAB = 100_000       # 26 fields -> 2.6M-row shared table (~166 MB fp32)
+# overridable for CPU smoke runs of the full orchestration (EDL_BENCH_CPU)
+# and for chip debugging; the defaults are the headline config
+BATCH = int(os.environ.get("EDL_BENCH_BATCH", "8192"))
+FIELD_VOCAB = int(os.environ.get("EDL_BENCH_FIELD_VOCAB", "100000"))
+# 26 fields -> 2.6M-row shared table (~166 MB fp32) at the default
 SCAN_STEPS = int(os.environ.get("EDL_BENCH_SCAN_STEPS", "32"))
 
 # Timing methodology (round 3, rev 2): through this sandbox's axon TPU
