@@ -147,6 +147,49 @@ class AUC(Metric):
         return float(abs(trapezoid(tpr, fpr)))
 
 
+class PrecisionRecall(Metric):
+    """Streaming binary precision/recall/F1 at one decision threshold.
+    State: [tp, fp, fn], additive across batches and workers. `result`
+    returns F1 by default; `kind` selects 'precision'/'recall'/'f1' so one
+    class serves all three (register it three times under different names,
+    e.g. {"precision": PrecisionRecall("precision"), ...})."""
+
+    def __init__(self, kind: str = "f1", threshold: float = 0.5,
+                 from_logits: bool = True):
+        if kind not in ("precision", "recall", "f1"):
+            raise ValueError(f"unknown kind {kind!r}")
+        self.kind = kind
+        self.name = kind
+        self.threshold = threshold
+        self.from_logits = from_logits
+
+    def init_state(self) -> np.ndarray:
+        return np.zeros((3,), np.float32)  # [tp, fp, fn]
+
+    def update(self, state, labels, outputs, mask=None):
+        scores = jnp.asarray(outputs, jnp.float32).reshape(-1)
+        if self.from_logits:
+            scores = 1.0 / (1.0 + jnp.exp(-scores))
+        labels = jnp.asarray(labels, jnp.float32).reshape(-1)
+        m = _as_mask(mask, labels.shape[0])
+        pred = (scores >= self.threshold).astype(jnp.float32)
+        lab = (labels > 0.5).astype(jnp.float32)
+        tp = jnp.sum(pred * lab * m)
+        fp = jnp.sum(pred * (1 - lab) * m)
+        fn = jnp.sum((1 - pred) * lab * m)
+        return state + jnp.stack([tp, fp, fn])
+
+    def result(self, state) -> float:
+        tp, fp, fn = (float(x) for x in np.asarray(state, np.float64))
+        precision = tp / max(tp + fp, 1e-9)
+        recall = tp / max(tp + fn, 1e-9)
+        if self.kind == "precision":
+            return precision
+        if self.kind == "recall":
+            return recall
+        return 2 * precision * recall / max(precision + recall, 1e-9)
+
+
 def init_states(metrics: Dict[str, Metric]) -> Dict[str, np.ndarray]:
     return {k: m.init_state() for k, m in metrics.items()}
 

@@ -284,3 +284,50 @@ def test_predict_many_matches_stepwise(trainer, state0, mesh8):
         single = np.asarray(trainer.predict_step(state0, b))
         np.testing.assert_allclose(stacked_out[i], single, rtol=1e-5,
                                    atol=1e-6)
+
+
+def test_precision_recall_f1_metric():
+    """Streaming precision/recall/F1 over two masked batches must equal
+    sklearn-style closed forms on the concatenated valid rows, and merge
+    across workers by plain state addition."""
+    from elasticdl_tpu.training import metrics as M
+
+    rng = np.random.RandomState(0)
+    labels = rng.randint(0, 2, size=(40,)).astype(np.float32)
+    logits = rng.randn(40).astype(np.float32) + (labels - 0.5)
+    mask = np.ones((40,), np.float32)
+    mask[36:] = 0.0          # padded rows must not count
+    labels[36:] = 1.0        # poison them to catch mask bugs
+
+    prec = M.PrecisionRecall("precision")
+    rec = M.PrecisionRecall("recall")
+    f1 = M.PrecisionRecall("f1")
+
+    def stream(metric):
+        s = metric.init_state()
+        s = metric.update(s, labels[:20], logits[:20], mask[:20])
+        s = metric.update(s, labels[20:], logits[20:], mask[20:])
+        return metric.result(np.asarray(s))
+
+    valid = mask > 0
+    p = 1.0 / (1.0 + np.exp(-logits[valid]))
+    pred = (p >= 0.5)
+    lab = labels[valid] > 0.5
+    tp = float(np.sum(pred & lab))
+    fp = float(np.sum(pred & ~lab))
+    fn = float(np.sum(~pred & lab))
+    exp_p = tp / (tp + fp)
+    exp_r = tp / (tp + fn)
+    exp_f1 = 2 * exp_p * exp_r / (exp_p + exp_r)
+    assert stream(prec) == pytest.approx(exp_p, abs=1e-6)
+    assert stream(rec) == pytest.approx(exp_r, abs=1e-6)
+    assert stream(f1) == pytest.approx(exp_f1, abs=1e-6)
+
+    # cross-worker merge = state addition
+    sa = f1.update(f1.init_state(), labels[:20], logits[:20], mask[:20])
+    sb = f1.update(f1.init_state(), labels[20:], logits[20:], mask[20:])
+    assert f1.result(np.asarray(sa) + np.asarray(sb)) == pytest.approx(
+        exp_f1, abs=1e-6)
+
+    with pytest.raises(ValueError):
+        M.PrecisionRecall("specificity")
