@@ -303,6 +303,59 @@ def test_cohort_lease_aborts_when_master_lost(tmp_path):
     assert ctrl[0] == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT
 
 
+def test_cohort_aborts_itself_when_master_vanishes(tmp_path):
+    """Orphan cleanup end-to-end: the master's gRPC server cold-stops (no
+    shutdown flag ever reaches the leader); after
+    master_unreachable_timeout_s the leader must broadcast the abort and
+    BOTH real subprocesses must exit on their own — no cohort may outlive
+    its master indefinitely (observed pre-fix: orphans surviving hours)."""
+    cfg = job_config(
+        tmp_path,
+        training_data="synthetic://criteo?n=8192&shards=8",
+        records_per_task=1024,
+        master_unreachable_timeout_s=6.0,
+        relaunch_max=0,
+    )
+    master = Master(cfg)
+    manager = ProcessManager(
+        cfg,
+        membership=master.membership,
+        extra_env=HERMETIC_ENV,
+        log_dir=str(tmp_path / "logs"),
+        job_finished_fn=master.dispatcher.finished,
+    )
+    master.start()
+    manager.start_workers()
+    try:
+        deadline = time.time() + 180
+        while (
+            time.time() < deadline
+            and master.dispatcher.counts()["finished_training"] < 1
+        ):
+            master.membership.reap()
+            master.dispatcher.poke()
+            time.sleep(0.2)
+        assert master.dispatcher.counts()["finished_training"] >= 1
+        master.server.stop(grace=0)   # cold stop: master vanishes
+
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            procs = list(manager._procs.values())
+            if procs and all(wp.proc.poll() is not None for wp in procs):
+                break
+            time.sleep(0.5)
+        else:
+            raise AssertionError(
+                "cohort outlived its vanished master: "
+                + all_logs(tmp_path)[-3000:]
+            )
+        log = all_logs(tmp_path)
+        assert "master presumed gone, aborting cohort" in log, log[-3000:]
+    finally:
+        master.server.stop(grace=0)
+        manager.stop()
+
+
 def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
     """Dynamic world resizing, scale-in: a member dies with the relaunch
     budget already spent — instead of stalling/failing, the cohort re-forms
