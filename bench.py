@@ -280,8 +280,9 @@ def bench_embedding_modes(mesh, np):
     with jax.set_mesh(mesh):
         # quantify the round-3 scatter fix: the same auto-mode update with
         # the plain XLA scatter-add backward vs the default sorted
-        # segment-sum custom VJP (ops/embedding.gather_rows)
-        for scatter in ("sorted", "xla"):
+        # segment-sum custom VJP vs the unique-compaction variant
+        # (ops/embedding.gather_rows) — the full menu in one chip window
+        for scatter in ("sorted", "unique", "xla"):
             os.environ["EDL_EMB_SCATTER"] = scatter
             try:
                 opt_state = opt.init(table)

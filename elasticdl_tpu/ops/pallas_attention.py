@@ -33,6 +33,7 @@ merge relies on the NEG_BIG lse, and no real caller consumes such rows.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import Optional, Tuple
@@ -84,17 +85,62 @@ def _min_block(dtype) -> int:
     return 8
 
 
+_INTERPRET_ENV = "EDL_FLASH_INTERPRET"
+_warned_probe_broken = False
+
+
+@contextlib.contextmanager
+def interpret_mode():
+    """Public entry for interpret-mode testing: wraps
+    `pltpu.force_tpu_interpret_mode()` AND marks interpret mode active via
+    `EDL_FLASH_INTERPRET` so `_interpret_active` has a signal that does not
+    depend on JAX private internals. Tests use THIS, not pltpu directly."""
+    prev = os.environ.get(_INTERPRET_ENV)
+    os.environ[_INTERPRET_ENV] = "1"
+    try:
+        with pltpu.force_tpu_interpret_mode():
+            yield
+    finally:
+        if prev is None:
+            os.environ.pop(_INTERPRET_ENV, None)
+        else:
+            os.environ[_INTERPRET_ENV] = prev
+
+
 def _interpret_active() -> bool:
-    """True inside `pltpu.force_tpu_interpret_mode()` (tests run the Mosaic
-    kernel on CPU there)."""
+    """True inside `interpret_mode()` / `pltpu.force_tpu_interpret_mode()`
+    (tests run the Mosaic kernel on CPU there).
+
+    Primary signal: the EDL_FLASH_INTERPRET env flag our own
+    `interpret_mode()` sets — public, upgrade-proof. Secondary: the JAX
+    config state behind pltpu's context manager, probed defensively (it is
+    a private module); if that probe breaks after a JAX upgrade we log
+    once instead of silently narrowing routing, and interpret_mode() users
+    are unaffected."""
+    if os.environ.get(_INTERPRET_ENV) == "1":
+        return True
+    global _warned_probe_broken
     try:
         from jax._src import config as _jax_config
 
-        return (
-            _jax_config.pallas_tpu_interpret_mode_context_manager.value
-            is not None
+        cm = getattr(
+            _jax_config, "pallas_tpu_interpret_mode_context_manager", None
         )
-    except Exception:
+        if cm is None:
+            raise AttributeError(
+                "pallas_tpu_interpret_mode_context_manager missing"
+            )
+        return cm.value is not None
+    except Exception as e:
+        if not _warned_probe_broken:
+            _warned_probe_broken = True
+            from elasticdl_tpu.common.log_utils import default_logger
+
+            default_logger(__name__).warning(
+                "interpret-mode probe of jax._src.config failed (%s); "
+                "bare force_tpu_interpret_mode() is now invisible — use "
+                "elasticdl_tpu.ops.pallas_attention.interpret_mode()", e,
+            )
         return False
 
 

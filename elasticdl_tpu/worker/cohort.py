@@ -378,8 +378,13 @@ class CohortWorker:
             full = jax.device_get(outputs)
         if not self.ctx.is_leader:
             return
+        from elasticdl_tpu.worker.prediction_outputs_processor import (
+            mask_predictions,
+        )
+
         valid = np.asarray(host_batch["mask"]) > 0
-        processor.process(np.asarray(full)[valid], self.worker_id)
+        # pytree-safe: predict outputs may be a dict/tuple, not an array
+        processor.process(mask_predictions(full, valid), self.worker_id)
 
     def _maybe_apply_ctrl_lr(self) -> None:
         """Apply the latest ctrl-carried LR override once state exists.
@@ -417,10 +422,17 @@ class CohortWorker:
             # and the checkpoint dir are symmetric across the cohort).
             mngr = self._checkpoint_manager()
             ok, err = True, ""
-            if mngr is not None and self._state is not None:
+            if mngr is None:
+                # No checkpoint_dir: nothing can be persisted. Reporting
+                # success would retire the job's durability task with
+                # nothing saved — fail it so the dispatcher's bounded
+                # retries surface the misconfiguration (all processes
+                # branch identically: the config is cohort-symmetric).
+                ok, err = False, "no checkpoint_dir configured, nothing to save to"
+            elif self._state is not None:
                 mngr.save(self._state, wait=True)
                 self._last_ckpt_step = self._state.model_version
-            elif mngr is not None and mngr.latest_step(refresh=True) is None:
+            elif mngr.latest_step(refresh=True) is None:
                 ok, err = False, "no live state and no checkpoint on disk"
             if self.ctx.is_leader:
                 try:
@@ -504,7 +516,11 @@ class CohortWorker:
                         self._mesh, pred_buf, self._spec.batch_partition),
                 )
                 for i, hb in enumerate(pred_buf):
-                    self._process_predictions(outs[i], hb)
+                    # tree-indexed: outs leaves carry the group dim, and
+                    # predict outputs may be a dict/tuple pytree
+                    self._process_predictions(
+                        jax.tree_util.tree_map(lambda x, i=i: x[i], outs), hb
+                    )
             else:
                 for hb in pred_buf:
                     gb = make_global_batch(

@@ -15,6 +15,32 @@ from typing import Any, List
 import numpy as np
 
 
+def mask_predictions(outputs: Any, valid: np.ndarray) -> Any:
+    """Drop padding rows from prediction outputs of ANY pytree shape.
+
+    `predict_step` returns whatever `model.apply` returns — an array, a
+    dict, a tuple, any pytree whose leaves share the batch leading dim.
+    Every leaf gets `np.asarray(leaf)[valid]`; a plain array comes back a
+    plain array, so existing array-output models are unchanged.
+    """
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda leaf: np.asarray(leaf)[valid], outputs
+    )
+
+
+def iter_stacked(outputs: Any, k: int):
+    """Yield the k per-batch pytrees out of a stacked `predict_many`
+    result (leaves have a leading group dim of k). Works for plain
+    arrays and arbitrary pytrees alike."""
+    import jax
+
+    leaves = jax.device_get(outputs)
+    for i in range(k):
+        yield jax.tree_util.tree_map(lambda leaf: np.asarray(leaf)[i], leaves)
+
+
 class BasePredictionOutputsProcessor:
     """Subclass and override `process`. The default is a no-op."""
 

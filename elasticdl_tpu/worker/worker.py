@@ -601,6 +601,10 @@ class Worker:
         import jax
 
         from elasticdl_tpu.parallel.mesh import shard_batch_stack
+        from elasticdl_tpu.worker.prediction_outputs_processor import (
+            iter_stacked,
+            mask_predictions,
+        )
 
         svc = self._data_service(pb.PREDICTION)
         processor = self._spec.prediction_outputs_processor
@@ -611,8 +615,10 @@ class Worker:
             if processor is None:
                 return
             valid = np.asarray(batch["mask"]) > 0
+            # pytree-safe: predict outputs may be a dict/tuple, not an array
             processor.process(
-                np.asarray(jax.device_get(outputs))[valid], self.worker_id
+                mask_predictions(jax.device_get(outputs), valid),
+                self.worker_id,
             )
 
         for buf in self._grouped_stream(
@@ -624,8 +630,7 @@ class Worker:
                 outs_dev = self._trainer.predict_many(self._state, stacked)
                 if processor is not None:
                     # D2H only when someone consumes the outputs
-                    outs = np.asarray(jax.device_get(outs_dev))
-                    for b, out in zip(buf, outs):
+                    for b, out in zip(buf, iter_stacked(outs_dev, len(buf))):
                         process(b, out)
             else:
                 for b in buf:
@@ -800,7 +805,13 @@ class Worker:
         here would retire the job's durability task with nothing saved)."""
         mngr = self._checkpoint_manager()
         if mngr is None:
-            return
+            # A SAVE_MODEL task with no checkpoint_dir cannot persist
+            # anything; silent success would retire the job's durability
+            # task with nothing saved. Fail loudly — the dispatcher's
+            # bounded retries (max_task_retries) then fail it permanently.
+            raise RuntimeError(
+                "SAVE_MODEL: no checkpoint_dir configured, nothing to save to"
+            )
         if self._state is None:
             if mngr.latest_step(refresh=True) is None:
                 raise RuntimeError(

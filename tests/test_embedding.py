@@ -50,6 +50,89 @@ def test_gather_rows_sorted_backward_matches_xla(monkeypatch):
     np.testing.assert_allclose(np.asarray(g_env), np.asarray(g_xla), rtol=1e-6)
 
 
+@pytest.mark.parametrize(
+    "ids_np",
+    [
+        np.asarray([[3, 3, 7], [0, 127, 3]], np.int32),   # duplicates
+        np.arange(12, dtype=np.int32).reshape(3, 4),       # all distinct
+        np.zeros((4, 4), np.int32),                        # one id repeated
+        np.asarray([[127, 0, 64]], np.int32),              # unsorted extremes
+    ],
+)
+def test_gather_rows_unique_backward_matches_xla(monkeypatch, ids_np):
+    """EDL_EMB_SCATTER=unique: the compaction backward (sorted boundary
+    cumsum -> per-unique segment_sum -> one unique_indices scatter) must
+    equal the plain take VJP across duplicate-heavy, distinct, and
+    degenerate id patterns (VERDICT r4 next #5)."""
+    t = jnp.asarray(np.random.RandomState(0).randn(128, 16), jnp.float32)
+    ids = jnp.asarray(ids_np)
+    g_xla = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) ** 2))(t)
+
+    monkeypatch.setenv("EDL_EMB_SCATTER", "unique")
+    g_unique = jax.grad(
+        lambda t: jnp.sum(emb_ops.gather_rows(t, ids) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g_unique), np.asarray(g_xla),
+                               rtol=1e-6)
+
+    # bf16 table round-trips through the f32 accumulator
+    tb = t.astype(jnp.bfloat16)
+    gb = jax.grad(
+        lambda t: jnp.sum(emb_ops.gather_rows(t, ids).astype(jnp.float32) ** 2)
+    )(tb)
+    assert gb.dtype == jnp.bfloat16
+
+
+@pytest.mark.parametrize("mode", ["sorted", "unique", "xla"])
+def test_gather_rows_backward_unsigned_ids_and_empty(monkeypatch, mode):
+    """Code-review r5: (a) uint32 ids must not break the unique path's
+    signed empty-segment sentinel (duplicate scatter targets at row 0
+    would be implementation-defined on TPU); (b) empty ids must give a
+    zero gradient in every mode, not a trace error."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", mode)
+    t = jnp.asarray(np.random.RandomState(0).randn(16, 4), jnp.float32)
+
+    # uint32 with id 0 present AND duplicated — the reviewer's repro
+    ids_u = jnp.asarray([[0, 0, 5]], jnp.uint32)
+    ids_i = ids_u.astype(jnp.int32)
+    g_u = jax.grad(lambda t: jnp.sum(emb_ops._take(t, ids_u) ** 2))(t)
+    g_ref = jax.grad(lambda t: jnp.sum(jnp.take(t, ids_i, axis=0) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g_u), np.asarray(g_ref), rtol=1e-6)
+
+    # empty ids: zero gradient, no trace error
+    empty = jnp.zeros((0, 3), jnp.int32)
+    g_e = jax.grad(lambda t: jnp.sum(emb_ops._take(t, empty)))(t)
+    np.testing.assert_array_equal(np.asarray(g_e), 0.0)
+
+
+def test_gather_rows_unique_backward_under_jit_and_lookup(monkeypatch, mesh8):
+    """unique mode composes with the full embedding_lookup paths (manual
+    shard_map + auto) under jit on the 8-device mesh."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", "unique")
+    from jax.sharding import NamedSharding
+
+    table_np, table = make_table(mesh8, V=256, D=8, seed=7)
+    ids_np = np.random.RandomState(8).randint(0, 256, (16, 3)).astype(np.int32)
+    ids = jax.device_put(ids_np, NamedSharding(mesh8, P("data", None)))
+    w_np = np.random.RandomState(9).randn(16, 3, 8).astype(np.float32)
+
+    expected = np.zeros_like(table_np)
+    for b in range(16):
+        for l in range(3):
+            expected[ids_np[b, l]] += w_np[b, l]
+
+    with jax.set_mesh(mesh8):
+        for mode in ("manual", "auto"):
+            g = jax.jit(
+                jax.grad(
+                    lambda t: jnp.sum(
+                        emb_ops.embedding_lookup(t, ids, mode=mode) * w_np
+                    )
+                )
+            )(table)
+            np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-5,
+                                       atol=1e-6)
+
+
 @pytest.mark.parametrize("mesh_name", ["mesh8", "mesh_4x2"])
 @pytest.mark.parametrize("mode", ["manual", "auto"])
 def test_lookup_matches_dense(mesh_name, mode, request):

@@ -101,7 +101,7 @@ def test_remat_accum_with_flash_kernel(reader, monkeypatch):
     on (EDL_FLASH=1 + interpret mode, the production-TPU path emulated)
     must match the plain step's first loss — remat recompute re-runs the
     kernel in the backward, which nothing else covers."""
-    from jax.experimental.pallas import tpu as pltpu
+    from elasticdl_tpu.ops.pallas_attention import interpret_mode
 
     spec = make_spec(seq_parallel="ring")
     mesh = build_mesh({"data": 2, "seq": 4})
@@ -113,7 +113,7 @@ def test_remat_accum_with_flash_kernel(reader, monkeypatch):
         return float(logs["loss"])
 
     monkeypatch.setenv("EDL_FLASH", "1")
-    with pltpu.force_tpu_interpret_mode():
+    with interpret_mode():
         plain = first_loss()
         knobs = first_loss(remat_policy="dots", grad_accum=2)
     assert knobs == pytest.approx(plain, rel=1e-4), (plain, knobs)
