@@ -81,26 +81,32 @@ TPU_PEAKS = (
 
 
 def _chip_peaks():
-    """(peak_tflops, peak_hbm_gbps) for this backend; each element is None
-    off-TPU with no override (MFU would be meaningless on the CPU mesh).
-    The two env overrides apply independently — they feed disjoint
-    consumers (_mfu_fields uses only the FLOP peak, the embedding leg only
-    the HBM peak)."""
+    """(peak_tflops, peak_hbm_gbps, tf_assumed, bw_assumed, device_kind)
+    for this backend; the peaks are None off-TPU with no override (MFU
+    would be meaningless on the CPU mesh). A per-peak `*_assumed` is True
+    only when THAT peak took the v5e-class fallback for an unknown device
+    kind — ADVICE r4: the record must carry the marker so an MFU computed
+    against the wrong roofline is visibly provisional, while an
+    env-overridden (exact) peak stays unmarked. The two env overrides
+    apply independently — they feed disjoint consumers (_mfu_fields uses
+    only the FLOP peak, the embedding leg only the HBM peak)."""
     import jax
 
     tf_env = os.environ.get("EDL_PEAK_TFLOPS")
     bw_env = os.environ.get("EDL_PEAK_HBM_GBPS")
     tf = float(tf_env) if tf_env else None
     bw = float(bw_env) if bw_env else None
+    tf_assumed, bw_assumed, kind = False, False, ""
     if (tf is None or bw is None) and jax.default_backend() == "tpu":
         kind = jax.devices()[0].device_kind.lower()
-        dtf, dbw = next(
-            (peaks for key, peaks in TPU_PEAKS if key in kind),
-            (197.0, 819.0),   # unknown TPU: assume v5e-class
-        )
-        tf = dtf if tf is None else tf
-        bw = dbw if bw is None else bw
-    return tf, bw
+        match = next((peaks for key, peaks in TPU_PEAKS if key in kind), None)
+        fallback = match is None
+        dtf, dbw = (197.0, 819.0) if fallback else match  # unknown: v5e-class
+        if tf is None:
+            tf, tf_assumed = dtf, fallback
+        if bw is None:
+            bw, bw_assumed = dbw, fallback
+    return tf, bw, tf_assumed, bw_assumed, kind
 
 
 def _mfu_fields(flops_per_step: float, step_s: float, n_chips: int = 1) -> dict:
@@ -108,7 +114,7 @@ def _mfu_fields(flops_per_step: float, step_s: float, n_chips: int = 1) -> dict:
     `flops_per_step` is the GLOBAL (whole-mesh) analytic count from the
     pre-partitioning lowered HLO, so achieved TFLOP/s and MFU are
     normalized PER CHIP to compare against the single-chip peak."""
-    peak_tf, _ = _chip_peaks()
+    peak_tf, _, tf_assumed, _, kind = _chip_peaks()
     if not flops_per_step or not step_s:
         return {}
     achieved_tf = flops_per_step / step_s / 1e12 / max(1, n_chips)
@@ -118,6 +124,9 @@ def _mfu_fields(flops_per_step: float, step_s: float, n_chips: int = 1) -> dict:
     }
     if peak_tf:
         out["mfu_pct"] = round(100.0 * achieved_tf / peak_tf, 3)
+        if tf_assumed:
+            out["peak_tflops_assumed"] = True
+            out["device_kind"] = kind
     return out
 
 
@@ -353,7 +362,7 @@ def bench_embedding_modes(mesh, np):
     # (table read + output write), a full SGD update ~5 (fwd gather 2 +
     # grad-segment read 1 + table read-modify-write 2). Utilization against
     # the chip's HBM peak says how far the engine is from the roof.
-    _, peak_bw = _chip_peaks()
+    _, peak_bw, _, bw_assumed, kind = _chip_peaks()
     row_bytes = D * 4
     for mode in ("manual", "auto"):
         r = results[mode]
@@ -366,6 +375,9 @@ def bench_embedding_modes(mesh, np):
                 100.0 * r["lookup_hbm_gbps"] / peak_bw, 2)
             r["update_hbm_util_pct"] = round(
                 100.0 * r["update_hbm_gbps"] / peak_bw, 2)
+            if bw_assumed:
+                r["peak_hbm_assumed"] = True
+                r["device_kind"] = kind
     return results
 
 

@@ -58,6 +58,12 @@ def format_kv_params(d: Dict[str, Any]) -> str:
     return ";".join(f"{k}={v}" for k, v in d.items())
 
 
+# Valid --remat_policy names. The jax.checkpoint policies they map to live
+# in training/trainer.resolve_remat_policy (kept out of this module so the
+# client submit path stays framework-free); tests pin the two in sync.
+REMAT_POLICY_NAMES = ("dots", "dots_no_batch", "nothing")
+
+
 @dataclass
 class JobConfig:
     """Everything a training/evaluation/prediction job needs, in one place."""
@@ -166,8 +172,10 @@ class JobConfig:
     remat_policy: str = ""
     # Gradient accumulation: split each minibatch into K micro-batches and
     # scan forward+backward holding one micro-batch of activations live —
-    # grads are EXACTLY the full-batch step's (masked-weighted), so K is a
-    # pure HBM knob for raising effective batch size. Must divide
+    # with a per-example (vector) loss, grads are EXACTLY the full-batch
+    # step's (masked-weighted), so K is a pure HBM knob for raising
+    # effective batch size. A loss returning a pre-reduced SCALAR weighs
+    # micro-batches equally instead (trainer warns once). Must divide
     # minibatch_size.
     grad_accum_steps: int = 1
 
@@ -193,12 +201,17 @@ class JobConfig:
     def validate(self) -> None:
         if not self.model_def:
             raise ValueError("model_def is required (e.g. mnist.mnist_cnn.custom_model)")
-        if self.remat_policy:
+        if self.remat_policy and self.remat_policy not in REMAT_POLICY_NAMES:
             # fail at submit time, not after TPUs are allocated and the
-            # first train step builds
-            from elasticdl_tpu.training.trainer import resolve_remat_policy
-
-            resolve_remat_policy(self.remat_policy)
+            # first train step builds — against the plain name set, NOT by
+            # importing training.trainer (which pulls jax/optax/flax into
+            # the framework-free client submit path). trainer's
+            # resolve_remat_policy does the jax lookup at construction;
+            # a test pins the two lists together.
+            raise ValueError(
+                f"unknown remat policy {self.remat_policy!r}; choose from "
+                f"{sorted(REMAT_POLICY_NAMES)} or '' for full remat"
+            )
         if self.grad_accum_steps < 1:
             raise ValueError("grad_accum_steps must be >= 1")
         if self.grad_accum_steps > 1 and (

