@@ -1,0 +1,348 @@
+"""Declarative feature-spec pipeline — tabular models declare features as
+data, not code.
+
+Reference parity: elasticdl_preprocessing/layers/*.py (SURVEY §2.5) composed
+Hashing / IndexLookup / Discretization / Normalizer / ConcatenateWithOffset
+into per-model Keras preprocessing stacks (~1,500 LoC of layer machinery);
+census/deepfm declared their features and the stack ran in the TF graph.
+
+TPU-first redesign: a `FeatureSpec` is a list of feature declarations that
+COMPILES into two halves instead of a layer graph:
+
+- **host half** (`host_transform`, numpy): everything XLA cannot express —
+  string hashing (crc32) and string-vocabulary lookup. Runs once in the data
+  pipeline. Features whose source is already numeric pass through untouched.
+- **device half** (`device_transform`, jnp): integer hashing, bucketization,
+  normalization, integer lookup, and the shared-id-space offset concat. Pure
+  jit-friendly ops, applied INSIDE the jitted step so they fuse into the
+  model's first matmul instead of burning host CPU (the actual pipeline
+  bottleneck — BASELINE.md round-2: the per-record Python loop capped the
+  chip 26x).
+
+`transform` is the numpy composition of both halves for per-record parsers
+(census CSV) and host-only pipelines; both halves agree bit-for-bit on the
+integer id spaces (tests pin host==device).
+
+Out of scope (kept in api/preprocessing.py for direct use): ragged bag
+inputs (`pad_to_dense` + Embedding combiners) — static-width bags are a
+model-shape decision, not a column transform.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from elasticdl_tpu.api import preprocessing as pp
+
+# A feature reads from a named 1-D column ("age") or a column of a packed
+# 2-D array (("cat", 3) = cols["cat"][:, 3]) — the latter is how wide
+# fixed-layout datasets like Criteo arrive from the batch parsers.
+Source = Union[str, Tuple[str, int]]
+
+
+def _col(cols: Dict[str, Any], source: Source):
+    if isinstance(source, tuple):
+        key, idx = source
+        return cols[key][:, idx]
+    return cols[source]
+
+
+@dataclass(frozen=True)
+class Numeric:
+    """Dense float feature. transform: None | 'log1p' | ('standard', mean,
+    std). Reference parity: Normalizer / the log-squash Criteo transform."""
+
+    name: str
+    transform: Any = None
+    source: Optional[Source] = None
+
+    @property
+    def src(self) -> Source:
+        return self.name if self.source is None else self.source
+
+    def apply(self, x, xp):
+        v = xp.asarray(x, xp.float32)
+        if self.transform is None:
+            return v
+        if self.transform == "log1p":
+            return xp.log1p(xp.maximum(v, 0.0))
+        kind, mean, std = self.transform
+        if kind != "standard":
+            raise ValueError(f"unknown numeric transform {self.transform!r}")
+        return (v - xp.float32(mean)) / xp.float32(max(std, 1e-12))
+
+
+@dataclass(frozen=True)
+class Bucketized:
+    """Continuous → bucket id in [0, len(boundaries)]. Reference parity:
+    Discretization."""
+
+    name: str
+    boundaries: Tuple[float, ...]
+    source: Optional[Source] = None
+
+    size = property(lambda self: len(self.boundaries) + 1)
+    src = property(lambda self: self.name if self.source is None else self.source)
+
+    def apply(self, x, xp):
+        b = xp.asarray(np.asarray(self.boundaries, np.float32))
+        return xp.searchsorted(
+            b, xp.asarray(x, xp.float32), side="right").astype(xp.int32)
+
+
+@dataclass(frozen=True)
+class Hashed:
+    """Value → [0, num_bins) by deterministic hash. Reference parity:
+    Hashing (the hash trick that bounds the embedding table's key space).
+    strings=True sources hash on the HOST (crc32 — XLA has no strings);
+    integer sources hash on the DEVICE (Fibonacci multiplicative)."""
+
+    name: str
+    num_bins: int
+    strings: bool = False
+    source: Optional[Source] = None
+
+    size = property(lambda self: self.num_bins)
+    src = property(lambda self: self.name if self.source is None else self.source)
+
+
+@dataclass(frozen=True)
+class Lookup:
+    """Static-vocabulary lookup: vocab[i] → num_oov + i, unknown → hash
+    into [0, num_oov). Reference parity: IndexLookup. A string vocab runs
+    on the host, an integer vocab on the device."""
+
+    name: str
+    vocab: Tuple[Any, ...]
+    num_oov: int = 1
+    source: Optional[Source] = None
+
+    size = property(lambda self: len(self.vocab) + self.num_oov)
+    src = property(lambda self: self.name if self.source is None else self.source)
+
+    @property
+    def strings(self) -> bool:
+        return bool(self.vocab) and isinstance(self.vocab[0], (str, bytes))
+
+
+FeatureDef = Union[Numeric, Bucketized, Hashed, Lookup]
+
+
+def numeric(name: str, *, standardize: Optional[Tuple[float, float]] = None,
+            log1p: bool = False, source: Optional[Source] = None) -> Numeric:
+    if standardize is not None and log1p:
+        raise ValueError("choose standardize OR log1p, not both")
+    t = ("standard", *standardize) if standardize is not None else (
+        "log1p" if log1p else None)
+    return Numeric(name, t, source)
+
+
+def bucketized(name: str, boundaries: Sequence[float], *,
+               source: Optional[Source] = None) -> Bucketized:
+    return Bucketized(name, tuple(float(b) for b in boundaries), source)
+
+
+def hashed(name: str, num_bins: int, *, strings: bool = False,
+           source: Optional[Source] = None) -> Hashed:
+    return Hashed(name, int(num_bins), strings, source)
+
+
+def lookup(name: str, vocab: Sequence[Any], *, num_oov: int = 1,
+           source: Optional[Source] = None) -> Lookup:
+    return Lookup(name, tuple(vocab), int(num_oov), source)
+
+
+def _np_hash_bucket(ids, num_bins: int) -> np.ndarray:
+    """Numpy twin of pp.hash_bucket (bit-identical; tests pin it)."""
+    x = np.asarray(ids).astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    x = (x * np.uint32(0x85EBCA6B)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(13))
+    x = (x * np.uint32(0xC2B2AE35)).astype(np.uint32)
+    x = x ^ (x >> np.uint32(16))
+    return (x % np.uint32(num_bins)).astype(np.int32)
+
+
+def _np_int_lookup(values, vocab, num_oov: int) -> np.ndarray:
+    """Numpy twin of pp.int_lookup: declaration-order ids (vocab[i] →
+    num_oov + i), sorted search + permutation."""
+    v = np.asarray(vocab, np.int32)
+    order = np.argsort(v, kind="stable")
+    sv, decl_idx = v[order], order.astype(np.int32)
+    x = np.asarray(values, np.int32)
+    pos = np.searchsorted(sv, x)
+    pos_c = np.clip(pos, 0, len(v) - 1)
+    found = sv[pos_c] == x
+    oov = (_np_hash_bucket(x, num_oov) if num_oov > 0
+           else np.zeros_like(pos_c, np.int32))
+    return np.where(found, decl_idx[pos_c] + num_oov, oov)
+
+
+class FeatureSpec:
+    """An ordered feature list compiled into (host, device) transforms.
+
+    Output contract (the shape every tabular zoo model consumes):
+      {"dense": (B, dense_dim) float32,
+       "cat":   (B, cat_dim)   int32 in ONE shared id space of
+                `total_vocab` rows (per-feature offsets applied)}
+    """
+
+    def __init__(self, features: Sequence[FeatureDef]):
+        if not features:
+            raise ValueError("FeatureSpec needs at least one feature")
+        names = [f.name for f in features]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate feature names in {names}")
+        self.features = tuple(features)
+        self.dense_features = tuple(
+            f for f in features if isinstance(f, Numeric))
+        self.cat_features = tuple(
+            f for f in features if not isinstance(f, Numeric))
+        self.dense_dim = len(self.dense_features)
+        self.cat_dim = len(self.cat_features)
+        self.offsets: Dict[str, int] = {}
+        off = 0
+        for f in self.cat_features:
+            self.offsets[f.name] = off
+            off += f.size
+        self.total_vocab = off
+        self._host_lookups = {
+            f.name: pp.StringLookup(
+                [v if isinstance(v, str) else v.decode("utf-8")
+                 for v in f.vocab], f.num_oov)
+            for f in self.cat_features
+            if isinstance(f, Lookup) and f.strings
+        }
+
+    # ------------------------------------------------------------------ #
+    # host half
+
+    def host_transform(self, cols: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Resolve everything XLA can't: string hash / string lookup become
+        final ids in [0, size); every other feature passes through raw
+        under its feature name. Output feeds device_transform."""
+        out: Dict[str, np.ndarray] = {}
+        for f in self.features:
+            x = _col(cols, f.src)
+            if isinstance(f, Hashed) and f.strings:
+                out[f.name] = pp.hash_strings(x, f.num_bins)
+            elif isinstance(f, Lookup) and f.strings:
+                out[f.name] = self._host_lookups[f.name](x)
+            elif isinstance(f, Numeric) or isinstance(f, Bucketized):
+                out[f.name] = np.asarray(x, np.float32)
+            else:
+                out[f.name] = np.asarray(x, np.int32)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # device half (jnp — call inside the jitted step / model)
+
+    def device_transform(self, inter: Dict[str, Any]) -> Dict[str, Any]:
+        """Host-resolved intermediate → {"dense", "cat"}; pure jnp ops that
+        fuse into the step. String-sourced features arrive as final ids and
+        only get their offset.
+
+        `inter` is keyed by feature name (host_transform output) OR, for an
+        all-numeric spec, by raw source columns — so a model whose inputs
+        are packed arrays (Criteo "dense"/"cat") can apply the WHOLE spec
+        inside its jitted __call__ with no host half at all."""
+        import jax.numpy as jnp
+
+        def col(f):
+            return inter[f.name] if f.name in inter else _col(inter, f.src)
+
+        dense = [f.apply(col(f), jnp) for f in self.dense_features]
+        cat = []
+        for f in self.cat_features:
+            if (isinstance(f, Hashed) and f.strings) or (
+                    isinstance(f, Lookup) and f.strings):
+                if f.name not in inter:
+                    raise ValueError(
+                        f"string feature {f.name!r} needs host_transform "
+                        "before device_transform")
+            x = col(f)
+            if isinstance(f, Bucketized):
+                ids = f.apply(x, jnp)
+            elif isinstance(f, Hashed) and not f.strings:
+                ids = pp.hash_bucket(x, f.num_bins)
+            elif isinstance(f, Lookup) and not f.strings:
+                ids = pp.int_lookup(x, f.vocab, f.num_oov)
+            else:   # host-resolved string feature: already final ids
+                ids = jnp.asarray(x, jnp.int32)
+            cat.append(ids + jnp.int32(self.offsets[f.name]))
+        out = {}
+        if dense:
+            out["dense"] = jnp.stack(dense, axis=-1)
+        if cat:
+            out["cat"] = jnp.stack(cat, axis=-1)
+        return out
+
+    # ------------------------------------------------------------------ #
+    # numpy composition (per-record parsers, host-only pipelines, tests)
+
+    def transform(self, cols: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """host_transform ∘ device-half-in-numpy. Bit-identical id spaces
+        with the device half (pinned by tests/test_feature_spec.py)."""
+        inter = self.host_transform(cols)
+        dense = [f.apply(inter[f.name], np) for f in self.dense_features]
+        cat = []
+        for f in self.cat_features:
+            x = inter[f.name]
+            if isinstance(f, Bucketized):
+                ids = f.apply(x, np)
+            elif isinstance(f, Hashed) and not f.strings:
+                ids = _np_hash_bucket(x, f.num_bins)
+            elif isinstance(f, Lookup) and not f.strings:
+                ids = _np_int_lookup(x, f.vocab, f.num_oov)
+            else:
+                ids = np.asarray(x, np.int32)
+            cat.append(ids + np.int32(self.offsets[f.name]))
+        out: Dict[str, np.ndarray] = {}
+        if dense:
+            out["dense"] = np.stack(dense, axis=-1).astype(np.float32)
+        if cat:
+            out["cat"] = np.stack(cat, axis=-1).astype(np.int32)
+        return out
+
+    def transform_row(self, row: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """One record (dict of scalars) → {"dense": (n,), "cat": (m,)}."""
+        cols = {k: np.asarray([v]) for k, v in row.items()}
+        out = self.transform(cols)
+        return {k: v[0] for k, v in out.items()}
+
+    # ------------------------------------------------------------------ #
+    # CSV convenience: spec + column order -> reader parse function
+
+    def csv_parser(
+        self,
+        columns: Sequence[str],
+        label_fn: Callable[[Dict[str, str]], Any],
+        delimiter: str = ",",
+    ):
+        """parse(record: bytes) -> (features, label) for CSV readers; the
+        per-row twin of the reference's feature-column input_fn."""
+        columns = tuple(columns)
+
+        def parse(record: bytes):
+            parts = [p.strip()
+                     for p in record.decode("utf-8").rstrip("\n").split(delimiter)]
+            row = dict(zip(columns, parts))
+            typed: Dict[str, Any] = {}
+            for f in self.features:
+                src = f.src
+                if isinstance(src, tuple):
+                    raise ValueError(
+                        "csv_parser needs named-column sources; "
+                        f"{f.name} reads {src}")
+                raw = row.get(src, "")
+                needs_string = (
+                    (isinstance(f, Hashed) and f.strings)
+                    or (isinstance(f, Lookup) and f.strings)
+                )
+                typed[src] = raw if needs_string else float(raw or 0)
+            return self.transform_row(typed), label_fn(row)
+
+        return parse

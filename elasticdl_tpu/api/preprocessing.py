@@ -82,10 +82,16 @@ def concat_with_offset(id_groups: Sequence[jax.Array], sizes: Sequence[int]):
 def int_lookup(values, vocab: Sequence[int], num_oov: int = 1):
     """Device-side IndexLookup over a static integer vocabulary.
 
-    Maps vocab[i] → num_oov + i; everything else hashes into [0, num_oov).
+    Maps vocab[i] → num_oov + i IN DECLARATION ORDER (matching the string
+    StringLookup twin — a vocab declared hot-ids-first keeps that layout in
+    the embedding table); everything else hashes into [0, num_oov). The
+    search runs over a sorted copy with a position→declaration-index
+    permutation applied after.
     """
-    v = np.sort(np.asarray(vocab, np.int32))
-    sorted_vocab = jnp.asarray(v)
+    v = np.asarray(vocab, np.int32)
+    order = np.argsort(v, kind="stable")
+    sorted_vocab = jnp.asarray(v[order])
+    decl_idx = jnp.asarray(order.astype(np.int32))
     x = jnp.asarray(values, jnp.int32)
     pos = jnp.searchsorted(sorted_vocab, x)
     pos_c = jnp.clip(pos, 0, len(v) - 1)
@@ -95,7 +101,7 @@ def int_lookup(values, vocab: Sequence[int], num_oov: int = 1):
         if num_oov > 0
         else jnp.zeros_like(pos_c, jnp.int32)
     )
-    return jnp.where(found, pos_c.astype(jnp.int32) + num_oov, oov)
+    return jnp.where(found, decl_idx[pos_c] + num_oov, oov)
 
 
 # ---------------------------------------------------------------------- #

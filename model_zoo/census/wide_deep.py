@@ -2,13 +2,16 @@
 Wide&Deep, PS-style sharded embeddings").
 
 Reference parity: the reference's census zoo model (model_zoo/census_*,
-built from feature columns + elasticdl_preprocessing layers). Rebuilt with
-the TPU-first preprocessing split: string columns are hashed/looked-up on the
-HOST in dataset_fn (XLA has no strings); the model receives
+built from feature columns + elasticdl_preprocessing layers). Features are
+DECLARED as a FeatureSpec (api/feature_spec.py — the declarative
+elasticdl_preprocessing equivalent) and compiled into the TPU-first split:
+string columns hash/look up on the HOST in dataset_fn (XLA has no strings),
+numerics normalize and the age column bucketizes in the numpy composition.
+The model receives
   "dense": (B, 5)  normalized numerics (age, education_num, capital_gain,
            capital_loss, hours_per_week)
-  "cat":   (B, 9)  int32 ids, one per categorical column (one shared id
-           space, offset per column — ConcatenateWithOffset)
+  "cat":   (B, 9)  int32 ids in ONE shared id space (per-feature offsets —
+           ConcatenateWithOffset), SPEC.total_vocab rows
 Wide = one linear weight per id (an output_dim-1 sharded Embedding, exactly
 the PS-tier wide column of the reference); Deep = D-dim embeddings + MLP.
 """
@@ -20,33 +23,34 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.api import feature_spec as fs
 from elasticdl_tpu.api.layers import Embedding
-from elasticdl_tpu.api import preprocessing as pp
 from elasticdl_tpu.training import metrics as metrics_lib
 
-# (name, hash buckets) per categorical column; one shared, offset id space.
-CAT_COLUMNS = (
-    ("workclass", 64),
-    ("education", 64),
-    ("marital_status", 32),
-    ("occupation", 128),
-    ("relationship", 32),
-    ("race", 16),
-    ("sex", 8),
-    ("native_country", 128),
-    ("age_bucket", 16),
-)
-DENSE_COLUMNS = ("age", "education_num", "capital_gain", "capital_loss", "hours_per_week")
-# Means/stds of the UCI adult training split (fixed normalization statistics).
-DENSE_STATS = {
-    "age": (38.6, 13.6),
-    "education_num": (10.1, 2.6),
-    "capital_gain": (1078.0, 7385.0),
-    "capital_loss": (87.3, 403.0),
-    "hours_per_week": (40.4, 12.3),
-}
 AGE_BOUNDARIES = (18, 25, 30, 35, 40, 45, 50, 55, 60, 65)
-TOTAL_VOCAB = sum(size for _, size in CAT_COLUMNS)
+
+# The whole tabular schema as data. Means/stds are fixed statistics of the
+# UCI adult training split; hash sizes match the reference zoo's buckets.
+# Categorical DECLARATION ORDER fixes the shared-id-space offsets (and so
+# the embedding-table layout in checkpoints) — append new features at the
+# end.
+SPEC = fs.FeatureSpec([
+    fs.numeric("age", standardize=(38.6, 13.6)),
+    fs.numeric("education_num", standardize=(10.1, 2.6)),
+    fs.numeric("capital_gain", standardize=(1078.0, 7385.0)),
+    fs.numeric("capital_loss", standardize=(87.3, 403.0)),
+    fs.numeric("hours_per_week", standardize=(40.4, 12.3)),
+    fs.hashed("workclass", 64, strings=True),
+    fs.hashed("education", 64, strings=True),
+    fs.hashed("marital_status", 32, strings=True),
+    fs.hashed("occupation", 128, strings=True),
+    fs.hashed("relationship", 32, strings=True),
+    fs.hashed("race", 16, strings=True),
+    fs.hashed("sex", 8, strings=True),
+    fs.hashed("native_country", 128, strings=True),
+    fs.bucketized("age_bucket", AGE_BOUNDARIES, source="age"),
+])
+TOTAL_VOCAB = SPEC.total_vocab
 
 
 class WideDeep(nn.Module):
@@ -105,41 +109,13 @@ _CSV_COLUMNS = (
 
 
 def dataset_fn(mode, metadata):
-    """Parse one adult-census CSV line into the model's feature dict.
-
-    Host-side preprocessing: string hashing (crc32), age bucketization,
-    fixed-stat normalization, per-column id offsets.
-    """
-    col_offset = {}
-    off = 0
-    for name, size in CAT_COLUMNS:
-        col_offset[name] = (off, size)
-        off += size
-
-    def parse(record: bytes):
-        parts = [p.strip() for p in record.decode("utf-8").rstrip("\n").split(",")]
-        row = dict(zip(_CSV_COLUMNS, parts))
-        label = np.int32(1 if ">50K" in row.get("label", "") else 0)
-
-        dense = np.array(
-            [
-                (float(row.get(c, 0) or 0) - DENSE_STATS[c][0]) / DENSE_STATS[c][1]
-                for c in DENSE_COLUMNS
-            ],
-            np.float32,
-        )
-        ids = []
-        for name, size in CAT_COLUMNS:
-            base, _ = col_offset[name]
-            if name == "age_bucket":
-                age = float(row.get("age", 0) or 0)
-                bucket = int(np.searchsorted(AGE_BOUNDARIES, age, side="right"))
-            else:
-                bucket = int(pp.hash_strings([row.get(name, "")], size)[0])
-            ids.append(base + bucket)
-        return {"dense": dense, "cat": np.array(ids, np.int32)}, label
-
-    return parse
+    """Parse one adult-census CSV line into the model's feature dict —
+    entirely generated from SPEC (csv_parser compiles the spec's host+
+    numpy halves into the per-record parser)."""
+    return SPEC.csv_parser(
+        _CSV_COLUMNS,
+        label_fn=lambda row: np.int32(1 if ">50K" in row.get("label", "") else 0),
+    )
 
 
 def eval_metrics_fn():

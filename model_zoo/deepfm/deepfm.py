@@ -14,6 +14,7 @@ Input features:
 Labels: (B,) {0,1} click. Output: (B,) logits.
 """
 
+import functools
 from typing import Sequence, Tuple
 
 import flax.linen as nn
@@ -21,12 +22,27 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from elasticdl_tpu.api import feature_spec as fs
 from elasticdl_tpu.api.layers import Embedding
-from elasticdl_tpu.api import preprocessing as pp
 from elasticdl_tpu.training import metrics as metrics_lib
 
 NUM_DENSE = 13
 NUM_CAT = 26
+
+
+@functools.lru_cache(maxsize=None)
+def feature_spec(field_vocab: int) -> fs.FeatureSpec:
+    """The Criteo schema as data: 13 log-squashed integer counts + 26
+    device-hashed categorical fields sharing one offset id space of
+    NUM_CAT * field_vocab rows. All sources are packed-array columns, so
+    the WHOLE spec runs as the device half inside the jitted step (zero
+    host preprocessing beyond wire decode)."""
+    return fs.FeatureSpec(
+        [fs.numeric(f"i{j}", log1p=True, source=("dense", j))
+         for j in range(NUM_DENSE)]
+        + [fs.hashed(f"c{j}", field_vocab, source=("cat", j))
+           for j in range(NUM_CAT)]
+    )
 
 
 class DeepFM(nn.Module):
@@ -39,11 +55,12 @@ class DeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, feats, training: bool = False):
-        dense = pp.log_normalize(feats["dense"])                  # (B, 13)
-        hashed = pp.hash_bucket(feats["cat"], self.field_vocab)   # (B, 26)
-        offsets = jnp.arange(NUM_CAT, dtype=jnp.int32) * self.field_vocab
-        ids = hashed + offsets[None, :]                           # shared id space
-        vocab = NUM_CAT * self.field_vocab
+        # the declared Criteo spec IS the in-model transform: log1p dense,
+        # per-field hash + shared-id-space offsets, fused into the step
+        spec = feature_spec(self.field_vocab)
+        t = spec.device_transform({"dense": feats["dense"], "cat": feats["cat"]})
+        dense, ids = t["dense"], t["cat"]                         # (B,13) (B,26)
+        vocab = spec.total_vocab
 
         emb = Embedding(
             vocab, self.embedding_dim, mode=self.embedding_mode, name="fm_embedding"

@@ -51,15 +51,17 @@ class XDeepFM(nn.Module):
 
     @nn.compact
     def __call__(self, feats, training: bool = False):
-        from elasticdl_tpu.api import preprocessing as pp
         from elasticdl_tpu.api.layers import Embedding
+        from model_zoo.deepfm.deepfm import feature_spec
 
         base = self.base
-        dense = pp.log_normalize(feats["dense"])
-        hashed = pp.hash_bucket(feats["cat"], base.field_vocab)
-        offsets = jnp.arange(NUM_CAT, dtype=jnp.int32) * base.field_vocab
-        ids = hashed + offsets[None, :]
-        vocab = NUM_CAT * base.field_vocab
+        # same declared Criteo spec as DeepFM: identical id space, so the
+        # two models share checkpoints' table geometry
+        spec = feature_spec(base.field_vocab)
+        t = spec.device_transform(
+            {"dense": feats["dense"], "cat": feats["cat"]})
+        dense, ids = t["dense"], t["cat"]
+        vocab = spec.total_vocab
 
         emb = Embedding(
             vocab, base.embedding_dim, mode=base.embedding_mode, name="embedding"
