@@ -1,0 +1,143 @@
+"""The north star's two halves composed in one CPU-provable artifact
+(VERDICT r4 next #2 / BASELINE.json): a miniature Criteo DeepFM cohort
+reaches its AUC target while surviving TWO injected member kills, with
+exactly-once task accounting (no record loss), checkpoint-resume across
+re-formations, and the recovery wall-clock overhead measured and reported.
+"""
+
+import glob
+import os
+import re
+import time
+
+import pytest
+
+from elasticdl_tpu.client.local import free_port
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.master.main import Master
+from elasticdl_tpu.master.process_manager import ProcessManager
+
+HERMETIC_ENV = {
+    "PALLAS_AXON_POOL_IPS": "",
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    "EDL_LOG_LEVEL": "INFO",
+}
+
+AUC_TARGET = 0.70   # the learnable synthetic stream passes 0.75 quickly;
+                    # 0.70 keeps the assert robust to the short run
+
+
+def test_elastic_time_to_auc_survives_two_kills(tmp_path):
+    n_tasks = 8
+    cfg = JobConfig(
+        job_name="elastic-auc",
+        model_zoo=os.path.abspath("model_zoo"),
+        model_def="deepfm.deepfm.custom_model",
+        model_params={"field_vocab": 64, "hidden": "32,32"},
+        training_data="synthetic://criteo?n=16384&shards=8",
+        validation_data="synthetic://criteo?n=1024&shards=1",
+        records_per_task=2048,
+        minibatch_size=64,
+        num_epochs=1,
+        evaluation_steps=64,    # model-version steps between eval triggers
+        num_workers=1,
+        num_processes=2,
+        master_addr=f"localhost:{free_port()}",
+        worker_heartbeat_s=1.0,
+        task_timeout_s=300.0,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=16,
+        shuffle=False,
+    )
+    master = Master(cfg)
+    manager = ProcessManager(
+        cfg,
+        membership=master.membership,
+        extra_env=HERMETIC_ENV,
+        log_dir=str(tmp_path / "logs"),
+        job_finished_fn=master.dispatcher.finished,
+        checkpoint_request_fn=lambda: master.servicer.request_checkpoint(0),
+    )
+    # Per-kill state machine: killed -> world_dead (the whole cohort has
+    # been declared dead: alive_count()==0 — a SIGKILLed member takes the
+    # leader down by cohort co-death, surfaced by heartbeat lapse) ->
+    # recovered (a RE-FORMED cohort's leader joined: alive again AFTER the
+    # death was observed). alive_count() alone is not a recovery signal:
+    # the stale leader keeps counting as alive for the heartbeat timeout
+    # right after the kill.
+    kills = []          # [{"t_kill", "t_dead", "t_rec"}]
+    kill_after = [1, 4]  # finished-task thresholds for kill #1 and #2
+
+    def observer():
+        if kills and kills[-1]["t_rec"] is None:
+            if kills[-1]["t_dead"] is None:
+                if master.membership.alive_count() == 0:
+                    kills[-1]["t_dead"] = time.time()
+            elif master.membership.alive_count() > 0:
+                kills[-1]["t_rec"] = time.time()
+            return   # a kill is in flight: never overlap the second one
+        if len(kills) < len(kill_after):
+            done = master.dispatcher.counts()["finished_training"]
+            if done >= kill_after[len(kills)]:
+                wp = manager._procs.get(1)
+                if wp is not None and wp.proc.poll() is None:
+                    wp.proc.kill()
+                    kills.append(
+                        {"t_kill": time.time(), "t_dead": None, "t_rec": None}
+                    )
+
+    master.start()
+    manager.start_workers()
+    t0 = time.time()
+    try:
+        deadline = time.time() + 900
+        while not master.dispatcher.finished() and time.time() < deadline:
+            master.membership.reap()
+            master.dispatcher.poke()
+            observer()
+            time.sleep(0.2)
+        counts = master.dispatcher.counts()
+        assert master.dispatcher.finished(), counts
+        wall_s = time.time() - t0
+        results = master.evaluation.latest_results()
+    finally:
+        master.shutdown()
+        manager.stop()
+
+    # exactly-once accounting: every task retired exactly once, none lost,
+    # none failed permanently — the "no record loss" half of the proof
+    assert counts["finished_training"] == n_tasks, counts
+    assert counts["failed_permanently"] == 0, counts
+
+    # both kills fired, both worlds died, both cohorts re-formed
+    assert len(kills) == 2, kills
+    assert all(k["t_dead"] and k["t_rec"] for k in kills), kills
+    # recovery overhead: kill -> re-formed leader registered, summed
+    overhead_s = sum(k["t_rec"] - k["t_kill"] for k in kills)
+
+    log = "".join(
+        open(f, errors="replace").read()
+        for f in sorted(glob.glob(str(tmp_path / "logs" / "*.log")))
+    )
+    # two re-formations: worlds v1 and v2 came up after v0
+    for v in (0, 1, 2):
+        assert f"distributed world v{v} up" in log, f"world v{v} missing"
+    # monotone resume: every restore picks up at a strictly positive step,
+    # and the sequence of resumed steps never regresses (checkpoint
+    # monotonicity across generations)
+    resumed = [int(s) for s in
+               re.findall(r"cohort resumed from checkpoint at step (\d+)", log)]
+    assert resumed, "no resume-from-checkpoint after kills"
+    assert all(s > 0 for s in resumed), resumed
+    assert resumed == sorted(resumed), f"step regression: {resumed}"
+
+    # the north-star gate: eval AUC reached the target despite 2 kills
+    auc = results.get("auc")
+    assert auc is not None and auc >= AUC_TARGET, results
+
+    print(
+        '\n[elastic-time-to-auc] {"auc_reached": true, "auc": %.4f, '
+        '"kills": 2, "overhead_s": %.2f, "wall_s": %.2f, '
+        '"resumed_steps": %s}' % (auc, overhead_s, wall_s, resumed)
+    )
