@@ -23,9 +23,11 @@ COMPILES into two halves instead of a layer graph:
 (census CSV) and host-only pipelines; both halves agree bit-for-bit on the
 integer id spaces (tests pin host==device).
 
-Out of scope (kept in api/preprocessing.py for direct use): ragged bag
-inputs (`pad_to_dense` + Embedding combiners) — static-width bags are a
-model-shape decision, not a column transform.
+Ragged multi-valued columns are declared as BAG features (`hashed_bag` /
+`lookup_bag`): the host half resolves each ragged row to a fixed-width
+(B, max_len) int32 bag with -1 pads (`pad_to_dense`), which Embedding's
+combiner consumes directly — the ToSparse/ToRagged path. Bags keep their
+own id space (own table per bag) rather than joining the shared offsets.
 """
 
 from __future__ import annotations
@@ -128,7 +130,71 @@ class Lookup:
         return bool(self.vocab) and isinstance(self.vocab[0], (str, bytes))
 
 
-FeatureDef = Union[Numeric, Bucketized, Hashed, Lookup]
+@dataclass(frozen=True)
+class HashedBag:
+    """Multi-valued (ragged) categorical → fixed-width padded id bag.
+    Reference parity: ToSparse/ToRagged + Hashing feeding an embedding
+    with a combiner. XLA needs static shapes, so the ragged bag becomes a
+    (B, max_len) int32 row with -1 pads — exactly what Embedding's
+    combiner treats as padding. Bags keep their OWN id space [0, num_bins)
+    (own embedding table per bag), so they don't join the shared offset
+    space. Resolution is inherently host-side (ragged → static)."""
+
+    name: str
+    num_bins: int
+    max_len: int
+    strings: bool = False
+    delimiter: str = "|"
+    source: Optional[Source] = None
+
+    size = property(lambda self: self.num_bins)
+    src = property(lambda self: self.name if self.source is None else self.source)
+
+    def elem_ids(self, elems, lookup=None) -> np.ndarray:
+        del lookup   # stateless hash; signature shared with LookupBag
+        if not len(elems):
+            return np.empty((0,), np.int32)
+        if self.strings:
+            return pp.hash_strings(list(elems), self.num_bins)
+        return _np_hash_bucket(
+            np.asarray(list(elems)).astype(np.int32), self.num_bins)
+
+
+@dataclass(frozen=True)
+class LookupBag:
+    """HashedBag's vocabulary twin: elements map vocab[i] → num_oov + i in
+    declaration order, unknowns hash into [0, num_oov)."""
+
+    name: str
+    vocab: Tuple[Any, ...]
+    max_len: int
+    num_oov: int = 1
+    delimiter: str = "|"
+    source: Optional[Source] = None
+
+    size = property(lambda self: len(self.vocab) + self.num_oov)
+    src = property(lambda self: self.name if self.source is None else self.source)
+
+    @property
+    def strings(self) -> bool:
+        return bool(self.vocab) and isinstance(self.vocab[0], (str, bytes))
+
+    def elem_ids(self, elems, lookup=None) -> np.ndarray:
+        if not len(elems):
+            return np.empty((0,), np.int32)
+        if self.strings:
+            # `lookup` is the spec's per-feature cached StringLookup —
+            # building one per row would rebuild a |vocab| table per record
+            table = lookup if lookup is not None else pp.StringLookup(
+                [v if isinstance(v, str) else v.decode("utf-8")
+                 for v in self.vocab], self.num_oov)
+            return table(list(elems))
+        return _np_int_lookup(
+            np.asarray(list(elems)).astype(np.int32), self.vocab, self.num_oov)
+
+
+BagFeature = Union[HashedBag, LookupBag]
+FeatureDef = Union[Numeric, Bucketized, Hashed, Lookup, HashedBag, LookupBag]
 
 
 def numeric(name: str, *, standardize: Optional[Tuple[float, float]] = None,
@@ -153,6 +219,20 @@ def hashed(name: str, num_bins: int, *, strings: bool = False,
 def lookup(name: str, vocab: Sequence[Any], *, num_oov: int = 1,
            source: Optional[Source] = None) -> Lookup:
     return Lookup(name, tuple(vocab), int(num_oov), source)
+
+
+def hashed_bag(name: str, num_bins: int, max_len: int, *,
+               strings: bool = False, delimiter: str = "|",
+               source: Optional[Source] = None) -> HashedBag:
+    return HashedBag(name, int(num_bins), int(max_len), strings, delimiter,
+                     source)
+
+
+def lookup_bag(name: str, vocab: Sequence[Any], max_len: int, *,
+               num_oov: int = 1, delimiter: str = "|",
+               source: Optional[Source] = None) -> LookupBag:
+    return LookupBag(name, tuple(vocab), int(max_len), int(num_oov),
+                     delimiter, source)
 
 
 def _np_hash_bucket(ids, num_bins: int) -> np.ndarray:
@@ -187,7 +267,10 @@ class FeatureSpec:
     Output contract (the shape every tabular zoo model consumes):
       {"dense": (B, dense_dim) float32,
        "cat":   (B, cat_dim)   int32 in ONE shared id space of
-                `total_vocab` rows (per-feature offsets applied)}
+                `total_vocab` rows (per-feature offsets applied),
+       "bags":  {name: (B, max_len) int32, pad=-1} — only when bag
+                features are declared; each bag keeps its own id space of
+                `feature.size` rows (own embedding + combiner)}
     """
 
     def __init__(self, features: Sequence[FeatureDef]):
@@ -199,8 +282,11 @@ class FeatureSpec:
         self.features = tuple(features)
         self.dense_features = tuple(
             f for f in features if isinstance(f, Numeric))
+        self.bag_features = tuple(
+            f for f in features if isinstance(f, (HashedBag, LookupBag)))
         self.cat_features = tuple(
-            f for f in features if not isinstance(f, Numeric))
+            f for f in features
+            if not isinstance(f, (Numeric, HashedBag, LookupBag)))
         self.dense_dim = len(self.dense_features)
         self.cat_dim = len(self.cat_features)
         self.offsets: Dict[str, int] = {}
@@ -213,9 +299,28 @@ class FeatureSpec:
             f.name: pp.StringLookup(
                 [v if isinstance(v, str) else v.decode("utf-8")
                  for v in f.vocab], f.num_oov)
-            for f in self.cat_features
-            if isinstance(f, Lookup) and f.strings
+            for f in self.cat_features + self.bag_features
+            if isinstance(f, (Lookup, LookupBag)) and f.strings
         }
+
+    def _resolve_bag(self, f: BagFeature, x) -> np.ndarray:
+        """Ragged column → (B, max_len) padded ids. Accepts rows that are
+        sequences (lists/arrays), delimiter-joined strings, or bare
+        scalars (single-element bag); None/NaN/empty → all-pad row."""
+        lookup = self._host_lookups.get(f.name)
+        rows = []
+        for r in np.asarray(x, dtype=object).reshape(-1):
+            if r is None or (isinstance(r, float) and np.isnan(r)):
+                elems = []
+            elif isinstance(r, (str, bytes)):
+                s = r.decode("utf-8") if isinstance(r, bytes) else r
+                elems = [e.strip() for e in s.split(f.delimiter) if e.strip()]
+            elif np.isscalar(r):
+                elems = [r]
+            else:
+                elems = list(r)
+            rows.append(f.elem_ids(elems, lookup))
+        return pp.pad_to_dense(rows, f.max_len)
 
     # ------------------------------------------------------------------ #
     # host half
@@ -227,7 +332,9 @@ class FeatureSpec:
         out: Dict[str, np.ndarray] = {}
         for f in self.features:
             x = _col(cols, f.src)
-            if isinstance(f, Hashed) and f.strings:
+            if isinstance(f, (HashedBag, LookupBag)):
+                out[f.name] = self._resolve_bag(f, x)   # ragged → static
+            elif isinstance(f, Hashed) and f.strings:
                 out[f.name] = pp.hash_strings(x, f.num_bins)
             elif isinstance(f, Lookup) and f.strings:
                 out[f.name] = self._host_lookups[f.name](x)
@@ -278,6 +385,18 @@ class FeatureSpec:
             out["dense"] = jnp.stack(dense, axis=-1)
         if cat:
             out["cat"] = jnp.stack(cat, axis=-1)
+        if self.bag_features:
+            # bags are host-resolved (ragged → static is host work); the
+            # device half only casts — keeping one output contract
+            for f in self.bag_features:
+                if f.name not in inter:
+                    raise ValueError(
+                        f"bag feature {f.name!r} needs host_transform "
+                        "before device_transform")
+            out["bags"] = {
+                f.name: jnp.asarray(inter[f.name], jnp.int32)
+                for f in self.bag_features
+            }
         return out
 
     # ------------------------------------------------------------------ #
@@ -305,13 +424,35 @@ class FeatureSpec:
             out["dense"] = np.stack(dense, axis=-1).astype(np.float32)
         if cat:
             out["cat"] = np.stack(cat, axis=-1).astype(np.int32)
+        if self.bag_features:
+            out["bags"] = {f.name: inter[f.name] for f in self.bag_features}
         return out
 
     def transform_row(self, row: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        """One record (dict of scalars) → {"dense": (n,), "cat": (m,)}."""
-        cols = {k: np.asarray([v]) for k, v in row.items()}
-        out = self.transform(cols)
-        return {k: v[0] for k, v in out.items()}
+        """One record (dict of scalars; bag cells may be lists or
+        delimiter-joined strings; packed sources take the full row
+        sequence) → {"dense": (n,), "cat": (m,)} (+ "bags":
+        {name: (max_len,)} when declared)."""
+        bag_srcs = {f.src for f in self.bag_features
+                    if isinstance(f.src, str)}
+
+        def one(k, v):
+            if k in bag_srcs:
+                # a single-slot object array holds a list/str bag cell
+                # intact (np.asarray([list]) would promote it to a 2-D
+                # row and _resolve_bag would see elements as rows)
+                a = np.empty((1,), dtype=object)
+                a[0] = v
+                return a
+            # non-bag cells: plain batch-of-one — a sequence cell becomes
+            # the (1, width) row that packed ("key", j) sources index
+            return np.asarray([v])
+
+        out = self.transform({k: one(k, v) for k, v in row.items()})
+        return {
+            k: ({n: b[0] for n, b in v.items()} if k == "bags" else v[0])
+            for k, v in out.items()
+        }
 
     # ------------------------------------------------------------------ #
     # CSV convenience: spec + column order -> reader parse function
@@ -339,7 +480,9 @@ class FeatureSpec:
                         f"{f.name} reads {src}")
                 raw = row.get(src, "")
                 needs_string = (
-                    (isinstance(f, Hashed) and f.strings)
+                    isinstance(f, (HashedBag, LookupBag))  # split later by
+                    # the bag's own delimiter in _resolve_bag
+                    or (isinstance(f, Hashed) and f.strings)
                     or (isinstance(f, Lookup) and f.strings)
                 )
                 typed[src] = raw if needs_string else float(raw or 0)
