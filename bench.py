@@ -378,6 +378,11 @@ def bench_embedding_modes(mesh, np):
             if bw_assumed:
                 r["peak_hbm_assumed"] = True
                 r["device_kind"] = kind
+            # the utilization is against the ANALYTIC minimum bytes/row
+            # model above, not measured traffic — a low number means the
+            # engine is far from the roof, a high one is still only a
+            # lower bound on real HBM activity (VERDICT r4 weak #8)
+            r["hbm_bytes_model"] = "analytic-min"
     return results
 
 

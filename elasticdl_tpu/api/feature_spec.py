@@ -150,8 +150,7 @@ class HashedBag:
     size = property(lambda self: self.num_bins)
     src = property(lambda self: self.name if self.source is None else self.source)
 
-    def elem_ids(self, elems, lookup=None) -> np.ndarray:
-        del lookup   # stateless hash; signature shared with LookupBag
+    def elem_ids(self, elems) -> np.ndarray:
         if not len(elems):
             return np.empty((0,), np.int32)
         if self.strings:
@@ -179,16 +178,23 @@ class LookupBag:
     def strings(self) -> bool:
         return bool(self.vocab) and isinstance(self.vocab[0], (str, bytes))
 
-    def elem_ids(self, elems, lookup=None) -> np.ndarray:
+    def _table(self) -> "pp.StringLookup":
+        """Per-instance cached StringLookup (frozen dataclass, so cache
+        through object.__setattr__) — building the |vocab| dict once, not
+        per row."""
+        t = getattr(self, "_cached_table", None)
+        if t is None:
+            t = pp.StringLookup(
+                [v if isinstance(v, str) else v.decode("utf-8")
+                 for v in self.vocab], self.num_oov)
+            object.__setattr__(self, "_cached_table", t)
+        return t
+
+    def elem_ids(self, elems) -> np.ndarray:
         if not len(elems):
             return np.empty((0,), np.int32)
         if self.strings:
-            # `lookup` is the spec's per-feature cached StringLookup —
-            # building one per row would rebuild a |vocab| table per record
-            table = lookup if lookup is not None else pp.StringLookup(
-                [v if isinstance(v, str) else v.decode("utf-8")
-                 for v in self.vocab], self.num_oov)
-            return table(list(elems))
+            return self._table()(list(elems))
         return _np_int_lookup(
             np.asarray(list(elems)).astype(np.int32), self.vocab, self.num_oov)
 
@@ -299,18 +305,20 @@ class FeatureSpec:
             f.name: pp.StringLookup(
                 [v if isinstance(v, str) else v.decode("utf-8")
                  for v in f.vocab], f.num_oov)
-            for f in self.cat_features + self.bag_features
-            if isinstance(f, (Lookup, LookupBag)) and f.strings
+            for f in self.cat_features
+            if isinstance(f, Lookup) and f.strings
         }
 
-    def _resolve_bag(self, f: BagFeature, x) -> np.ndarray:
+    @staticmethod
+    def _resolve_bag(f: BagFeature, x) -> np.ndarray:
         """Ragged column → (B, max_len) padded ids. Accepts rows that are
         sequences (lists/arrays), delimiter-joined strings, or bare
-        scalars (single-element bag); None/NaN/empty → all-pad row."""
-        lookup = self._host_lookups.get(f.name)
+        scalars (single-element bag); None/NaN/empty → all-pad row.
+        (LookupBag caches its own StringLookup per instance.)"""
         rows = []
         for r in np.asarray(x, dtype=object).reshape(-1):
-            if r is None or (isinstance(r, float) and np.isnan(r)):
+            if r is None or (isinstance(r, (float, np.floating))
+                             and np.isnan(r)):
                 elems = []
             elif isinstance(r, (str, bytes)):
                 s = r.decode("utf-8") if isinstance(r, bytes) else r
@@ -319,7 +327,7 @@ class FeatureSpec:
                 elems = [r]
             else:
                 elems = list(r)
-            rows.append(f.elem_ids(elems, lookup))
+            rows.append(f.elem_ids(elems))
         return pp.pad_to_dense(rows, f.max_len)
 
     # ------------------------------------------------------------------ #

@@ -361,13 +361,23 @@ def test_transform_row_packed_sources_and_scalar_bag_cells():
     assert out["bags"]["ids"][1][0] >= 0
 
 
-def test_lookup_bag_uses_specs_cached_lookup():
-    """The spec builds ONE StringLookup per string LookupBag (not one per
-    row) — pinned by checking the cached instance exists and resolves."""
-    spec = fs.FeatureSpec([
-        fs.lookup_bag("tags", ("a", "b"), max_len=2),
-    ])
-    assert "tags" in spec._host_lookups
+def test_lookup_bag_caches_its_string_table():
+    """A string LookupBag builds ONE StringLookup per feature instance
+    (not one per row) — pinned by object identity across calls."""
+    bag = fs.lookup_bag("tags", ("a", "b"), max_len=2)
+    spec = fs.FeatureSpec([bag])
     out = spec.transform({"tags": np.array(["b|a", "a"], dtype=object)})
     np.testing.assert_array_equal(out["bags"]["tags"],
                                   [[1 + 1, 1 + 0], [1 + 0, -1]])
+    assert bag._table() is bag._table()
+
+
+def test_bag_nan_float32_is_all_pad():
+    """Code-review r5 round 3: np.float32 NaN cells (float32 pandas/
+    parquet columns) must pad out like None, not cast INT_MIN into a
+    real embedding id."""
+    spec = fs.FeatureSpec([fs.hashed_bag("ids", 32, max_len=2)])
+    out = spec.transform(
+        {"ids": np.array([np.float32("nan"), 5], dtype=object)})
+    np.testing.assert_array_equal(out["bags"]["ids"][0], [-1, -1])
+    assert out["bags"]["ids"][1][0] >= 0
