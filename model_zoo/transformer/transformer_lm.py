@@ -31,34 +31,70 @@ from elasticdl_tpu.ops.attention import sequence_parallel_attention
 from elasticdl_tpu.training import metrics as metrics_lib
 
 
+def _tp_dense(feats, dtype, name, tp_axis, split):
+    """Dense with Megatron-style tensor-parallel kernel annotations.
+
+    split="col": kernel P(None, tp) + bias P(tp) — output features shard
+    over the tp axis (qkv heads, MLP hidden). split="row": kernel
+    P(tp, None), bias replicated — the matmul consumes tp-sharded inputs
+    and produces PARTIAL sums; GSPMD inserts the all-reduce over tp (the
+    hand-written psum of a Megatron layer). tp_axis="" → plain Dense.
+    """
+    if not tp_axis:
+        return nn.Dense(feats, dtype=dtype, name=name)
+    if split == "col":
+        kernel_names, bias_names = (None, tp_axis), (tp_axis,)
+    else:
+        kernel_names, bias_names = (tp_axis, None), (None,)
+    return nn.Dense(
+        feats,
+        dtype=dtype,
+        kernel_init=nn.with_partitioning(
+            nn.initializers.lecun_normal(), kernel_names),
+        bias_init=nn.with_partitioning(nn.initializers.zeros, bias_names),
+        name=name,
+    )
+
+
 class Block(nn.Module):
     dim: int
     heads: int
     compute_dtype: jnp.dtype
     seq_parallel: str
     dropout: float
+    tp_axis: str = ""
 
     @nn.compact
     def __call__(self, x, training: bool):
         B, T, C = x.shape
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        qkv = nn.Dense(3 * C, dtype=self.compute_dtype, name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        # SEPARATE q/k/v projections, not a fused 3C Dense: a fused
+        # column-split kernel shards at 3C/tp boundaries, which straddle
+        # the q|k|v splits (e.g. tp=4, C=64: q = cols [0,64) spans two
+        # shards), forcing GSPMD to reshard activations before attention.
+        # Per-projection col-split shards land on head boundaries, so
+        # attention runs head-parallel with zero comm (scores never cross
+        # heads). heads must divide by the tp axis size.
+        q = _tp_dense(C, self.compute_dtype, "q", self.tp_axis, "col")(h)
+        k = _tp_dense(C, self.compute_dtype, "k", self.tp_axis, "col")(h)
+        v = _tp_dense(C, self.compute_dtype, "v", self.tp_axis, "col")(h)
         shape = (B, T, self.heads, C // self.heads)
         attn = sequence_parallel_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             causal=True, mode=self.seq_parallel,
         )
-        h = nn.Dense(C, dtype=self.compute_dtype, name="proj")(
+        h = _tp_dense(C, self.compute_dtype, "proj", self.tp_axis, "row")(
             attn.reshape(B, T, C)
         )
         if training and self.dropout > 0:
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        h = nn.Dense(4 * C, dtype=self.compute_dtype, name="mlp_in")(h)
+        h = _tp_dense(4 * C, self.compute_dtype, "mlp_in",
+                      self.tp_axis, "col")(h)
         h = nn.gelu(h)
-        h = nn.Dense(C, dtype=self.compute_dtype, name="mlp_out")(h)
+        h = _tp_dense(C, self.compute_dtype, "mlp_out",
+                      self.tp_axis, "row")(h)
         return x + h
 
 
@@ -71,6 +107,9 @@ class TransformerLM(nn.Module):
     compute_dtype: jnp.dtype
     seq_parallel: str   # "ring" | "ulysses" (used when the mesh has a seq axis)
     dropout: float
+    tp_axis: str = ""   # mesh axis for Megatron-style tensor parallelism
+                        # ("" = off; typically "model"). heads must divide
+                        # by the axis size.
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -84,10 +123,12 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             x = Block(
                 self.dim, self.heads, self.compute_dtype,
-                self.seq_parallel, self.dropout, name=f"block_{i}",
+                self.seq_parallel, self.dropout, tp_axis=self.tp_axis,
+                name=f"block_{i}",
             )(x, training)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        logits = nn.Dense(self.vocab, dtype=jnp.float32, name="lm_head")(x)
+        logits = _tp_dense(self.vocab, jnp.float32, "lm_head",
+                           self.tp_axis, "col")(x)
         return logits                                       # (B, T, vocab) f32
 
 
@@ -101,6 +142,7 @@ def custom_model(**kwargs) -> TransformerLM:
         compute_dtype=jnp.dtype(kwargs.get("compute_dtype", "bfloat16")),
         seq_parallel=str(kwargs.get("seq_parallel", "ring")),
         dropout=float(kwargs.get("dropout", 0.0)),
+        tp_axis=str(kwargs.get("tp_axis", "")),
     )
 
 

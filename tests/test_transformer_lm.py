@@ -117,3 +117,76 @@ def test_remat_accum_with_flash_kernel(reader, monkeypatch):
         plain = first_loss()
         knobs = first_loss(remat_policy="dots", grad_accum=2)
     assert knobs == pytest.approx(plain, rel=1e-4), (plain, knobs)
+
+
+def test_tensor_parallel_matches_replicated(reader):
+    """Megatron-style TP (tp_axis=model): same seed, same batch, one train
+    step — loss and (gathered) params must match the replicated run, with
+    kernels actually sharded over the model axis. GSPMD inserts the
+    row-split partial-sum all-reduce the hand-written Megatron psum would
+    do."""
+    base = dict(seq_parallel="none", compute_dtype="float32")
+    spec_rep = make_spec(**base)
+    spec_tp = make_spec(**base, tp_axis="model")
+    mesh = build_mesh({"data": 2, "model": 4})
+
+    def one_step(spec):
+        trainer = Trainer(spec, mesh, seed=0)
+        batch = make_batch(spec, reader, 0)
+        state = trainer.init_state(batch)
+        state, logs = trainer.train_step(state, batch)
+        return state, float(logs["loss"])
+
+    state_rep, loss_rep = one_step(spec_rep)
+    state_tp, loss_tp = one_step(spec_tp)
+    assert loss_tp == pytest.approx(loss_rep, rel=1e-4)
+
+    # kernels are genuinely split over the model axis: col-split q and
+    # row-split mlp_out, each device holding 1/4 of the split dim
+    q = state_tp.params["block_0"]["q"]["kernel"]
+    assert "model" in tuple(q.sharding.spec), q.sharding.spec
+    assert q.sharding.shard_shape(q.shape)[1] == q.shape[1] // 4
+    mlp_out = state_tp.params["block_0"]["mlp_out"]["kernel"]
+    assert "model" in tuple(mlp_out.sharding.spec), mlp_out.sharding.spec
+
+    # params agree after one step (gather the tp shards)
+    for name in ("q", "k", "v", "mlp_in", "mlp_out", "proj"):
+        a = np.asarray(state_rep.params["block_0"][name]["kernel"])
+        b = np.asarray(state_tp.params["block_0"][name]["kernel"])
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5)
+
+
+def _compiled_step_collectives(spec, mesh, reader):
+    import jax
+
+    from elasticdl_tpu.parallel import mesh as mesh_lib
+    from tests.test_comm_structure import collective_sizes
+
+    trainer = Trainer(spec, mesh, seed=0)
+    batch = make_batch(spec, reader, 0)
+    state = trainer.init_state(batch)
+    state, _ = trainer.train_step(state, batch)   # builds the jitted step
+    sharded = mesh_lib.shard_batch(mesh, batch, spec.batch_partition)
+    with jax.set_mesh(mesh):
+        hlo = trainer._train_step.lower(state, sharded).compile().as_text()
+    return collective_sizes(hlo)
+
+
+def test_tensor_parallel_inserts_model_axis_collectives(reader):
+    """TP must actually distribute the matmuls: the compiled TP step
+    carries MORE reduction collectives than the replicated baseline (the
+    row-split partial-sum all-reduces over `model`, on top of the DP
+    gradient sync both versions share). A bare "has an all-reduce" check
+    would be vacuous — DP grad sync alone satisfies it."""
+    mesh = build_mesh({"data": 2, "model": 4})
+    base = dict(seq_parallel="none", compute_dtype="float32")
+    n_base = sum(
+        1 for op, _ in _compiled_step_collectives(make_spec(**base), mesh, reader)
+        if "all-reduce" in op or "reduce-scatter" in op
+    )
+    n_tp = sum(
+        1 for op, _ in _compiled_step_collectives(
+            make_spec(**base, tp_axis="model"), mesh, reader)
+        if "all-reduce" in op or "reduce-scatter" in op
+    )
+    assert n_tp > n_base, (n_tp, n_base)
