@@ -77,6 +77,7 @@ class MeshAxis:
     DATA = "data"   # batch dimension; DP gradient psum rides this axis
     MODEL = "model"  # embedding-table rows / any model-parallel dim
     SEQ = "seq"     # sequence/context parallelism (ring / Ulysses attention)
+    PIPE = "pp"     # pipeline parallelism (GPipe microbatch streaming)
 
 
 DEFAULT_MASTER_PORT = 50001
