@@ -98,6 +98,93 @@ class Block(nn.Module):
         return x + h
 
 
+class PipelinedBlocks(nn.Module):
+    """num_layers transformer blocks executed as a GPipe pipeline over the
+    `pp` mesh axis (parallel/pipeline.gpipe): per-layer params are STACKED
+    with a leading layer dim sharded P('pp', ...), and each pp shard runs
+    its resident layer while activations rotate along the ring.
+
+    The stage function must be a pure (params, activation) fn, so the
+    block math is hand-rolled here (LayerNorm + q/k/v/proj + MLP as
+    explicit params) instead of nested flax modules; full_attention is a
+    pure op and drops in directly. Falls back to a sequential loop over
+    the stacked layers when the mesh has no pp axis, so the same module
+    (and checkpoint) runs anywhere. Dropout is not supported inside the
+    pipeline (deterministic stages)."""
+
+    num_layers: int
+    dim: int
+    heads: int
+    compute_dtype: jnp.dtype
+    pp_axis: str = "pp"
+    num_microbatches: int = 4
+
+    @nn.compact
+    def __call__(self, x, training: bool):
+        from elasticdl_tpu.ops.attention import full_attention
+        from elasticdl_tpu.parallel.pipeline import gpipe
+
+        del training   # no dropout inside the pipeline
+        S, C = self.num_layers, self.dim
+
+        # mesh-agnostic like api.layers.Embedding: name the pp axis only
+        # when the ambient mesh has it, so the same module initializes on
+        # a data-only mesh (sequential fallback) without a phantom axis
+        ambient = jax.sharding.get_abstract_mesh().axis_names
+        lead = self.pp_axis if self.pp_axis in ambient else None
+
+        def p(name, shape, init):
+            return self.param(
+                name,
+                nn.with_partitioning(
+                    init, (lead,) + (None,) * (len(shape) - 1)),
+                shape, jnp.float32)
+
+        w_init = nn.initializers.normal(0.02)
+        params = {
+            "ln1_s": p("ln1_scale", (S, C), nn.initializers.ones),
+            "ln1_b": p("ln1_bias", (S, C), nn.initializers.zeros),
+            "wq": p("wq", (S, C, C), w_init),
+            "wk": p("wk", (S, C, C), w_init),
+            "wv": p("wv", (S, C, C), w_init),
+            "wo": p("wo", (S, C, C), w_init),
+            "ln2_s": p("ln2_scale", (S, C), nn.initializers.ones),
+            "ln2_b": p("ln2_bias", (S, C), nn.initializers.zeros),
+            "w1": p("w1", (S, C, 4 * C), w_init),
+            "b1": p("b1", (S, 4 * C), nn.initializers.zeros),
+            "w2": p("w2", (S, 4 * C, C), w_init),
+            "b2": p("b2", (S, C), nn.initializers.zeros),
+        }
+
+        def ln(a, scale, bias):
+            a32 = a.astype(jnp.float32)
+            mu = jnp.mean(a32, axis=-1, keepdims=True)
+            var = jnp.var(a32, axis=-1, keepdims=True)
+            return ((a32 - mu) * jax.lax.rsqrt(var + 1e-6) * scale
+                    + bias).astype(a.dtype)
+
+        heads, dt = self.heads, self.compute_dtype
+
+        def stage(sp, a):
+            B, T, _ = a.shape
+            h = ln(a, sp["ln1_s"], sp["ln1_b"])
+            shape = (B, T, heads, C // heads)
+            attn = full_attention(
+                (h @ sp["wq"].astype(dt)).reshape(shape),
+                (h @ sp["wk"].astype(dt)).reshape(shape),
+                (h @ sp["wv"].astype(dt)).reshape(shape),
+                causal=True,
+            )
+            a = a + attn.reshape(B, T, C) @ sp["wo"].astype(dt)
+            h = ln(a, sp["ln2_s"], sp["ln2_b"])
+            h = nn.gelu(h @ sp["w1"].astype(dt) + sp["b1"].astype(dt))
+            return a + h @ sp["w2"].astype(dt) + sp["b2"].astype(dt)
+
+        return gpipe(
+            stage, params, x,
+            num_microbatches=self.num_microbatches, axis=self.pp_axis)
+
+
 class TransformerLM(nn.Module):
     vocab: int
     num_layers: int
@@ -110,6 +197,10 @@ class TransformerLM(nn.Module):
     tp_axis: str = ""   # mesh axis for Megatron-style tensor parallelism
                         # ("" = off; typically "model"). heads must divide
                         # by the axis size.
+    pp_axis: str = ""   # mesh axis for GPipe pipeline parallelism ("" =
+                        # off). num_layers must equal the axis size when
+                        # the mesh has it; mutually exclusive with tp_axis.
+    pp_microbatches: int = 4
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -120,12 +211,31 @@ class TransformerLM(nn.Module):
             "pos_embed", nn.initializers.normal(0.02), (self.max_len, self.dim)
         )
         x = (x + pos[:T][None]).astype(self.compute_dtype)
-        for i in range(self.num_layers):
-            x = Block(
-                self.dim, self.heads, self.compute_dtype,
-                self.seq_parallel, self.dropout, tp_axis=self.tp_axis,
-                name=f"block_{i}",
+        if self.pp_axis and self.tp_axis:
+            raise ValueError("pp_axis and tp_axis are mutually exclusive")
+        if self.pp_axis and self.dropout > 0:
+            raise ValueError(
+                "pp_axis does not support dropout (pipeline stages are "
+                "deterministic); set dropout=0")
+        if self.pp_axis and self.seq_parallel not in ("", "none"):
+            raise ValueError(
+                "pp_axis runs attention unsharded inside each stage; set "
+                "seq_parallel='none' (ring/Ulysses do not compose with "
+                "the pipeline)")
+        if self.pp_axis:
+            x = PipelinedBlocks(
+                self.num_layers, self.dim, self.heads, self.compute_dtype,
+                pp_axis=self.pp_axis,
+                num_microbatches=self.pp_microbatches,
+                name="pipeline",
             )(x, training)
+        else:
+            for i in range(self.num_layers):
+                x = Block(
+                    self.dim, self.heads, self.compute_dtype,
+                    self.seq_parallel, self.dropout, tp_axis=self.tp_axis,
+                    name=f"block_{i}",
+                )(x, training)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = _tp_dense(self.vocab, jnp.float32, "lm_head",
                            self.tp_axis, "col")(x)
@@ -143,6 +253,8 @@ def custom_model(**kwargs) -> TransformerLM:
         seq_parallel=str(kwargs.get("seq_parallel", "ring")),
         dropout=float(kwargs.get("dropout", 0.0)),
         tp_axis=str(kwargs.get("tp_axis", "")),
+        pp_axis=str(kwargs.get("pp_axis", "")),
+        pp_microbatches=int(kwargs.get("pp_microbatches", 4)),
     )
 
 
