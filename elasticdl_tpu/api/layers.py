@@ -55,3 +55,58 @@ class Embedding(nn.Module):
         ids = jnp.asarray(ids, jnp.int32)
         vectors = emb_ops.embedding_lookup(table, ids, mode=self.mode)
         return emb_ops.combine(vectors, self.combiner, ids, weights)
+
+
+class MoE(nn.Module):
+    """Switch-style top-1 Mixture-of-Experts FFN with expert parallelism.
+
+    Expert weights are stacked (num_experts, ...) and sharded one group
+    per shard of the ambient mesh's `expert` axis (mesh-adaptive — on a
+    mesh without one the experts replicate and the layer still works);
+    token dispatch lowers to all_to_all via GSPMD (ops/moe.py). Output is
+    residual: over-capacity tokens pass through unchanged. The Switch
+    load-balancing aux loss is sown into the "losses" collection
+    (`moe_aux`) for callers that thread mutable collections; with an
+    immutable apply the sow is a no-op and routing still works, just
+    without the balance penalty.
+    """
+
+    num_experts: int
+    hidden_dim: int
+    capacity_factor: float = 1.25
+    kernel_init: Callable = nn.initializers.normal(0.02)
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        from elasticdl_tpu.ops import moe as moe_ops
+
+        c = x.shape[-1]
+        e, h = self.num_experts, self.hidden_dim
+        names = moe_ops.expert_partition_names
+        wg = self.param("router", self.kernel_init, (c, e), jnp.float32)
+        w1 = self.param(
+            "w1", nn.with_partitioning(self.kernel_init, names(3)),
+            (e, c, h), jnp.float32)
+        b1 = self.param(
+            "b1", nn.with_partitioning(nn.initializers.zeros, names(2)),
+            (e, h), jnp.float32)
+        w2 = self.param(
+            "w2", nn.with_partitioning(self.kernel_init, names(3)),
+            (e, h, c), jnp.float32)
+        b2 = self.param(
+            "b2", nn.with_partitioning(nn.initializers.zeros, names(2)),
+            (e, c), jnp.float32)
+        flat = x.reshape(-1, c)
+        out, aux = moe_ops.switch_moe(
+            flat, wg, w1, b1, w2, b2, self.capacity_factor)
+        # OVERWRITE semantics, not flax's default tuple-append: the trainer
+        # threads mutable collections through every step, and an appending
+        # sow would grow the pytree each step — changing its structure and
+        # forcing a full retrace/recompile per train step (review-caught,
+        # empirically confirmed)
+        self.sow(
+            "losses", "moe_aux", aux,
+            reduce_fn=lambda prev, new: new,
+            init_fn=lambda: jnp.float32(0.0),
+        )
+        return x + out.reshape(x.shape)

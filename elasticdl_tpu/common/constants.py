@@ -78,6 +78,7 @@ class MeshAxis:
     MODEL = "model"  # embedding-table rows / any model-parallel dim
     SEQ = "seq"     # sequence/context parallelism (ring / Ulysses attention)
     PIPE = "pp"     # pipeline parallelism (GPipe microbatch streaming)
+    EXPERT = "expert"  # expert parallelism (MoE all_to_all dispatch)
 
 
 DEFAULT_MASTER_PORT = 50001

@@ -1,0 +1,165 @@
+"""Switch-MoE expert parallelism (ops/moe.py + api.layers.MoE): routing
+semantics, replicated-vs-expert-sharded parity, training, and the
+comm-structure bound (no expert-weight-sized collectives).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from elasticdl_tpu.api.layers import MoE
+from elasticdl_tpu.ops import moe as moe_ops
+from elasticdl_tpu.parallel.mesh import build_mesh
+
+E, C, H, N = 4, 8, 16, 32
+
+
+def make_weights(seed=0):
+    r = np.random.RandomState(seed)
+    return dict(
+        wg=jnp.asarray(r.randn(C, E), jnp.float32),
+        w1=jnp.asarray(r.randn(E, C, H) * 0.1, jnp.float32),
+        b1=jnp.zeros((E, H), jnp.float32),
+        w2=jnp.asarray(r.randn(E, H, C) * 0.1, jnp.float32),
+        b2=jnp.zeros((E, C), jnp.float32),
+    )
+
+
+def reference_moe(x, w):
+    """Per-token loop twin of switch_moe with unlimited capacity."""
+    probs = np.asarray(jax.nn.softmax(x @ w["wg"], axis=-1))
+    out = np.zeros_like(np.asarray(x))
+    for i, tok in enumerate(np.asarray(x)):
+        e = int(np.argmax(probs[i]))
+        hdn = np.asarray(jax.nn.gelu(tok @ w["w1"][e] + w["b1"][e]))
+        out[i] = (hdn @ w["w2"][e] + w["b2"][e]) * probs[i, e]
+    return out
+
+
+def test_switch_moe_matches_per_token_reference():
+    w = make_weights()
+    x = jnp.asarray(np.random.RandomState(1).randn(N, C), jnp.float32)
+    # capacity ample: nothing dropped -> must equal the per-token loop
+    out, aux = moe_ops.switch_moe(
+        x, w["wg"], w["w1"], w["b1"], w["w2"], w["b2"],
+        capacity_factor=float(E))
+    np.testing.assert_allclose(np.asarray(out), reference_moe(x, w),
+                               rtol=1e-4, atol=1e-5)
+    assert float(aux) > 0.0
+
+
+def test_switch_moe_capacity_drops_overflow_tokens():
+    w = make_weights()
+    # router forced: positive tokens + positive-only column 0 weights make
+    # expert 0's logit strictly dominate for EVERY token
+    w["wg"] = jnp.zeros((C, E)).at[:, 0].set(10.0)
+    x = jnp.asarray(
+        np.abs(np.random.RandomState(2).randn(N, C)) + 0.1, jnp.float32)
+    cap = max(1, int(0.25 * N / E))   # 2 slots
+    out, _ = moe_ops.switch_moe(
+        x, w["wg"], w["w1"], w["b1"], w["w2"], w["b2"],
+        capacity_factor=0.25)
+    nonzero_rows = np.count_nonzero(
+        np.any(np.abs(np.asarray(out)) > 1e-9, axis=-1))
+    assert nonzero_rows == cap, (nonzero_rows, cap)   # overflow -> 0 (residual)
+
+
+def test_moe_layer_parity_replicated_vs_expert_sharded():
+    """The SAME init on an expert-sharded mesh and a data-only mesh must
+    produce the same output — expert parallelism is a layout, not a
+    semantics change."""
+    x = jnp.asarray(np.random.RandomState(3).randn(4, 8, C), jnp.float32)
+    layer = MoE(num_experts=E, hidden_dim=H)
+
+    def run(mesh):
+        with jax.set_mesh(mesh):
+            import flax.linen as nn
+
+            boxed = layer.init(jax.random.PRNGKey(0), x)
+            # commit the annotated shardings (expert-sharded on the EP
+            # mesh, replicated otherwise) so the EP side really shards
+            variables = jax.tree_util.tree_map(
+                jax.device_put, nn.meta.unbox(boxed),
+                nn.get_sharding(boxed, mesh))
+            return np.asarray(jax.jit(
+                lambda v, x: layer.apply(v, x))(variables, x))
+
+    out_rep = run(build_mesh({"data": 2}, jax.devices()[:2]))
+    out_ep = run(build_mesh({"data": 2, "expert": 4}))
+    np.testing.assert_allclose(out_ep, out_rep, rtol=1e-4, atol=1e-6)
+
+
+def test_moe_layer_trains(mesh8):
+    """A tiny classifier with an MoE FFN learns on the 8-device mesh (no
+    expert axis: replicated experts, same code path the trainer uses)."""
+    import flax.linen as nn
+    import optax
+
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    class MoEModel(nn.Module):
+        @nn.compact
+        def __call__(self, feats, training=False):
+            h = nn.Dense(C)(feats)
+            h = MoE(num_experts=E, hidden_dim=H)(h)
+            return nn.Dense(1)(h).reshape(-1)
+
+    spec = ModelSpec(
+        model=MoEModel(),
+        loss=lambda labels, out: optax.sigmoid_binary_cross_entropy(
+            out, jnp.asarray(labels, jnp.float32).reshape(-1)),
+        optimizer=optax.adam(5e-3),
+        dataset_fn=None,
+        eval_metrics_fn=None,
+    )
+    trainer = Trainer(spec, mesh8)
+
+    def batch(seed):
+        r = np.random.RandomState(seed)
+        feats = r.randn(32, C).astype(np.float32)
+        labels = (feats[:, 0] > 0).astype(np.float32)
+        return {"features": feats, "labels": labels,
+                "mask": np.ones((32,), np.float32)}
+
+    state = trainer.init_state(batch(0))
+    losses = []
+    for i in range(30):
+        state, logs = trainer.train_step(state, batch(i % 5))
+        losses.append(float(logs["loss"]))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), losses
+
+
+def test_moe_collectives_are_token_sized_not_weight_sized():
+    """On a data x expert mesh with the weights COMMITTED to their expert
+    sharding and tokens to data sharding, the compiled fwd+bwd must (a)
+    actually contain collectives (uncommitted inputs would let GSPMD
+    replicate everything, making this vacuous — review-caught) and (b)
+    never move the full stacked expert weights: experts stay resident."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tests.test_comm_structure import collective_sizes
+
+    w = make_weights()
+    x = jnp.asarray(np.random.RandomState(4).randn(N, C), jnp.float32)
+    mesh = build_mesh({"data": 2, "expert": 4})
+    def put(k, v):
+        # router replicated; every stacked expert leaf sharded over expert
+        spec = P() if k == "wg" else P("expert", *([None] * (v.ndim - 1)))
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    w = {k: put(k, v) for k, v in w.items()}
+    x = jax.device_put(x, NamedSharding(mesh, P("data", None)))
+    weight_elems = E * C * H          # stacked w1
+    with jax.set_mesh(mesh):
+        hlo = (
+            jax.jit(jax.grad(
+                lambda w: jnp.sum(moe_ops.switch_moe(
+                    x, w["wg"], w["w1"], w["b1"], w["w2"], w["b2"])[0] ** 2)))
+            .lower(w).compile().as_text()
+        )
+    sizes = collective_sizes(hlo)
+    assert sizes, "expected token-movement collectives in the sharded MoE HLO"
+    for op, nelem in collective_sizes(hlo):
+        assert nelem < weight_elems, (op, nelem, "expert weights crossed the mesh")
