@@ -75,6 +75,9 @@ class MoE(nn.Module):
     hidden_dim: int
     capacity_factor: float = 1.25
     kernel_init: Callable = nn.initializers.normal(0.02)
+    # residual=False returns only the expert mix (dropped tokens -> 0) for
+    # callers that add their own residual (pre-norm transformer blocks)
+    residual: bool = True
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
@@ -109,4 +112,5 @@ class MoE(nn.Module):
             reduce_fn=lambda prev, new: new,
             init_fn=lambda: jnp.float32(0.0),
         )
-        return x + out.reshape(x.shape)
+        out = out.reshape(x.shape)
+        return x + out if self.residual else out

@@ -46,6 +46,12 @@ class ModelSpec:
     # (zoo module-level `batch_partition()`; sequence-parallel models shard
     # tokens over ('data', 'seq')).
     batch_partition: Optional[Dict[str, Any]] = None
+    # Weight on auxiliary losses sown into the "losses" collection (e.g.
+    # api.layers.MoE's Switch load-balance penalty). 0 = ignored. The
+    # trainer adds weight * sum(sown leaves) INSIDE the differentiated
+    # loss, so the aux regularizes training. Zoo modules export it as a
+    # module-level `aux_loss_weight` float.
+    aux_loss_weight: float = 0.0
 
     @classmethod
     def from_config(cls, cfg: JobConfig) -> "ModelSpec":
@@ -93,4 +99,6 @@ class ModelSpec:
             batch_partition=(
                 dict(batch_partition_fn()) if batch_partition_fn else None
             ),
+            aux_loss_weight=float(
+                getattr(module, "aux_loss_weight", 0.0) or 0.0),
         )

@@ -78,6 +78,20 @@ def _masked_scalar_loss(loss_fn, labels, outputs, mask):
     return jnp.sum(value * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
+def _aux_loss(new_vars, weight: float):
+    """weight * sum of everything sown into the "losses" collection (e.g.
+    api.layers.MoE's Switch load-balance penalty). Added INSIDE the
+    differentiated loss so auxiliaries regularize training; 0-weight jobs
+    pay nothing (static branch)."""
+    if not weight:
+        return jnp.float32(0.0)
+    leaves = jax.tree_util.tree_leaves(new_vars.get("losses", {}))
+    if not leaves:
+        return jnp.float32(0.0)
+    return jnp.float32(weight) * sum(
+        jnp.sum(jnp.asarray(l, jnp.float32)) for l in leaves)
+
+
 _warned_scalar_accum = False
 
 
@@ -100,7 +114,7 @@ def _warn_scalar_loss_with_accum() -> None:
 
 
 def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
-                       step_rng, accum):
+                       step_rng, accum, aux_weight: float = 0.0):
     """Gradient accumulation: split the batch into `accum` micro-batches
     along the leading dim, `lax.scan` forward+backward over them holding
     ONE micro-batch of activations live at a time, and return grads exactly
@@ -111,7 +125,12 @@ def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
     dividing once at the end — identical to the full batch's weighted mean
     even with padded rows concentrated in one micro-batch. A user loss that
     returns a SCALAR is assumed to be a mean over its micro-batch (true of
-    every zoo loss); micro-batches then weigh equally. BatchNorm-style
+    every zoo loss); micro-batches then weigh equally. The exactness claim
+    is scoped to aux_weight=0: sown auxiliary losses (MoE balance) are
+    batch-DEPENDENT statistics, so per-micro aux (micro-sized capacity,
+    per-micro frac/mean_prob) legitimately differs from the full-batch
+    aux — the example-count weighting below is the accumulation-consistent
+    choice, not an equality guarantee. BatchNorm-style
     extra_vars thread through the scan (last micro-batch wins, matching K
     sequential steps); dropout draws per-micro-batch folds of the step
     rng."""
@@ -148,11 +167,18 @@ def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
                 # pre-reduced scalar: weigh micro-batches equally (ndim is
                 # static, so this warning fires once at trace time)
                 _warn_scalar_loss_with_accum()
-                return value, (jnp.float32(1.0), new_vars)
+                return value + _aux_loss(new_vars, aux_weight), (
+                    jnp.float32(1.0), new_vars)
             v = value.reshape(-1).astype(jnp.float32)
             mm = (jnp.asarray(m, jnp.float32).reshape(-1) if m is not None
                   else jnp.ones_like(v))
-            return jnp.sum(v * mm), (jnp.sum(mm), new_vars)
+            cnt = jnp.sum(mm)
+            # aux scaled by this micro-batch's example count so the final
+            # divide-once yields the example-weighted mean of the PER-MICRO
+            # aux (see the exactness scoping in the docstring: batch-
+            # dependent aux statistics cannot equal the full-batch value)
+            return jnp.sum(v * mm) + _aux_loss(new_vars, aux_weight) * cnt, (
+                cnt, new_vars)
 
         (s, (cnt, new_vars)), g = jax.value_and_grad(
             sum_loss, has_aux=True)(state.params)
@@ -302,6 +328,7 @@ class Trainer:
         remat = self.remat
         remat_policy = self._resolved_remat_policy
         accum = self.grad_accum
+        aux_weight = float(self.spec.aux_loss_weight or 0.0)
 
         def step_fn(state: TrainState, batch):
             features, labels, mask = _split_batch(batch)
@@ -325,12 +352,13 @@ class Trainer:
             def compute_loss(params):
                 variables = {"params": params, **state.extra_vars}
                 outputs, new_vars = forward(variables, features, step_rng)
-                return _masked_scalar_loss(loss_fn, labels, outputs, mask), new_vars
+                loss = _masked_scalar_loss(loss_fn, labels, outputs, mask)
+                return loss + _aux_loss(new_vars, aux_weight), new_vars
 
             if accum > 1:
                 loss_value, new_vars, grads = _accumulated_grads(
                     forward, loss_fn, state, features, labels, mask,
-                    step_rng, accum,
+                    step_rng, accum, aux_weight=aux_weight,
                 )
             else:
                 (loss_value, new_vars), grads = jax.value_and_grad(

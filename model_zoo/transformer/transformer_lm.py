@@ -63,6 +63,9 @@ class Block(nn.Module):
     seq_parallel: str
     dropout: float
     tp_axis: str = ""
+    moe_experts: int = 0   # >0 replaces the dense MLP with a Switch-MoE
+                           # FFN (experts shard over the mesh's `expert`
+                           # axis when present)
 
     @nn.compact
     def __call__(self, x, training: bool):
@@ -90,11 +93,19 @@ class Block(nn.Module):
             h = nn.Dropout(self.dropout, deterministic=False)(h)
         x = x + h
         h = nn.LayerNorm(dtype=self.compute_dtype)(x)
-        h = _tp_dense(4 * C, self.compute_dtype, "mlp_in",
-                      self.tp_axis, "col")(h)
-        h = nn.gelu(h)
-        h = _tp_dense(C, self.compute_dtype, "mlp_out",
-                      self.tp_axis, "row")(h)
+        if self.moe_experts:
+            from elasticdl_tpu.api.layers import MoE
+
+            h = MoE(
+                num_experts=self.moe_experts, hidden_dim=4 * C,
+                residual=False, name="moe",
+            )(h)
+        else:
+            h = _tp_dense(4 * C, self.compute_dtype, "mlp_in",
+                          self.tp_axis, "col")(h)
+            h = nn.gelu(h)
+            h = _tp_dense(C, self.compute_dtype, "mlp_out",
+                          self.tp_axis, "row")(h)
         return x + h
 
 
@@ -201,6 +212,10 @@ class TransformerLM(nn.Module):
                         # off). num_layers must equal the axis size when
                         # the mesh has it; mutually exclusive with tp_axis.
     pp_microbatches: int = 4
+    moe_experts: int = 0  # >0: Switch-MoE FFN per block (expert-parallel
+                          # over the mesh's `expert` axis; pair with
+                          # module-level aux_loss_weight for balance).
+                          # Mutually exclusive with tp_axis/pp_axis.
 
     @nn.compact
     def __call__(self, features, training: bool = False):
@@ -213,6 +228,9 @@ class TransformerLM(nn.Module):
         x = (x + pos[:T][None]).astype(self.compute_dtype)
         if self.pp_axis and self.tp_axis:
             raise ValueError("pp_axis and tp_axis are mutually exclusive")
+        if self.moe_experts and (self.tp_axis or self.pp_axis):
+            raise ValueError(
+                "moe_experts is mutually exclusive with tp_axis/pp_axis")
         if self.pp_axis and self.dropout > 0:
             raise ValueError(
                 "pp_axis does not support dropout (pipeline stages are "
@@ -234,7 +252,7 @@ class TransformerLM(nn.Module):
                 x = Block(
                     self.dim, self.heads, self.compute_dtype,
                     self.seq_parallel, self.dropout, tp_axis=self.tp_axis,
-                    name=f"block_{i}",
+                    moe_experts=self.moe_experts, name=f"block_{i}",
                 )(x, training)
         x = nn.LayerNorm(dtype=self.compute_dtype)(x)
         logits = _tp_dense(self.vocab, jnp.float32, "lm_head",
@@ -255,7 +273,13 @@ def custom_model(**kwargs) -> TransformerLM:
         tp_axis=str(kwargs.get("tp_axis", "")),
         pp_axis=str(kwargs.get("pp_axis", "")),
         pp_microbatches=int(kwargs.get("pp_microbatches", 4)),
+        moe_experts=int(kwargs.get("moe_experts", 0)),
     )
+
+
+# ModelSpec picks this up: weight on the sown Switch load-balance loss
+# (only active when moe_experts > 0 sows it; harmless otherwise)
+aux_loss_weight = 0.01
 
 
 def loss(labels, outputs):
