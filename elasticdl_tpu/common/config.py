@@ -366,7 +366,9 @@ class JobConfig:
 
         Two forms: positional "4" / "4,2" (data[, model], back-compat) and
         named "data=2,seq=4" / "data=4,model=2" — named supports any axis
-        set (data/model/seq) in mesh order.
+        set (data/model/seq/pp/expert) in mesh order, so a job can request
+        the sequence-, tensor-, pipeline-, or expert-parallel meshes the
+        zoo transformer consumes.
         """
         if not self.mesh_shape:
             return {"data": n_devices}
