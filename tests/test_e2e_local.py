@@ -163,3 +163,47 @@ def test_profiling_and_step_time_summaries(tmp_path):
     assert lines, "no train summaries written"
     assert all("step_time_ms" in rec and rec["step_time_ms"] > 0 for rec in lines)
     assert all("loss" in rec for rec in lines)
+
+
+def test_local_transformer_lm_job_end_to_end(tmp_path):
+    """The control plane is model-agnostic: the transformer LM (net-new
+    family) runs the SAME master/worker job path the tabular models use —
+    synthetic bigram shards in, tasks leased/retired exactly once, epoch-
+    end eval aggregating token accuracy."""
+    cfg = job_config(
+        tmp_path,
+        model_def="transformer.transformer_lm.custom_model",
+        model_params={
+            "vocab": 32, "num_layers": 1, "dim": 32, "heads": 4,
+            "max_len": 32, "seq_parallel": "none",
+            "compute_dtype": "float32",
+        },
+        training_data="synthetic://lm?n=512&shards=4&vocab=32&seq_len=16",
+        validation_data="synthetic://lm?n=64&shards=1&vocab=32&seq_len=16",
+        records_per_task=128,
+        minibatch_size=16,
+    )
+    master = Master(cfg)
+    manager = ProcessManager(
+        cfg,
+        membership=master.membership,
+        extra_env=HERMETIC_ENV,
+        log_dir=str(tmp_path / "logs"),
+    )
+    master.start()
+    manager.start_workers()
+    try:
+        ok = master.wait(timeout_s=420)
+        assert ok, (
+            "LM job did not finish; worker log:\n"
+            + (tmp_path / "logs" / "worker-0.log").read_text()[-4000:]
+        )
+        counts = master.dispatcher.counts()
+        assert counts["finished_training"] == 4      # 512 / 128
+        assert counts["failed_permanently"] == 0
+        results = master.evaluation.latest_results()
+        assert "token_accuracy" in results, results
+        assert 0.0 <= results["token_accuracy"] <= 1.0
+    finally:
+        master.shutdown(grace_s=2)
+        manager.stop()
