@@ -270,28 +270,38 @@ def bench_embedding_modes(mesh, np):
     rows/s, manual (shard_map) vs auto (GSPMD) schedule. On one chip the two
     compile to nearly the same program — the schedules only diverge on a
     multi-device mesh (see BASELINE.md note); this records both so a regression
-    in either shows up in the round log."""
+    in either shows up in the round log.
+
+    Inputs are COMMITTED to a NamedSharding before any timing (round-5
+    finding): feeding uncommitted (SingleDeviceSharding) arrays to a jit
+    under an ambient mesh takes a ~27x-slower dispatch path through the
+    axon PJRT plugin even on a 1-device mesh — that artifact, not the
+    scatter, produced round 3's "0.18M rows/s" update figure. The real
+    framework path (Trainer + shard_batch) always feeds committed arrays,
+    so committed inputs are the representative measurement."""
     import jax
     import jax.numpy as jnp
     import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from elasticdl_tpu.ops import embedding as emb_ops
 
     V, D, B, L = emb_ops.padded_vocab(FIELD_VOCAB * 26), 16, BATCH, 26
+    repl = NamedSharding(mesh, P())
     table = jax.device_put(
-        np.random.RandomState(0).randn(V, D).astype(np.float32) * 0.01
+        np.random.RandomState(0).randn(V, D).astype(np.float32) * 0.01, repl
     )
     ids = jax.device_put(
-        np.random.RandomState(1).randint(0, V, (B, L)).astype(np.int32)
+        np.random.RandomState(1).randint(0, V, (B, L)).astype(np.int32), repl
     )
     opt = optax.sgd(0.1)
     results = {}
     with jax.set_mesh(mesh):
-        # quantify the round-3 scatter fix: the same auto-mode update with
-        # the plain XLA scatter-add backward vs the default sorted
-        # segment-sum custom VJP vs the unique-compaction variant
-        # (ops/embedding.gather_rows) — the full menu in one chip window
-        for scatter in ("sorted", "unique", "xla"):
+        # the full scatter-strategy menu in one chip window: tiled
+        # (fast-zone scan, round-5 default) vs sorted segment-sum vs
+        # unique-compaction vs the plain XLA scatter baseline
+        # (ops/embedding.gather_rows)
+        for scatter in ("tiled", "sorted", "unique", "xla"):
             os.environ["EDL_EMB_SCATTER"] = scatter
             try:
                 opt_state = opt.init(table)
@@ -319,6 +329,12 @@ def bench_embedding_modes(mesh, np):
             finally:
                 os.environ.pop("EDL_EMB_SCATTER", None)
 
+        if int(mesh.devices.size) == 1:
+            # honesty marker (code-review r5 pt3): embedding_lookup
+            # reroutes manual->auto on a 1-device mesh, so the two rows
+            # below are the SAME program there; a shard_map-schedule
+            # regression only shows up on a multi-device run
+            results["manual_is_auto_on_1_device"] = True
         for mode in ("manual", "auto"):
             # summed output: a scalar readback that depends on every lookup
             look = jax.jit(

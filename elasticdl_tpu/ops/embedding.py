@@ -36,6 +36,7 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from elasticdl_tpu.common.constants import MeshAxis
@@ -46,25 +47,40 @@ logger = default_logger(__name__)
 
 @jax.custom_vjp
 def gather_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
-    """`table[ids]` whose BACKWARD avoids XLA's unsorted scatter-add.
+    """`table[ids]` with a BACKWARD built around the TPU scatter cliff.
 
-    Why: on TPU, XLA lowers the take-VJP's unsorted scatter-add essentially
-    row-serially — measured round 3 (honest timing): 213k-row gather from a
-    2.6M x 16 table runs at 46M rows/s, but its backward scatter at 0.18M
-    rows/s, making the embedding UPDATE ~250x slower than the lookup and
-    binding the whole DeepFM step. Two replacement strategies, selected by
-    EDL_EMB_SCATTER (read at trace time):
+    Measured on the chip (round 5, idle host, scalar-readback timing,
+    213k rows x D=16 into a 2.6M-row table): the scatter-add's per-element
+    cost jumps ~8x once the OUTPUT outgrows the fast zone — ~14 ns/element
+    when the destination is <= ~256k rows (16 MB, VMEM-resident tiles),
+    ~105 ns/element into the full 2.6M-row table — and neither the
+    `indices_are_sorted` nor the `unique_indices` promise changes the slow
+    lowering (22.4 ms either way; a sorted `segment_sum` over V segments
+    costs the same 23 ms). The earlier round-3 "0.18M rows/s, 250x slower
+    than the gather" reading conflated this with an uncommitted-input
+    dispatch pathology under an ambient mesh (see BASELINE.md round-5
+    notes); the honest gap is ~5x (gather 4.6 ms vs scatter 22-23 ms),
+    still the single biggest line in the DeepFM step.
 
-    - `sorted` (default): argsort the ids (a fast TPU sort) and accumulate
-      the full table gradient with `segment_sum(indices_are_sorted=True)` —
-      a contiguous, vectorizable, scatter-free update that writes all V
-      rows.
-    - `unique`: sort, then compact duplicate ids into per-unique buckets
-      (boundary cumsum + sorted segment_sum over at most B·L segments) and
-      apply ONE scatter-add with provably `unique_indices=True` — no
-      collision handling, and the dense write is V zeros + B·L touched
-      rows instead of a V-row segment_sum. Wins when V >> batch.
-    - `xla`: the plain take VJP (baseline for the bench comparison).
+    Strategies, selected by EDL_EMB_SCATTER (read at trace time):
+
+    - `tiled` (default): argsort ids, materialize the sorted gradient rows
+      once (contiguous), then lax.scan over vocab tiles of <= 256k rows:
+      each tile dynamic-slices a fixed window of the sorted stream
+      (searchsorted tile edges) and scatter-adds INSIDE the fast zone,
+      accumulating tiles into the dense gradient by dynamic-update-slice.
+      Every scatter's output fits the fast zone, so the whole backward
+      runs at the ~14 ns/element rate plus one sorted materialization
+      (measured: 10.8 ms vs 22.4 ms flat for the bench shape). A
+      data-dependent overflow guard (`lax.cond` on the max window
+      population) falls back to the flat scatter for pathological skew,
+      so the path is exact for every distribution.
+    - `sorted`: argsort + full-table `segment_sum(indices_are_sorted=True)`
+      — scatter-free but writes all V segments; measured equal to the flat
+      scatter on v5e (23 ms), kept as the structural baseline.
+    - `unique`: sort, compact duplicates (boundary cumsum), ONE
+      unique-indices scatter — same slow zone, kept for the bench menu.
+    - `xla`: the plain take VJP (the flat-scatter baseline).
     """
     return jnp.take(table, ids, axis=0)
 
@@ -73,6 +89,112 @@ def _gather_rows_fwd(table, ids):
     return gather_rows(table, ids), (
         ids, jnp.empty((0,), table.dtype), table.shape[0],
     )
+
+
+# Fast-zone knobs for the tiled backward (see gather_rows docstring).
+# tile_rows x D x 4B must stay inside the measured fast-scatter zone
+# (<= ~16 MB output on v5e); 128k rows x 16 floats = 8 MB leaves headroom
+# for wider embedding dims. Read at trace time so bench sweeps and tests
+# can resize tiles without re-importing.
+DEFAULT_TILE_ROWS = 128 * 1024
+# Windows are sized at slack x the uniform expectation (hashed vocabs make
+# the per-tile population near-uniform; uniform max over ~20 tiles sits
+# ~4 sigma = ~4% above the mean, so 1.3x is comfortable); the cond
+# fallback keeps skewed id distributions exact. Cost is per window SLOT
+# (round-5 chip sweep), so the window is aligned to 256 rows, not rounded
+# to a power of two — pow2 rounding nearly doubled the slot count.
+DEFAULT_TILE_WINDOW_SLACK = 1.3
+
+
+def _tile_rows() -> int:
+    return int(os.environ.get("EDL_EMB_TILE_ROWS", str(DEFAULT_TILE_ROWS)))
+
+
+def _window_slack() -> float:
+    return float(os.environ.get(
+        "EDL_EMB_WINDOW_SLACK", str(DEFAULT_TILE_WINDOW_SLACK)))
+
+
+def _tiled_table_grad(cf, sf, num_rows):
+    """Dense (num_rows, D) gradient from SORTED contributions, every
+    scatter confined to the fast zone.
+
+    cf: (N, D) f32 gradient rows already in sorted-id order; sf: (N,)
+    sorted int32 ids. Scans vocab tiles of TILE_ROWS rows; tile t
+    dynamic-slices a fixed W-row window of (cf, sf) starting at its
+    searchsorted edge — contiguous reads, no row gathers — and
+    scatter-adds into a TILE_ROWS-row zero tile (mode='drop' masks the
+    window tail that belongs to later tiles), then lays tiles down with
+    dynamic_update_slice. W covers the max tile population for
+    near-uniform (hashed) ids; `lax.cond` falls back to one flat scatter
+    when the data is skewed enough to overflow a window."""
+    n, d = cf.shape
+    tile_rows = _tile_rows()
+    nt = -(-num_rows // tile_rows)
+    # Window sizing counts ALL n contributions, including the manual shard
+    # path's non-owned sentinels (they sort beyond every real id, so they
+    # inflate w but never a tile's population). On an s-shard mesh each
+    # shard therefore sweeps ~slack*n window slots when ~n/s would cover
+    # its owned rows — the backward stays at single-chip cost rather than
+    # scaling down. Known refinement: derive the owned fraction from the
+    # static shard count when tracing inside shard_map.
+    w = int(min(n, -(-int(max(256.0, _window_slack() * n / nt)) // 256) * 256))
+    vpad = nt * tile_rows
+    edges = jnp.searchsorted(
+        sf, jnp.arange(0, vpad + 1, tile_rows, dtype=jnp.int32)
+    ).astype(jnp.int32)
+
+    def tiled(cf, sf):
+        # Pad the sorted stream by one window so a tile's slice NEVER
+        # needs a clamped start: window t is then always [monotone
+        # in-range ids for tile t][ids of later tiles / pad, all of which
+        # map OUT of range high] — the exact shape for which the TPU's
+        # drop+sorted scatter lowering is both correct and fast. The
+        # design is pinned by on-TPU evidence (round-5 pt2; CPU ignores
+        # the flag so only chip numerics can police it):
+        #   - clamped starts put invalid slots BEFORE valid ids and the
+        #     sorted lowering silently dropped ~27k rows (13%!);
+        #   - dropping `indices_are_sorted` instead was exact but 1.6x
+        #     slower (31 vs 19 ms) — the fast path span-searches the
+        #     sorted window and skips the OOB tail;
+        #   - with padding, no masks are needed at all: stray window
+        #     slots belong to later tiles, so their tile-local index is
+        #     >= tile_rows and mode='drop' discards them by construction.
+        # pad ids with int32 max, not vpad: callers may legally pass ids
+        # beyond vpad (the manual shard path's non-owned sentinels are
+        # 2x the shard size), and a pad value smaller than a real id
+        # would make the window tail non-monotone under the sorted
+        # promise — the silent-drop trap again
+        sf_pad = jnp.concatenate(
+            [sf, jnp.full((w,), jnp.iinfo(jnp.int32).max, sf.dtype)])
+        cf_pad = jnp.concatenate(
+            [cf, jnp.zeros((w, d), cf.dtype)])
+
+        def body(acc, t):
+            c_w = jax.lax.dynamic_slice(cf_pad, (edges[t], 0), (w, d))
+            s_w = jax.lax.dynamic_slice(sf_pad, (edges[t],), (w,))
+            local = s_w - t * tile_rows     # monotone; >= tile_rows drops
+            tile = jnp.zeros((tile_rows, d), jnp.float32).at[local].add(
+                c_w, mode="drop", indices_are_sorted=True)
+            return jax.lax.dynamic_update_slice(
+                acc, tile, (t * tile_rows, 0)), None
+
+        # seed the carry from the cotangent so it carries the same
+        # varying-manual-axes type as the body's output when this runs
+        # inside shard_map (the manual lookup schedule) — a plain
+        # jnp.zeros carry is 'unvarying' there and scan rejects the
+        # mismatch; the broadcast folds away in XLA
+        acc = jnp.zeros((vpad, d), jnp.float32) + cf[:1, :1] * 0.0
+        acc, _ = jax.lax.scan(
+            body, acc, jnp.arange(nt, dtype=jnp.int32))
+        return acc[:num_rows]
+
+    def flat(cf, sf):
+        return jnp.zeros((num_rows, d), jnp.float32).at[sf].add(
+            cf, mode="drop", indices_are_sorted=True)
+
+    max_pop = jnp.max(edges[1:] - edges[:-1])
+    return jax.lax.cond(max_pop <= w, tiled, flat, cf, sf)
 
 
 def _gather_rows_bwd(res, ct):
@@ -84,9 +206,21 @@ def _gather_rows_bwd(res, ct):
     cf = ct.reshape(-1, ct.shape[-1]).astype(jnp.float32)
     if flat.shape[0] == 0:  # static: empty batch, zero gradient
         return jnp.zeros((num_rows, ct.shape[-1]), proto.dtype), None
+    mode = os.environ.get("EDL_EMB_SCATTER", "tiled")
+    if mode == "tiled" and num_rows > 2 * _tile_rows() \
+            and flat.shape[0] >= 4096:
+        # below those sizes the flat scatter is already in (or near) the
+        # fast zone and tiling only adds window overhead
+        order = jnp.argsort(flat)
+        d_table = _tiled_table_grad(cf[order], flat[order], num_rows)
+        return d_table.astype(proto.dtype), None
+    if mode == "tiled":
+        d_table = jnp.zeros((num_rows, cf.shape[1]), jnp.float32).at[
+            flat].add(cf, mode="drop")
+        return d_table.astype(proto.dtype), None
     order = jnp.argsort(flat)
     sf = flat[order]
-    if os.environ.get("EDL_EMB_SCATTER", "sorted") == "unique":
+    if mode == "unique":
         # compact duplicates: segment j = the j-th distinct id in sorted
         # order; `starts` marks each first occurrence, cumsum numbers them
         n = sf.shape[0]
@@ -97,12 +231,17 @@ def _gather_rows_bwd(res, ct):
             cf[order], seg, num_segments=n, indices_are_sorted=True)
         uids = jax.ops.segment_max(
             sf, seg, num_segments=n, indices_are_sorted=True)
-        # empty trailing segments come back at the dtype minimum; route
-        # each to a DISTINCT out-of-range row (num_rows + position) so
-        # mode="drop" discards them without ever violating the
-        # unique_indices promise below — duplicate OOB targets would make
-        # the scatter implementation-defined on TPU
-        uids = jnp.where(uids < 0, num_rows + jnp.arange(n), uids)
+        # Empty trailing segments come back at the dtype minimum, and REAL
+        # out-of-range uids can also appear (the manual shard path's
+        # non-owned sentinels are 2x the shard size). Route every
+        # not-in-range target to a DISTINCT out-of-range row
+        # (num_rows + position) so mode="drop" discards them without ever
+        # violating the unique_indices promise below — duplicate OOB
+        # targets (e.g. a real sentinel uid colliding with a rerouted
+        # empty segment, code-review r5 pt4) would make the scatter
+        # implementation-defined on TPU
+        uids = jnp.where((uids < 0) | (uids >= num_rows),
+                         num_rows + jnp.arange(n), uids)
         d_table = jnp.zeros((num_rows, cf.shape[1]), jnp.float32)
         d_table = d_table.at[uids].add(
             sums, mode="drop", unique_indices=True)
@@ -118,7 +257,7 @@ gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 
 
 def _take(table: jax.Array, ids: jax.Array) -> jax.Array:
-    if os.environ.get("EDL_EMB_SCATTER", "sorted") == "xla":
+    if os.environ.get("EDL_EMB_SCATTER", "tiled") == "xla":
         return jnp.take(table, ids, axis=0)
     return gather_rows(table, ids)
 
@@ -157,7 +296,26 @@ def embedding_lookup(
     """
     axes = ambient_axes()
     in_range = (ids >= 0) & (ids < table.shape[0])
-    safe_ids = jnp.where(in_range, ids, 0)
+    # Out-of-range ids (the negative padding sentinels of bag features) go
+    # to a LARGE out-of-range value, not row 0: the forward masks them
+    # either way (jnp.take clips, then in_range zeroes the vectors and
+    # their cotangents), but the tiled backward sorts the raw ids — a
+    # row-0 pile of pad slots would overflow tile 0's window and
+    # permanently trip the flat-scatter fallback (code-review r5 pt4,
+    # same pathology as the manual path's non-owned ids). int32max/2
+    # stays beyond every padded vocab and survives the shard path's
+    # offset subtraction without wrapping.
+    oob = jnp.iinfo(jnp.int32).max // 2
+    safe_ids = jnp.where(in_range, ids, oob).astype(jnp.int32)
+
+    if mode == "manual" and axes:
+        mesh_ = jax.sharding.get_abstract_mesh()
+        if int(np.prod([mesh_.shape[a] for a in axes])) == 1:
+            # a 1-device mesh has nothing to shard: the shard_map schedule
+            # only adds manual-axes bookkeeping around the same local
+            # gather/scatter (measured round 5: ~8 ms/step of pure
+            # overhead in the DeepFM backward) — route to auto
+            mode = "auto"
 
     if mode == "auto" or not axes:
         out = _take(table, safe_ids)
@@ -195,8 +353,22 @@ def embedding_lookup(
         offset = shard * table_shard.shape[0]
         local = all_ids - offset
         owned = (local >= 0) & (local < table_shard.shape[0])
+        # Non-owned ids map OUT of the shard's range (not to row 0): the
+        # forward clamps/masks them either way, but the backward's tiled
+        # scatter sorts the raw ids — a row-0 pile of every non-owned id
+        # (up to (n_shards-1)/n_shards of the batch) would overflow tile
+        # 0's window and trip the lax.cond flat fallback EVERY step,
+        # silently making `tiled` slower than the flat scatter on exactly
+        # the multi-chip manual path it exists for (code-review r5 pt3).
+        # 2x the shard size specifically: the tiled backward's padded
+        # vocab is < 1.5x num_rows (tile_rows < num_rows/2 on that path),
+        # so 2x sits beyond the last searchsorted edge and the sentinels
+        # count toward NO tile's window population; every scatter mode
+        # drops out-of-range cotangent rows.
+        sentinel = jnp.int32(2 * table_shard.shape[0])
         part = jnp.where(
-            owned[..., None], _take(table_shard, jnp.where(owned, local, 0)), 0.0
+            owned[..., None],
+            _take(table_shard, jnp.where(owned, local, sentinel)), 0.0
         )  # (B, L, D)
         out = jax.lax.psum_scatter(
             part, data_ax, scatter_dimension=0, tiled=True

@@ -452,8 +452,12 @@ class Trainer:
     def train_step_cost(self, state: TrainState, batch) -> Dict[str, float]:
         """XLA cost analysis of ONE train step (the scan body `train_many`
         runs K times per dispatch): {'flops', 'bytes accessed'} from the
-        lowered (pre-optimization) HLO — no compile or execution, so it
-        costs milliseconds. The SINGLE step is costed deliberately: XLA's
+        lowered (pre-optimization) HLO — milliseconds on backends whose
+        client-side analysis works; on PJRT-plugin backends (the axon TPU)
+        it falls back to compiling the step AOT to ask the backend, which
+        can take the full first-compile time (~20-40 s on the chip) — keep
+        this off latency-sensitive paths. The SINGLE step is costed
+        deliberately: XLA's
         cost analysis counts a `lax.scan` (while-loop) body ONCE regardless
         of trip count, so costing the train_many program would be ambiguous
         per-step. Matmul/conv FLOPs are exact (fusion never changes them);
@@ -464,8 +468,20 @@ class Trainer:
             self._train_step = self._build_train_step()
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            ca = self._train_step.lower(state, batch).cost_analysis()
-        d = ca if isinstance(ca, dict) else (ca[0] if ca else {})
+            lowered = self._train_step.lower(state, batch)
+            ca = lowered.cost_analysis()
+            d = ca if isinstance(ca, dict) else (ca[0] if ca else {})
+            if not d.get("flops"):
+                # PJRT-plugin backends (the axon TPU here) return None from
+                # the client-side lowered analysis; the compiled
+                # executable's analysis is computed by the backend and does
+                # work there. Costs one AOT compile — the caller (bench)
+                # has already paid the jit compile for the same shapes, so
+                # this only runs when the cheap path yields nothing.
+                try:
+                    d = lowered.compile().cost_analysis() or {}
+                except Exception:
+                    d = {}
         return {
             "flops": float(d.get("flops", 0.0)),
             "bytes accessed": float(d.get("bytes accessed", 0.0)),

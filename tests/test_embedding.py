@@ -29,7 +29,10 @@ def make_table(mesh, V=512, D=16, seed=0):
 def test_gather_rows_sorted_backward_matches_xla(monkeypatch):
     """gather_rows' sorted-segment-sum backward (the TPU scatter-add fix,
     round 3 rev 2) must equal the plain take VJP — including duplicate ids
-    (accumulation) and bf16 cotangents."""
+    (accumulation) and bf16 cotangents. Pinned to EDL_EMB_SCATTER=sorted:
+    the round-5 default flip to `tiled` silently rerouted this test to the
+    tiled flat branch (code-review r5 pt4)."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", "sorted")
     t = jnp.asarray(np.random.RandomState(0).randn(128, 16), jnp.float32)
     ids = jnp.asarray([[3, 3, 7], [0, 127, 3]], jnp.int32)  # dup id 3 x3
 
@@ -82,7 +85,67 @@ def test_gather_rows_unique_backward_matches_xla(monkeypatch, ids_np):
     assert gb.dtype == jnp.bfloat16
 
 
-@pytest.mark.parametrize("mode", ["sorted", "unique", "xla"])
+@pytest.mark.parametrize(
+    "ids_np",
+    [
+        np.random.RandomState(1).randint(0, 300, (64, 81)).astype(np.int32),
+        np.full((64, 81), 7, np.int32),    # extreme skew -> window overflow
+        np.asarray([[0, 299, 150]], np.int32),   # small N -> flat branch
+    ],
+)
+def test_gather_rows_tiled_backward_matches_xla(monkeypatch, ids_np):
+    """EDL_EMB_SCATTER=tiled (round-5 default): the fast-zone scan backward
+    must equal the plain take VJP on (a) the scan path (uniform ids, table
+    larger than 2 tiles), (b) the lax.cond overflow fallback (every id
+    identical, so one window can't hold its tile's population), and (c)
+    the small-batch flat branch. EDL_EMB_TILE_ROWS=64 shrinks tiles so a
+    300-row table exercises the real scan machinery on CPU."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", "tiled")
+    monkeypatch.setenv("EDL_EMB_TILE_ROWS", "64")
+    t = jnp.asarray(np.random.RandomState(0).randn(300, 4), jnp.float32)
+    ids = jnp.asarray(ids_np)
+    g = jax.grad(lambda t: jnp.sum(emb_ops.gather_rows(t, ids) ** 2))(t)
+    g_ref = jax.grad(lambda t: jnp.sum(jnp.take(t, ids, axis=0) ** 2))(t)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), rtol=1e-6)
+
+    # bf16 table round-trips through the f32 accumulator
+    tb = t.astype(jnp.bfloat16)
+    gb = jax.grad(
+        lambda t: jnp.sum(emb_ops.gather_rows(t, ids).astype(jnp.float32) ** 2)
+    )(tb)
+    assert gb.dtype == jnp.bfloat16
+
+
+def test_tiled_backward_on_manual_shard_path(monkeypatch, mesh8):
+    """Code-review r5 pt3 regression: the manual shard_map schedule feeds
+    gather_rows non-owned sentinel ids (up to 7/8 of the batch on mesh8).
+    The tiled backward must (a) stay exact and (b) keep those sentinels
+    out of every tile's window population — mapping them to row 0 (the
+    old behavior) piled them into tile 0 and permanently tripped the flat
+    fallback. Tiny tiles force the real scan path on an 8-shard table."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", "tiled")
+    monkeypatch.setenv("EDL_EMB_TILE_ROWS", "16")
+    V, D = 2048, 8     # 256 rows/shard on mesh8 > 2*16 -> tiled path
+    table_np, table = make_table(mesh8, V=V, D=D, seed=11)
+    ids_np = np.random.RandomState(12).randint(0, V, (64, 26)).astype(np.int32)
+    ids = jax.device_put(ids_np, NamedSharding(mesh8, P("data", None)))
+    w_np = np.random.RandomState(13).randn(64, 26, D).astype(np.float32)
+
+    with jax.set_mesh(mesh8):
+        g = jax.jit(
+            jax.grad(
+                lambda t: jnp.sum(
+                    emb_ops.embedding_lookup(t, ids, mode="manual") * w_np
+                )
+            )
+        )(table)
+
+    expected = np.zeros_like(table_np)
+    np.add.at(expected, ids_np.reshape(-1), w_np.reshape(-1, D))
+    np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("mode", ["tiled", "sorted", "unique", "xla"])
 def test_gather_rows_backward_unsigned_ids_and_empty(monkeypatch, mode):
     """Code-review r5: (a) uint32 ids must not break the unique path's
     signed empty-segment sentinel (duplicate scatter targets at row 0
@@ -180,6 +243,42 @@ def test_padding_ids_give_zero(mesh8):
     out = np.asarray(out)
     assert np.all(out[:, 1:] == 0)
     assert np.any(out[:, 0] != 0)
+
+
+@pytest.mark.parametrize("mode", ["tiled", "sorted", "unique", "xla"])
+def test_padding_ids_backward_zero_grad(monkeypatch, mesh8, mode):
+    """Pad slots (negative ids) must contribute ZERO gradient in every
+    scatter mode, through both lookup schedules — and in `tiled` they are
+    routed to a large OOB sentinel, not row 0, so heavy bag padding can't
+    overflow tile 0's window (code-review r5 pt4). Tiny tiles force the
+    real scan path."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", mode)
+    monkeypatch.setenv("EDL_EMB_TILE_ROWS", "16")
+    V, D = 2048, 8
+    table_np, table = make_table(mesh8, V=V, D=D, seed=21)
+    ids_np = np.random.RandomState(22).randint(0, V, (16, 6)).astype(np.int32)
+    ids_np[:, 3:] = -1                      # half the bag is padding
+    ids = jax.device_put(ids_np, NamedSharding(mesh8, P("data", None)))
+    w_np = np.random.RandomState(23).randn(16, 6, D).astype(np.float32)
+
+    expected = np.zeros_like(table_np)
+    for b in range(16):
+        for l in range(3):                  # only the real slots
+            expected[ids_np[b, l]] += w_np[b, l]
+
+    with jax.set_mesh(mesh8):
+        for lookup_mode in ("manual", "auto"):
+            g = jax.jit(
+                jax.grad(
+                    lambda t: jnp.sum(
+                        emb_ops.embedding_lookup(t, ids, mode=lookup_mode)
+                        * w_np
+                    )
+                )
+            )(table)
+            np.testing.assert_allclose(
+                np.asarray(g), expected, rtol=1e-5, atol=1e-6,
+                err_msg=f"{mode}/{lookup_mode}")
 
 
 def test_combiners():
