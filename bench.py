@@ -301,7 +301,13 @@ def bench_embedding_modes(mesh, np):
         # (fast-zone scan, round-5 default) vs sorted segment-sum vs
         # unique-compaction vs the plain XLA scatter baseline
         # (ops/embedding.gather_rows)
-        for scatter in ("tiled", "sorted", "unique", "xla"):
+        from elasticdl_tpu.ops import pallas_scatter as _ps
+
+        if not _ps.runnable():
+            # off-TPU the pallas mode reroutes to tiled — recording both
+            # rows would be the same program under two labels
+            results["pallas_is_tiled_off_tpu"] = True
+        for scatter in ("pallas", "tiled", "sorted", "unique", "xla"):
             os.environ["EDL_EMB_SCATTER"] = scatter
             try:
                 opt_state = opt.init(table)

@@ -25,8 +25,10 @@ from elasticdl_tpu.ops import embedding as emb_ops
 class Embedding(nn.Module):
     """Mesh-sharded embedding with optional bag combiner.
 
-    input_dim: vocabulary size (rows are padded to emb_ops.VOCAB_ALIGN so any
-      mesh up to that many shards divides the table evenly).
+    input_dim: vocabulary size (rows are padded via emb_ops.padded_vocab:
+      to VOCAB_ALIGN=256 for even mesh shards, or to 8192 for vocabs >=
+      PALLAS_VOCAB_MIN so the Pallas placement kernel emits whole blocks
+      — the padded row count is part of the checkpoint geometry).
     output_dim: embedding dimension.
     combiner: None → (..., L, D); 'sum'|'mean'|'sqrtn' → (..., D) over the
       last id axis, with negative ids treated as padding slots.

@@ -64,7 +64,13 @@ def gather_rows(table: jax.Array, ids: jax.Array) -> jax.Array:
 
     Strategies, selected by EDL_EMB_SCATTER (read at trace time):
 
-    - `tiled` (default): argsort ids, materialize the sorted gradient rows
+    - `pallas` (default): the Mosaic placement kernel
+      (ops/pallas_scatter.py) — sort once, then one-hot matmul the sorted
+      windows onto 2048-row output blocks on the MXU (13-15 ms vs 26-30
+      for the XLA paths on the DeepFM shape; ~4e-6 rel accuracy via a
+      two-term bf16 split). Runs on real TPU or under interpret mode;
+      everywhere else (and below its size gate) it falls back to:
+    - `tiled`: argsort ids, materialize the sorted gradient rows
       once (contiguous), then lax.scan over vocab tiles of <= 256k rows:
       each tile dynamic-slices a fixed window of the sorted stream
       (searchsorted tile edges) and scatter-adds INSIDE the fast zone,
@@ -197,6 +203,76 @@ def _tiled_table_grad(cf, sf, num_rows):
     return jax.lax.cond(max_pop <= w, tiled, flat, cf, sf)
 
 
+def _pallas_table_grad(cf, sf, num_rows):
+    """Dense gradient via the MXU one-hot placement kernel
+    (ops/pallas_scatter.py) — same windowing contract as the tiled path
+    (sorted stream, searchsorted block starts, lax.cond flat fallback on
+    window overflow), but the per-block placement is dense matmul instead
+    of fast-zone scatters."""
+    from elasticdl_tpu.ops import pallas_scatter
+
+    n, d = cf.shape
+    bs = pallas_scatter.block_rows()
+    nb = -(-num_rows // bs)
+    vpad = nb * bs
+    c = pallas_scatter.CHUNK
+    # window statistics over the REAL row count: ceil-padding the block
+    # count would undersize w for tables barely past the gate and
+    # silently land every step on the flat branch
+    per_block = _window_slack() * n * bs / num_rows
+    w = int(min(-(-n // c) * c, max(c, -(-int(per_block) // c) * c)))
+    # +128: window starts are aligned DOWN to 128 for Mosaic's DMA-offset
+    # tiling proof, so a window may begin up to 127 rows before its
+    # block's first id — the leading slop belongs to the previous block
+    # and the one-hot never matches it. Then round UP to a whole number
+    # of kernel chunks: the kernel iterates w // CHUNK full chunks, so a
+    # ragged tail would be silently skipped — dropped gradient rows that
+    # only full-scale on-TPU numerics catch (round-5 pt2, again).
+    w = -(-(w + 128) // c) * c
+    sf_pad = jnp.concatenate(
+        [sf, jnp.full((w,), jnp.iinfo(jnp.int32).max, sf.dtype)])
+    # transpose FIRST, pad on lanes: the (N, D) -> (D, N) relayout of the
+    # small sorted stream fuses with the reorder gather (~0.7 ms
+    # measured), while transpose-of-concat materialized a separate 2 ms
+    # copy
+    # depth padded to the Mosaic sublane tile (8): D=17 (deepfm's merged
+    # linear column) would otherwise fail the DMA alignment check
+    d8 = -(-d // 8) * 8
+    cf_t = jnp.concatenate([
+        jnp.concatenate([cf.T, jnp.zeros((d8 - d, n), cf.dtype)], axis=0),
+        jnp.zeros((d8, w), cf.dtype),
+    ], axis=1)
+    edges = jnp.searchsorted(
+        sf, jnp.arange(0, vpad + 1, bs, dtype=jnp.int32)
+    ).astype(jnp.int32)
+    starts = (edges[:-1] // 128) * 128
+
+    def pallas_branch(cf_t, sf_pad):
+        from elasticdl_tpu.ops.pallas_attention import _interpret_active
+
+        out = pallas_scatter.place_sorted_grads(
+            cf_t, sf_pad[None, :], starts,
+            num_rows=vpad, block_rows=bs, w=w, d_out=d,
+            split=os.environ.get(
+                "EDL_EMB_PALLAS_PRECISION", "split") != "bf16",
+            interpret=_interpret_active(),
+        )
+        return out[:num_rows]
+
+    def flat(cf_t, sf_pad):
+        return jnp.zeros((num_rows, d), jnp.float32).at[sf_pad[:n]].add(
+            cf_t[:d, :n].T, mode="drop", indices_are_sorted=True)
+
+    # aligned-start coverage: window b must reach this block's last id.
+    # NOTE the window statistics assume near-uniform ids (hashed vocab):
+    # a single hot id concentrates its duplicates in one tile and trips
+    # this guard, landing every step on the exact-but-slow flat branch —
+    # dedupe-compaction before placement is the designed next step for
+    # skewed real-world distributions (BASELINE.md round-5 pt2).
+    max_span = jnp.max(edges[1:] - starts)
+    return jax.lax.cond(max_span <= w, pallas_branch, flat, cf_t, sf_pad)
+
+
 def _gather_rows_bwd(res, ct):
     ids, proto, num_rows = res
     # int32: the unique path's empty-segment sentinel relies on signed
@@ -206,7 +282,24 @@ def _gather_rows_bwd(res, ct):
     cf = ct.reshape(-1, ct.shape[-1]).astype(jnp.float32)
     if flat.shape[0] == 0:  # static: empty batch, zero gradient
         return jnp.zeros((num_rows, ct.shape[-1]), proto.dtype), None
-    mode = os.environ.get("EDL_EMB_SCATTER", "tiled")
+    mode = os.environ.get("EDL_EMB_SCATTER", "pallas")
+    if mode == "pallas":
+        from elasticdl_tpu.ops import pallas_scatter
+
+        bs_p = pallas_scatter.block_rows()
+        # window cap: w scales as slack*n*bs/num_rows, and a small vocab
+        # under a huge batch (just past the 2*bs gate) would demand a
+        # VMEM window far beyond the kernel's ~4 MB budget — those shapes
+        # route to the tiled path instead of failing Mosaic allocation
+        est_w = _window_slack() * flat.shape[0] * bs_p / max(1, num_rows)
+        if (pallas_scatter.runnable()
+                and num_rows >= 2 * bs_p
+                and flat.shape[0] >= 4096
+                and est_w <= 16384):
+            order = jnp.argsort(flat)
+            d_table = _pallas_table_grad(cf[order], flat[order], num_rows)
+            return d_table.astype(proto.dtype), None
+        mode = "tiled"   # no TPU / small shapes: the XLA tiled path
     if mode == "tiled" and num_rows > 2 * _tile_rows() \
             and flat.shape[0] >= 4096:
         # below those sizes the flat scatter is already in (or near) the
@@ -257,16 +350,32 @@ gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 
 
 def _take(table: jax.Array, ids: jax.Array) -> jax.Array:
-    if os.environ.get("EDL_EMB_SCATTER", "tiled") == "xla":
+    if os.environ.get("EDL_EMB_SCATTER", "pallas") == "xla":
         return jnp.take(table, ids, axis=0)
     return gather_rows(table, ids)
 
 # Table rows are padded to a multiple of this so every device of any mesh up
 # to this many chips gets an equal shard (shard_map needs even shards).
 VOCAB_ALIGN = 256
+# Large tables align to 8192 instead: the Pallas placement kernel emits
+# whole row-blocks, and a vocab that isn't block-aligned costs a 178 MB
+# epilogue slice-copy (~4 ms/step measured) to trim the padding. 8192 is
+# a multiple of every power-of-two block size the kernel sweeps, so the
+# alignment holds regardless of EDL_EMB_PALLAS_BS. Absolute overhead is
+# bounded by 8191 extra rows (~0.5 MB at D=16).
+# NOTE (round-5 geometry change): tables created before this alignment
+# existed were padded to 256; their checkpoints restore only into models
+# built with the same geometry (pass align=VOCAB_ALIGN explicitly to
+# reproduce it). The padded vocab has always been baked into checkpoints —
+# this changes which value large-vocab models bake.
+PALLAS_VOCAB_MIN = 64 * 1024
+PALLAS_VOCAB_ALIGN = 8192
 
 
-def padded_vocab(vocab_size: int, align: int = VOCAB_ALIGN) -> int:
+def padded_vocab(vocab_size: int, align: Optional[int] = None) -> int:
+    if align is None:
+        align = (PALLAS_VOCAB_ALIGN
+                 if vocab_size >= PALLAS_VOCAB_MIN else VOCAB_ALIGN)
     return ((vocab_size + align - 1) // align) * align
 
 

@@ -250,6 +250,7 @@ class Trainer:
             dict(spec.eval_metrics_fn()) if spec.eval_metrics_fn else {}
         )
         self._train_step = None
+        self._cost_cache = None
         self._train_many = None
         self._eval_step = None
         self._eval_many = None
@@ -472,16 +473,21 @@ class Trainer:
             ca = lowered.cost_analysis()
             d = ca if isinstance(ca, dict) else (ca[0] if ca else {})
             if not d.get("flops"):
-                # PJRT-plugin backends (the axon TPU here) return None from
-                # the client-side lowered analysis; the compiled
-                # executable's analysis is computed by the backend and does
-                # work there. Costs one AOT compile — the caller (bench)
-                # has already paid the jit compile for the same shapes, so
-                # this only runs when the cheap path yields nothing.
-                try:
-                    d = lowered.compile().cost_analysis() or {}
-                except Exception:
-                    d = {}
+                # PJRT-plugin backends (the axon TPU here) return None
+                # from the client-side lowered analysis; the compiled
+                # executable's analysis is computed by the backend and
+                # does work there. This is a FRESH AOT compile of the
+                # single-step program (train_many's scan is a different
+                # program, so nothing is cached) — memoized so repeat
+                # callers pay it once per trainer.
+                if self._cost_cache is not None:
+                    d = self._cost_cache
+                else:
+                    try:
+                        d = lowered.compile().cost_analysis() or {}
+                    except Exception:
+                        d = {}
+                    self._cost_cache = d
         return {
             "flops": float(d.get("flops", 0.0)),
             "bytes accessed": float(d.get("bytes accessed", 0.0)),
