@@ -307,21 +307,26 @@ def bench_embedding_modes(mesh, np):
             # off-TPU the pallas mode reroutes to tiled — recording both
             # rows would be the same program under two labels
             results["pallas_is_tiled_off_tpu"] = True
+        def make_step():
+            # fresh jit per use: EDL_EMB_SCATTER is read at trace time,
+            # and the sweep + skew legs must each trace their own step
+            @jax.jit
+            def step(t, s, i):
+                g = jax.grad(
+                    lambda tt: jnp.sum(
+                        emb_ops.embedding_lookup(tt, i, mode="auto") ** 2
+                    )
+                )(t)
+                up, s = opt.update(g, s)
+                return optax.apply_updates(t, up), s
+
+            return step
+
         for scatter in ("pallas", "tiled", "sorted", "unique", "xla"):
             os.environ["EDL_EMB_SCATTER"] = scatter
             try:
                 opt_state = opt.init(table)
-
-                @jax.jit
-                def sstep(t, s, i):
-                    g = jax.grad(
-                        lambda tt: jnp.sum(
-                            emb_ops.embedding_lookup(tt, i, mode="auto") ** 2
-                        )
-                    )(t)
-                    up, s = opt.update(g, s)
-                    return optax.apply_updates(t, up), s
-
+                sstep = make_step()
                 sbox = [sstep(table, opt_state, ids)]
                 float(jnp.sum(sbox[0][0][:1]))
 
@@ -334,6 +339,23 @@ def bench_embedding_modes(mesh, np):
                     n * B * L / dt, 1)
             finally:
                 os.environ.pop("EDL_EMB_SCATTER", None)
+
+        # skewed-id leg: 30% of all slots hit ONE hot id — real recsys
+        # head skew. Exercises the pallas dedupe middle path (adjacent-
+        # duplicate compaction before placement); without it every step
+        # lands on the flat scatter.
+        skew_np = np.random.RandomState(2).randint(0, V, (B, L)).astype(
+            np.int32)
+        skew_np[:, :8] = 12345
+        skew_ids = jax.device_put(skew_np, repl)
+        sk = make_step()
+        kbox = [sk(table, opt.init(table), skew_ids)]
+        float(jnp.sum(kbox[0][0][:1]))
+        n, dt = timed_loop(
+            lambda i: kbox.__setitem__(
+                0, sk(kbox[0][0], kbox[0][1], skew_ids)),
+            lambda: float(jnp.sum(kbox[0][0][:1])), 5)
+        results["update_rows_per_sec_skewed_ids"] = round(n * B * L / dt, 1)
 
         if int(mesh.devices.size) == 1:
             # honesty marker (code-review r5 pt3): embedding_lookup
