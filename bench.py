@@ -341,9 +341,12 @@ def bench_embedding_modes(mesh, np):
                 os.environ.pop("EDL_EMB_SCATTER", None)
 
         # skewed-id leg: 30% of all slots hit ONE hot id — real recsys
-        # head skew. Exercises the pallas dedupe middle path (adjacent-
-        # duplicate compaction before placement); without it every step
-        # lands on the flat scatter.
+        # head skew. On TPU this exercises the pallas dedupe middle path
+        # (adjacent-duplicate compaction before placement); off-TPU the
+        # default reroutes to tiled, whose overflow guard lands on the
+        # flat scatter — the path label below keeps the record honest.
+        results["skewed_ids_path"] = (
+            "pallas-dedupe" if _ps.runnable() else "tiled-flat-fallback")
         skew_np = np.random.RandomState(2).randint(0, V, (B, L)).astype(
             np.int32)
         skew_np[:, :8] = 12345
