@@ -55,6 +55,25 @@ def normalize(values, mean, std):
     return (jnp.asarray(values, jnp.float32) - mean) / jnp.maximum(std, 1e-12)
 
 
+def multi_hot(ids, num_classes: int, weights=None):
+    """Multi-hot / count encoding of an id bag: (..., L) int ids →
+    (..., num_classes) float32, with negative ids (padding slots) skipped.
+
+    Reference parity: the CategoryEncoding-style layer (binary/count
+    output modes collapse to this plus an optional clip). Built as a
+    one-hot sum so XLA keeps it fused — no scatter in the hot path —
+    which is fine at preprocessing vocabulary sizes (<= a few thousand
+    classes; use an Embedding table beyond that).
+    """
+    ids = jnp.asarray(ids, jnp.int32)
+    # one_hot already zero-encodes negative/out-of-range ids, which is
+    # exactly the padding-skip this needs — no extra mask
+    oh = jax.nn.one_hot(ids, num_classes, dtype=jnp.float32)
+    if weights is not None:
+        oh = oh * jnp.asarray(weights, jnp.float32)[..., None]
+    return jnp.sum(oh, axis=-2)
+
+
 def log_normalize(values):
     """log(1+x) squashing — the standard Criteo dense-feature transform."""
     v = jnp.asarray(values, jnp.float32)
@@ -166,3 +185,41 @@ def pad_to_dense(
         r = list(r)[:max_len]
         out[i, : len(r)] = r
     return out
+
+
+def fit_discretization(values, num_bins: int) -> np.ndarray:
+    """Quantile boundaries for `bucketize`, fitted from data — the adapt()
+    half of the reference's Discretization layer. Returns num_bins - 1
+    boundaries splitting `values` into near-equal-mass buckets; feed them
+    to `bucketize` / `feature_spec.bucketized` as plain data.
+
+    Host-side by design: fitting is a one-time ingest-stage pass (like the
+    reference's layer adapt before training), not per-step work.
+    """
+    flat = np.asarray(values, np.float64).reshape(-1)
+    flat = flat[np.isfinite(flat)]
+    if flat.size == 0 or num_bins < 2:
+        return np.zeros((0,), np.float32)
+    qs = np.linspace(0.0, 1.0, num_bins + 1)[1:-1]
+    # dedupe AFTER the float32 cast: quantiles distinct in float64 can
+    # collapse at float32 and duplicated boundaries mean permanently
+    # empty buckets (code-review r5 pt7)
+    return np.unique(np.quantile(flat, qs).astype(np.float32))
+
+
+def vocab_from_file(path: str, *, max_size: Optional[int] = None) -> List[str]:
+    """One-token-per-line vocabulary file → ordered token list, for
+    StringLookup / feature_spec.lookup (reference parity: IndexLookup's
+    vocabulary-file constructor; the census zoo shipped its vocabularies
+    this way). Blank lines are skipped; duplicates keep first occurrence.
+    """
+    seen: Dict[str, None] = {}
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            tok = line.rstrip("\n")
+            if not tok or tok in seen:
+                continue
+            seen[tok] = None
+            if max_size is not None and len(seen) >= max_size:
+                break
+    return list(seen)

@@ -57,3 +57,45 @@ def test_string_lookup():
 def test_pad_to_dense():
     out = pp.pad_to_dense([[1, 2, 3], [7]], max_len=2)
     np.testing.assert_array_equal(out, [[1, 2], [7, -1]])
+
+
+def test_multi_hot_skips_padding_and_counts():
+    """CategoryEncoding parity: multi-hot counts duplicate ids, skips
+    negative padding slots, and applies per-slot weights."""
+    import jax.numpy as jnp
+
+    ids = np.asarray([[1, 1, 3, -1], [0, 2, -1, -1]], np.int32)
+    out = np.asarray(pp.multi_hot(ids, 4))
+    np.testing.assert_array_equal(
+        out, [[0, 2, 0, 1], [1, 0, 1, 0]])
+    w = np.asarray([[0.5, 0.5, 2.0, 9.0], [1.0, 3.0, 9.0, 9.0]], np.float32)
+    outw = np.asarray(pp.multi_hot(ids, 4, weights=w))
+    np.testing.assert_allclose(outw, [[0, 1.0, 0, 2.0], [1.0, 0, 3.0, 0]])
+
+
+def test_fit_discretization_quantiles_feed_bucketize():
+    """Discretization adapt() parity: fitted boundaries split the fitted
+    data into near-equal-mass buckets and compose with bucketize."""
+    r = np.random.RandomState(0)
+    vals = np.concatenate([r.randn(4000), r.randn(1000) * 10 + 50])
+    bounds = pp.fit_discretization(vals, num_bins=8)
+    assert len(bounds) == 7 and np.all(np.diff(bounds) > 0)
+    buckets = np.asarray(pp.bucketize(vals, bounds))
+    counts = np.bincount(buckets, minlength=8)
+    assert counts.min() > 0.7 * len(vals) / 8  # near-equal mass
+    # degenerate inputs: too few bins / empty data -> no boundaries
+    assert len(pp.fit_discretization(vals, 1)) == 0
+    assert len(pp.fit_discretization([], 4)) == 0
+
+
+def test_vocab_from_file_round_trip(tmp_path):
+    """IndexLookup vocabulary-file parity: file -> tokens -> StringLookup
+    gives stable ids; blanks and duplicates are dropped."""
+    p = tmp_path / "vocab.txt"
+    p.write_text("apple\nbanana\n\ncherry\nbanana\n", encoding="utf-8")
+    vocab = pp.vocab_from_file(str(p))
+    assert vocab == ["apple", "banana", "cherry"]
+    assert pp.vocab_from_file(str(p), max_size=2) == ["apple", "banana"]
+    lk = pp.StringLookup(vocab, num_oov=1)
+    np.testing.assert_array_equal(
+        lk(np.asarray(["banana", "durian", "apple"])), [2, 0, 1])
