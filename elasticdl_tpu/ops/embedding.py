@@ -270,14 +270,17 @@ def _pallas_table_grad(cf, sf, num_rows):
     def pallas_branch(cf_t, sf_pad, starts):
         from elasticdl_tpu.ops.pallas_attention import _interpret_active
 
-        out = pallas_scatter.place_sorted_grads(
+        out_t = pallas_scatter.place_sorted_grads(
             cf_t, sf_pad[None, :], starts,
             num_rows=vpad, block_rows=bs, w=w, d_out=d,
             split=os.environ.get(
                 "EDL_EMB_PALLAS_PRECISION", "split") != "bf16",
+            group=pallas_scatter.group_blocks(),
             interpret=_interpret_active(),
         )
-        return out[:num_rows]
+        # kernel emits (D, vpad) — rows on lanes, see pallas_scatter —
+        # one bandwidth-class transpose restores the param layout
+        return out_t[:, :num_rows].T
 
     def flat(cf_t, sf_pad, starts):
         del starts

@@ -57,65 +57,98 @@ def block_rows() -> int:
 
 
 def _kernel(starts_ref, sf_ref, cf_ref, out_ref, ids_vmem, vec_vmem,
-            sem_ids, sem_vec, *, bs, w, d, d_out, split):
+            sem_ids, sem_vec, *, bs, w, d, d_out, split, group):
+    """`group` output blocks per grid step (default 1 — see the sweep
+    note in place_sorted_grads). Sub-block indices are PYTHON ints
+    (static scratch slots: the dynamic-slot double-buffer variant
+    measured 5.5x SLOWER), and a step's DMAs all start before the first
+    wait so multi-block groups overlap their transfers."""
     b = pl.program_id(0)
-    # the caller aligns starts to 128: Mosaic must PROVE dynamic DMA
-    # offsets land on tile boundaries, and both streams put the window
-    # dimension on LANES — ids as a (1, N) row, gradients TRANSPOSED to
-    # (D, N) (slicing the untransposed (N, D) would lane-slice a
-    # 128-padded memref, which Mosaic rejects)
-    start = pl.multiple_of(starts_ref[b], 128)
-    cp_ids = pltpu.make_async_copy(
-        sf_ref.at[:, pl.ds(start, w)], ids_vmem, sem_ids)
-    cp_vec = pltpu.make_async_copy(
-        cf_ref.at[:, pl.ds(start, w)], vec_vmem, sem_vec)
-    cp_ids.start()
-    cp_vec.start()
-    cp_ids.wait()
-    cp_vec.wait()
 
-    base = b * bs
-    acc = jnp.zeros((bs, d), jnp.float32)
-    row_ids = jax.lax.broadcasted_iota(jnp.int32, (bs, CHUNK), 0) + base
-    for c in range(w // CHUNK):
-        ids_c = ids_vmem[:, c * CHUNK:(c + 1) * CHUNK]       # (1, C)
-        vec_c = vec_vmem[:, c * CHUNK:(c + 1) * CHUNK]       # (D, C)
-        onehot = (row_ids == ids_c).astype(jnp.bfloat16)     # exact 0/1
-        dims = (((1,), (1,)), ((), ()))
-        if split:
-            # Two-term bf16 split of the f32 gradient values: the MXU
-            # runs bf16, and a single cast rounds the accumulated
-            # gradients to ~8 mantissa bits (0.4% rel err measured);
-            # hi+lo recovers ~16 bits (~4e-6 rel) for a second matmul
-            # pass. EDL_EMB_PALLAS_PRECISION=bf16 drops the second pass
-            # for models already training in bf16 end to end.
-            hi = vec_c.astype(jnp.bfloat16)
-            lo = (vec_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
-            acc = acc + jax.lax.dot_general(
-                onehot, hi, dimension_numbers=dims,
-                preferred_element_type=jnp.float32,
-            ) + jax.lax.dot_general(
-                onehot, lo, dimension_numbers=dims,
-                preferred_element_type=jnp.float32,
-            )
-        else:
-            acc = acc + jax.lax.dot_general(
-                onehot, vec_c.astype(jnp.bfloat16),
-                dimension_numbers=dims,
-                preferred_element_type=jnp.float32,
-            )
-    # d is the 8-aligned padded depth the DMA needs; the real embedding
-    # width d_out is restored by an in-register slice before the write
-    out_ref[:] = acc[:, :d_out]
+    def copies(g):
+        # the caller aligns starts to 128: Mosaic must PROVE dynamic DMA
+        # offsets land on tile boundaries, and both streams put the
+        # window dimension on LANES — ids as a (1, N) row, gradients
+        # TRANSPOSED to (D, N) (slicing the untransposed (N, D) would
+        # lane-slice a 128-padded memref, which Mosaic rejects)
+        start = pl.multiple_of(starts_ref[b * group + g], 128)
+        return (
+            pltpu.make_async_copy(
+                sf_ref.at[:, pl.ds(start, w)], ids_vmem.at[g],
+                sem_ids.at[g]),
+            pltpu.make_async_copy(
+                cf_ref.at[:, pl.ds(start, w)], vec_vmem.at[g],
+                sem_vec.at[g]),
+        )
+
+    for g in range(group):
+        for cp in copies(g):
+            cp.start()
+
+    for g in range(group):
+        for cp in copies(g):
+            cp.wait()
+        base = (b * group + g) * bs
+        # the accumulator is built TRANSPOSED, (D, bs): the output's
+        # row dimension must ride the 128-lane axis — a (bs, 17) block
+        # lane-pads 17 -> 128 in VMEM, a 7.5x write-bandwidth tax that
+        # was most of the kernel's cost (write-only floor 7.5 ms) and
+        # an OOM at group=8. dot_general(vec, onehot) contracting the
+        # chunk gives (D, bs) natively, no in-register transpose.
+        acc = jnp.zeros((d, bs), jnp.float32)
+        row_ids = jax.lax.broadcasted_iota(
+            jnp.int32, (bs, CHUNK), 0) + base
+        for c in range(w // CHUNK):
+            ids_c = ids_vmem[g, :, c * CHUNK:(c + 1) * CHUNK]    # (1, C)
+            vec_c = vec_vmem[g, :, c * CHUNK:(c + 1) * CHUNK]    # (D, C)
+            onehot = (row_ids == ids_c).astype(jnp.bfloat16)     # 0/1
+            dims = (((1,), (1,)), ((), ()))
+            if split:
+                # Two-term bf16 split of the f32 gradient values: the
+                # MXU runs bf16, and a single cast rounds the
+                # accumulated gradients to ~8 mantissa bits (0.4% rel
+                # err measured); hi+lo recovers ~16 bits (~4e-6 rel)
+                # for a second matmul pass. EDL_EMB_PALLAS_PRECISION=
+                # bf16 drops the second pass for models already
+                # training in bf16 end to end.
+                hi = vec_c.astype(jnp.bfloat16)
+                lo = (vec_c - hi.astype(jnp.float32)).astype(jnp.bfloat16)
+                acc = acc + jax.lax.dot_general(
+                    hi, onehot, dimension_numbers=dims,
+                    preferred_element_type=jnp.float32,
+                ) + jax.lax.dot_general(
+                    lo, onehot, dimension_numbers=dims,
+                    preferred_element_type=jnp.float32,
+                )
+            else:
+                acc = acc + jax.lax.dot_general(
+                    vec_c.astype(jnp.bfloat16), onehot,
+                    dimension_numbers=dims,
+                    preferred_element_type=jnp.float32,
+                )
+        # d is the 8-aligned padded depth the DMA needs; the real
+        # embedding width d_out is restored in-register before the write
+        out_ref[:, g * bs:(g + 1) * bs] = acc[:d_out, :]
+
+
+def group_blocks() -> int:
+    g = int(os.environ.get("EDL_EMB_PALLAS_GROUP", "1"))
+    if g < 1:
+        raise ValueError(
+            f"EDL_EMB_PALLAS_GROUP must be >= 1, got {g}")
+    return g
 
 
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "num_rows", "block_rows", "w", "d_out", "split", "interpret"))
+        "num_rows", "block_rows", "w", "d_out", "split", "group",
+        "interpret"))
 def place_sorted_grads(cf, sf, starts, *, num_rows, block_rows, w,
-                       d_out=None, split=True, interpret=False):
-    """Dense (num_rows, D) gradient from a SORTED contribution stream.
+                       d_out=None, split=True, group=1, interpret=False):
+    """Dense (D, num_rows) TRANSPOSED gradient from a SORTED stream
+    (the row dimension rides the 128-lane axis so output writes aren't
+    lane-padded; callers transpose once at the end).
 
     cf: (D, N_pad) float32 gradient rows TRANSPOSED into sorted-id order
     along lanes, padded by at least `w` columns; sf: (1, N_pad) the
@@ -140,26 +173,37 @@ def place_sorted_grads(cf, sf, starts, *, num_rows, block_rows, w,
     d_out = d if d_out is None else d_out
     bs = block_rows
     nb = num_rows // bs
+    # Chip sweep (round 5, DeepFM shape, transposed out): group 1/2/4
+    # all ~8.3 ms, group 8 EXPLODES to ~60 ms (VMEM-pressure spill
+    # signature). The write-only "7.5 ms grid floor" that motivated
+    # grouping turned out to be the lane-padded (bs, 17) write tax the
+    # transposed output already removed — per-step overhead is small.
+    # `group` is a STATIC arg (callers read group_blocks()) so env
+    # sweeps reach the jit cache key; legalize to a divisor of nb.
+    while nb % group:
+        group //= 2
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(nb,),
+        grid=(nb // group,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((bs, d_out), lambda b, starts: (b, 0)),
+        out_specs=pl.BlockSpec(
+            (d_out, bs * group), lambda b, starts: (0, b)),
         scratch_shapes=[
-            pltpu.VMEM((1, w), jnp.int32),
-            pltpu.VMEM((d, w), jnp.float32),
-            pltpu.SemaphoreType.DMA,
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((group, 1, w), jnp.int32),
+            pltpu.VMEM((group, d, w), jnp.float32),
+            pltpu.SemaphoreType.DMA((group,)),
+            pltpu.SemaphoreType.DMA((group,)),
         ],
     )
     return pl.pallas_call(
         functools.partial(
-            _kernel, bs=bs, w=w, d=d, d_out=d_out, split=split),
+            _kernel, bs=bs, w=w, d=d, d_out=d_out, split=split,
+            group=group),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((num_rows, d_out), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((d_out, num_rows), jnp.float32),
         interpret=interpret,
     )(starts, sf, cf)
 
