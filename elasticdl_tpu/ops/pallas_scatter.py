@@ -45,8 +45,11 @@ from jax.experimental.pallas import tpu as pltpu
 # AND matmul FLOPs) scales with vocab * window, and the window shrinks
 # with the block, so smaller blocks win until grid/DMA overhead bites —
 # block size is env-tunable for the bench sweep. Chip sweep (round 5,
-# DeepFM shape): 8192/4096/2048/1024/512 -> 16.4/16.5/13.0/15.4/16.3
-# ms full-update-step with the split-precision kernel; 2048 is the knee.
+# DeepFM shape, TRANSPOSED output): the standalone D=16/sgd update step
+# measured 2048/4096/8192 -> 12.9/11.6/15.4 ms, but the FULL DeepFM
+# step (D=17, adam, fwd gather in the same program) measured 589k
+# samples/s at 2048 vs 560k at 4096 — the end-to-end metric wins, so
+# 2048 stays the default.
 DEFAULT_BLOCK_ROWS = 2048
 CHUNK = 256
 
