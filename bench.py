@@ -511,6 +511,11 @@ def bench_time_to_auc(mesh, np, target=0.75):
         auc = eval_auc(state)
     return {
         "target_auc": target,
+        # compile_and_first_group_s is the one deliberately-timed compile;
+        # with the persistent cache it measures deserialization on warm
+        # runs — the marker keeps round-log comparisons honest
+        "compile_cache_prewarmed":
+            os.environ.get("EDL_BENCH_CACHE_PREWARMED") == "1",
         "initial_auc": round(initial_auc, 4),
         "auc": round(auc, 4),
         "seconds_to_auc": round(time.perf_counter() - t0, 3),
@@ -796,6 +801,36 @@ def main():
 
         _xb._backend_factories.pop("axon", None)
         jax.config.update("jax_platforms", "cpu")
+
+    # Persistent XLA compilation cache shared by every leg subprocess:
+    # each leg re-lowers the same programs (DeepFM's headline compile is
+    # 20-60 s on the chip), and timed_loop regions always run after
+    # warmup, so caching only buys wall-clock headroom against the
+    # driver's global deadline. The ONE metric that deliberately times
+    # compilation — time_to_auc's compile_and_first_group_s — gets a
+    # warm/cold marker (EDL_BENCH_CACHE_PREWARMED, below) so round logs
+    # stay comparable. EDL_BENCH_NO_CACHE=1 opts out entirely.
+    if os.environ.get("EDL_BENCH_NO_CACHE") != "1":
+        import types
+
+        from elasticdl_tpu.common.runtime import configure_jax_runtime
+
+        cache_dir = os.environ.get(
+            "EDL_BENCH_CACHE_DIR", "/tmp/edl_bench_xla_cache")
+        try:
+            prewarmed = bool(os.path.isdir(cache_dir)
+                             and os.listdir(cache_dir))
+            os.environ.setdefault(
+                "EDL_BENCH_CACHE_PREWARMED", "1" if prewarmed else "0")
+            os.makedirs(cache_dir, exist_ok=True)
+            # the production helper (common/runtime.py), not a local
+            # re-implementation; -1 keeps JAX's min-compile-time default
+            configure_jax_runtime(types.SimpleNamespace(
+                compilation_cache_dir=cache_dir,
+                compilation_cache_min_compile_s=-1.0,
+            ))
+        except Exception:
+            pass   # cache is an optimization, never a failure
 
     if len(sys.argv) >= 3 and sys.argv[1] == "--leg":
         # subprocess mode: one leg, one JSON line
