@@ -33,6 +33,11 @@ class Embedding(nn.Module):
     combiner: None → (..., L, D); 'sum'|'mean'|'sqrtn' → (..., D) over the
       last id axis, with negative ids treated as padding slots.
     mode: 'manual' (explicit shard_map collectives) or 'auto' (XLA GSPMD).
+    vocab_align: override the padding alignment (None = the current rule).
+      Restoring a checkpoint written under an older padding rule requires
+      rebuilding the model with ITS alignment — e.g. vocab_align=256 for
+      large-vocab checkpoints from before the round-5 8192 alignment
+      (CheckpointManager's restore error names the value to pass).
     """
 
     input_dim: int
@@ -41,10 +46,11 @@ class Embedding(nn.Module):
     mode: str = "manual"
     embeddings_initializer: Callable = nn.initializers.uniform(scale=0.05)
     param_dtype: jnp.dtype = jnp.float32
+    vocab_align: Optional[int] = None
 
     @nn.compact
     def __call__(self, ids: jax.Array, weights: Optional[jax.Array] = None):
-        rows = emb_ops.padded_vocab(self.input_dim)
+        rows = emb_ops.padded_vocab(self.input_dim, self.vocab_align)
         axes = emb_ops.table_partition_axes()
         table = self.param(
             "table",

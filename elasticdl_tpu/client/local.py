@@ -22,7 +22,7 @@ from elasticdl_tpu.master.process_manager import ProcessManager
 logger = default_logger(__name__)
 
 
-from elasticdl_tpu.common.net import free_port  # noqa: F401  (re-export)
+from elasticdl_tpu.common.net import bind_with_retry, free_port  # noqa: F401  (re-export)
 
 
 def run_local(
@@ -33,8 +33,17 @@ def run_local(
 ) -> int:
     """Run a whole job on this host: in-process master, subprocess workers."""
     if cfg.master_addr.endswith(":0"):
-        cfg = cfg.replace(master_addr=f"localhost:{free_port()}")
-    master = Master(cfg)
+        # bind_with_retry closes free_port()'s TOCTOU window: Master binds
+        # its port during construction and raises PortBindError when the
+        # pick was lost to a concurrent bind — retry with a fresh port
+        # instead of failing the whole job submission
+        def build(port: int) -> Master:
+            return Master(cfg.replace(master_addr=f"localhost:{port}"))
+
+        port, master = bind_with_retry(build)
+        cfg = cfg.replace(master_addr=f"localhost:{port}")
+    else:
+        master = Master(cfg)
     manager = ProcessManager(
         cfg,
         membership=master.membership,

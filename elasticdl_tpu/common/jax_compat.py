@@ -1,0 +1,107 @@
+"""Compatibility shims for older jax releases (gated, no-op on new jax).
+
+The framework is written against the current ambient-mesh API surface:
+`jax.set_mesh(mesh)` as a context manager, meshless `jax.shard_map(...)`
+resolving the mesh from the ambient context, and
+`jax.sharding.get_abstract_mesh()` to introspect it. Older jaxlib images
+(e.g. 0.4.x, which this container bakes in) predate all three but carry
+exact functional equivalents:
+
+- `with mesh:` enters the thread-local resource env (the 0.4.x ambient
+  mesh), which `jax._src.mesh.thread_resources` exposes during tracing;
+- `jax.experimental.shard_map.shard_map` takes the mesh explicitly and
+  spells partial-manual axes as the complementary `auto=` set instead of
+  `axis_names=`.
+
+`ensure()` installs adapters onto the `jax` module ONLY for attributes
+that are missing, so on a current jax it does exactly nothing. Call it
+from any module that uses these APIs, before first use (imports are cheap:
+it runs once and latches).
+
+This is a dependency gate, not a polyfill of semantics we don't use: the
+adapters cover the call forms in this repo (context-managed set_mesh,
+shard_map with in_specs/out_specs/axis_names, get_abstract_mesh for
+axis_names/shape introspection) — not the full new-jax sharding-in-types
+feature set.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+_done = False
+
+
+def ensure() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+    import jax
+
+    if not hasattr(jax, "set_mesh"):
+        jax.set_mesh = _set_mesh
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _get_ambient_mesh
+    if not hasattr(jax.lax, "axis_size"):
+        jax.lax.axis_size = _axis_size
+    if not hasattr(jax.lax, "pcast"):
+        # varying-type casts only exist for the new replication checker;
+        # with check_rep off (see _shard_map) the cast is a no-op
+        jax.lax.pcast = _pcast_identity
+
+
+@contextlib.contextmanager
+def _set_mesh(mesh):
+    """`with jax.set_mesh(mesh):` -> the 0.4.x thread-local resource env."""
+    with mesh:
+        yield mesh
+
+
+def _get_ambient_mesh():
+    """The mesh `with mesh:` made ambient (an empty Mesh outside any).
+    Callers in this repo only read `.axis_names` / `.shape`, which the
+    physical Mesh serves identically to the new AbstractMesh."""
+    from jax._src.mesh import thread_resources
+
+    return thread_resources.env.physical_mesh
+
+
+def _axis_size(axis_name):
+    """Static size of a named mesh axis inside shard_map tracing. 0.4.x
+    keeps it in core's axis env (axis_frame returns the bare int there)."""
+    from jax._src.core import axis_frame
+
+    frame = axis_frame(axis_name)
+    return frame if isinstance(frame, int) else frame.size
+
+
+def _pcast_identity(x, axes=(), *, to=None):
+    return x
+
+
+def _shard_map(f, mesh=None, in_specs=None, out_specs=None, axis_names=None,
+               **kwargs):
+    """Meshless `jax.shard_map(f, in_specs=..., out_specs=...,
+    axis_names=...)` on top of the experimental API: mesh from the ambient
+    context, `axis_names` (manual axes) mapped to its complement `auto`.
+    check_rep defaults off — the 0.4.x replication checker predates some
+    collectives these kernels use."""
+    from jax.experimental.shard_map import shard_map as _sm
+
+    if mesh is None:
+        mesh = _get_ambient_mesh()
+        if not mesh.axis_names:
+            raise ValueError(
+                "jax.shard_map compat: no mesh argument and no ambient mesh "
+                "(enter `with jax.set_mesh(mesh):` first)"
+            )
+    if axis_names is not None:
+        kwargs["auto"] = frozenset(mesh.axis_names) - set(axis_names)
+    kwargs.setdefault("check_rep", False)
+    if in_specs is None or out_specs is None:
+        raise TypeError("shard_map compat requires in_specs and out_specs")
+    return _sm(f, mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)

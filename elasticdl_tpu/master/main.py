@@ -132,9 +132,17 @@ class Master:
         self.server = make_server()
         add_master_servicer(self.server, self.servicer)
         port = int(cfg.master_addr.rsplit(":", 1)[1])
-        bound = self.server.add_insecure_port(f"[::]:{port}")
+        from elasticdl_tpu.common.net import PortBindError
+
+        # PortBindError (a RuntimeError) lets launchers that picked the
+        # port via free_port() retry with a fresh one (net.bind_with_retry).
+        # Depending on grpc version, a lost bind returns 0 or raises.
+        try:
+            bound = self.server.add_insecure_port(f"[::]:{port}")
+        except RuntimeError as e:
+            raise PortBindError(f"could not bind master port {port}: {e}") from e
         if bound == 0:
-            raise RuntimeError(f"could not bind master port {port}")
+            raise PortBindError(f"could not bind master port {port}")
 
     def start(self) -> None:
         self.server.start()

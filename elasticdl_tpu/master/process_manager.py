@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.net import free_port
 from elasticdl_tpu.common.constants import ExitCode, PodStatus, WorkerEnv
@@ -137,8 +138,19 @@ class ProcessManager:
             )
             log = open(os.path.join(self._log_dir, name), "ab")
             stdout = stderr = log
+        cmd = [sys.executable, "-m", "elasticdl_tpu.worker.main", *argv]
+        try:
+            # chaos hook: delay/crash keep their documented semantics
+            # (crash = os._exit of THIS process, honoring code=); drop is
+            # remapped below
+            faults.fire("proc.spawn")
+        except faults.FaultInjected:
+            # drop: spawn a doomed stand-in that exits 1 immediately (a pod
+            # that never comes up), exercising death detection and the
+            # relaunch budget rather than silently skipping the spawn
+            cmd = [sys.executable, "-c", "raise SystemExit(1)"]
         proc = subprocess.Popen(
-            [sys.executable, "-m", "elasticdl_tpu.worker.main", *argv],
+            cmd,
             env=env,
             stdout=stdout,
             stderr=stderr,

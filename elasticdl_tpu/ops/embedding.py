@@ -36,6 +36,9 @@ from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+from elasticdl_tpu.common import jax_compat
+
+jax_compat.ensure()  # older-jax API adapters (no-op on current jax)
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -425,6 +428,26 @@ def padded_vocab(vocab_size: int, align: Optional[int] = None) -> int:
         align = (PALLAS_VOCAB_ALIGN
                  if vocab_size >= PALLAS_VOCAB_MIN else VOCAB_ALIGN)
     return ((vocab_size + align - 1) // align) * align
+
+
+def geometry_descriptor() -> dict:
+    """The vocab-padding rule baked into embedding-table shapes, as data.
+
+    Checkpoints persist padded tables, so the padding rule is part of the
+    checkpoint geometry: a model rebuilt under a *different* rule cannot
+    restore them (orbax shape mismatch). CheckpointManager records this
+    descriptor beside every checkpoint dir and compares it on a failed
+    restore, turning the raw shape error into an actionable message
+    ("rebuild with vocab_align=256"). `geometry_version` bumps whenever the
+    rule changes: v1 = align 256 for every vocab; v2 (round 5) = 8192 for
+    vocabs >= 64k.
+    """
+    return {
+        "geometry_version": 2,
+        "vocab_align": VOCAB_ALIGN,
+        "pallas_vocab_align": PALLAS_VOCAB_ALIGN,
+        "pallas_vocab_min": PALLAS_VOCAB_MIN,
+    }
 
 
 def ambient_axes() -> Tuple[str, ...]:

@@ -97,8 +97,12 @@ def interpret_mode():
     depend on JAX private internals. Tests use THIS, not pltpu directly."""
     prev = os.environ.get(_INTERPRET_ENV)
     os.environ[_INTERPRET_ENV] = "1"
+    # Older jax has no global interpret-mode context; the env flag above is
+    # the primary routing signal (every pallas_call here threads an explicit
+    # interpret= from _interpret_active), so a nullcontext loses nothing.
+    force = getattr(pltpu, "force_tpu_interpret_mode", contextlib.nullcontext)
     try:
-        with pltpu.force_tpu_interpret_mode():
+        with force():
             yield
     finally:
         if prev is None:
@@ -493,7 +497,7 @@ def flash_attention_lse(
     causal: bool = True,
     q_offset=0, kv_offset=0,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Flash attention over (B, T, H, D) q/k/v returning (out, lse) with
     lse (B, H, Tq) float32. Offsets may be Python ints OR traced int32
@@ -509,7 +513,7 @@ def flash_attention(
     causal: bool = True,
     q_offset=0, kv_offset=0,
     block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Same contract as `ops.attention.full_attention` (output only; the
     cheaper backward — no lse cotangent input)."""
@@ -520,6 +524,11 @@ def flash_attention(
 
 def _plan_call(q, k, causal, q_offset, kv_offset, block_q, block_k,
                interpret, with_lse):
+    if interpret is None:
+        # default = the ambient interpret signal: on new jax the global
+        # force_tpu_interpret_mode config also catches interpret=False, but
+        # older jax has no global mode — the explicit flag must carry it
+        interpret = _interpret_active()
     blocks = _plan_blocks(q.shape, k.shape, block_q, block_k,
                           dtype=q.dtype)
     if blocks is None:

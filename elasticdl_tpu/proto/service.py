@@ -4,17 +4,31 @@ so the service bindings that `elasticdl_pb2_grpc.py` would contain in the
 reference are spelled out here by hand).
 
 Reference parity: the generated MasterServicer/MasterStub pair of
-elasticdl/proto/elasticdl.proto.
+elasticdl/proto/elasticdl.proto — plus the hardening the reference never
+had: every client call carries a deadline, idempotent RPCs retry with
+exponential backoff + jitter, and a circuit breaker stops a worker from
+hammering a dead master (RetryingMasterStub). Fault-injection sites
+(`rpc.<method>` / `rpc.<method>.recv`, common/faults.py) wrap each send so
+chaos schedules can drop/delay/lose-response any call deterministically.
 """
 
 from __future__ import annotations
 
-from typing import Any
+import random
+import re
+import threading
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
 
 import grpc
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import GRPC
+from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+logger = default_logger(__name__)
 
 SERVICE_NAME = "elasticdl_tpu.Master"
 
@@ -30,6 +44,134 @@ _RPCS = {
     "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatResponse),
     "GetJobStatus": (pb.Empty, pb.JobStatusResponse),
 }
+
+
+def rpc_site(name: str) -> str:
+    """Fault-injection site for an RPC: snake_case under the rpc. prefix
+    (GetTask -> rpc.get_task)."""
+    return "rpc." + re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()
+
+
+@dataclass(frozen=True)
+class RpcPolicy:
+    """Per-RPC client behavior: default deadline, retry eligibility.
+
+    `idempotent` means a retry after an ambiguous failure (deadline, lost
+    response) cannot change job state a second time. Only those RPCs are
+    retried; for the rest a retry is the caller's decision because it needs
+    protocol context:
+
+      RegisterWorker          NOT idempotent — re-registering allocates a
+                              fresh membership version (and possibly id)
+      GetTask                 NOT idempotent — a lost response leaves a task
+                              leased; retrying would lease a second one and
+                              expire the first into a spurious requeue
+      ReportTaskResult        NOT idempotent at this layer — the dispatcher
+                              dedupes, but the duplicate returns
+                              accepted=False, which the preemption-drain
+                              protocol treats as a rejection (it would
+                              delete the drain checkpoint it must keep)
+      Heartbeat               NOT idempotent — the servicer consumes the
+                              one-shot should_checkpoint flag on read, so a
+                              retry after a lost response would report
+                              should_checkpoint=False and silently swallow
+                              a master-requested (resize-quiesce)
+                              checkpoint. The heartbeat LOOP is the retry
+                              mechanism: the next beat arrives in
+                              worker_heartbeat_s anyway.
+      ReportEvaluationMetrics idempotent — the evaluation service dedupes
+                              by task_id and drops repeats silently
+      GetJobStatus            idempotent — read-only
+    """
+
+    timeout_s: float
+    idempotent: bool
+    max_attempts: int = 3
+
+
+DEFAULT_POLICIES: Dict[str, RpcPolicy] = {
+    "RegisterWorker": RpcPolicy(timeout_s=30.0, idempotent=False),
+    "GetTask": RpcPolicy(timeout_s=30.0, idempotent=False),
+    "ReportTaskResult": RpcPolicy(timeout_s=30.0, idempotent=False),
+    "ReportEvaluationMetrics": RpcPolicy(timeout_s=30.0, idempotent=True),
+    "Heartbeat": RpcPolicy(timeout_s=10.0, idempotent=False),
+    "GetJobStatus": RpcPolicy(timeout_s=10.0, idempotent=True),
+}
+
+
+class MasterUnreachableError(ConnectionError):
+    """Raised fast (no wire traffic) while the circuit breaker is open."""
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker shared by all of a stub's RPCs.
+
+    After `failure_threshold` consecutive failures the circuit opens: calls
+    fail immediately with MasterUnreachableError for `cooldown_s`, then ONE
+    probe call is let through (half-open); its outcome closes or re-opens
+    the circuit. This keeps a worker from burning its master-unreachable
+    grace window inside per-call connect timeouts against a dead address —
+    the wall-clock-based `_master_unreachable` exit logic in the worker
+    still makes the kill decision; the breaker just makes the failing
+    window cheap and the log honest.
+    """
+
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 10.0):
+        self.failure_threshold = failure_threshold
+        self.cooldown_s = cooldown_s
+        self.consecutive_failures = 0
+        self._opened_at: Optional[float] = None
+        self._probe_in_flight = False
+        # shared by the worker's heartbeat thread and main task loop: the
+        # counter increment and the half-open single-probe admission are
+        # read-modify-write and need the lock to stay exact
+        self._lock = threading.Lock()
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self._opened_at is not None
+
+    def allow(self) -> bool:
+        with self._lock:
+            if self._opened_at is None:
+                return True
+            if (
+                time.monotonic() - self._opened_at >= self.cooldown_s
+                and not self._probe_in_flight
+            ):
+                # half-open: admit one probe; concurrent callers keep
+                # failing fast until the probe resolves
+                self._probe_in_flight = True
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            reopened = self._opened_at is not None
+            self.consecutive_failures = 0
+            self._opened_at = None
+            self._probe_in_flight = False
+        if reopened:
+            logger.info("master circuit closed again (probe succeeded)")
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            self._probe_in_flight = False
+            opened_now = False
+            if self._opened_at is not None:
+                self._opened_at = time.monotonic()  # re-open: restart cooldown
+            elif self.consecutive_failures >= self.failure_threshold:
+                self._opened_at = time.monotonic()
+                opened_now = True
+            failures = self.consecutive_failures
+        if opened_now:
+            logger.warning(
+                "master circuit OPEN after %d consecutive RPC failures; "
+                "failing fast for %.1fs between probes",
+                failures, self.cooldown_s,
+            )
 
 
 def add_master_servicer(server: grpc.Server, servicer: Any) -> None:
@@ -64,6 +206,117 @@ class MasterStub:
             return self._methods[name]
         except KeyError as e:
             raise AttributeError(name) from e
+
+
+class RetryingMasterStub:
+    """MasterStub hardened for the worker side of an elastic job.
+
+    Every call gets a deadline (the per-RPC policy default, or an explicit
+    `timeout=`); idempotent RPCs (see RpcPolicy) retry transient failures
+    with exponential backoff + full jitter; a shared CircuitBreaker fails
+    fast against a dead master. With no fault schedule active and no
+    failures, the only behavior change over the bare stub is the deadline.
+
+    `on_success` (if given) runs after every successful call — the worker
+    wires its `_last_master_ok` clock here so the master-unreachable exit
+    logic sees every RPC, not just the two loops that updated it by hand.
+    """
+
+    #: failures worth retrying: transport errors and injected faults. An
+    #: INVALID_ARGUMENT-style local error also lands here — acceptable,
+    #: since retries are bounded and only on idempotent calls.
+    RETRYABLE = (grpc.RpcError, faults.FaultInjected)
+
+    def __init__(
+        self,
+        channel: grpc.Channel,
+        policies: Optional[Dict[str, RpcPolicy]] = None,
+        on_success: Optional[Callable[[], None]] = None,
+        breaker: Optional[CircuitBreaker] = None,
+        backoff_base_s: float = 0.2,
+        backoff_max_s: float = 5.0,
+        rng: Optional[random.Random] = None,
+        sleep: Callable[[float], None] = time.sleep,
+        stub: Any = None,
+    ):
+        self._stub = stub if stub is not None else MasterStub(channel)
+        self._policies = dict(DEFAULT_POLICIES)
+        if policies:
+            self._policies.update(policies)
+        self._on_success = on_success
+        self.breaker = breaker if breaker is not None else CircuitBreaker()
+        self._backoff_base_s = backoff_base_s
+        self._backoff_max_s = backoff_max_s
+        self._rng = rng if rng is not None else random.Random()
+        self._sleep = sleep
+
+    def _backoff(self, attempt: int) -> float:
+        """Exponential with full jitter: uniform(0, base * 2^attempt]."""
+        cap = min(self._backoff_max_s, self._backoff_base_s * (2 ** attempt))
+        return cap * self._rng.uniform(0.1, 1.0)
+
+    def __getattr__(self, name: str):
+        if name not in _RPCS:
+            raise AttributeError(name)
+        policy = self._policies.get(name) or RpcPolicy(30.0, False)
+        method = getattr(self._stub, name)
+        site = rpc_site(name)
+        # the closure below is cached on the instance (end of this method):
+        # __getattr__ runs once per RPC name, not once per call
+
+        def call(request, timeout: Optional[float] = None):
+            attempts = policy.max_attempts if policy.idempotent else 1
+            deadline = timeout if timeout is not None else policy.timeout_s
+            last: Optional[BaseException] = None
+            for attempt in range(attempts):
+                if not self.breaker.allow():
+                    raise MasterUnreachableError(
+                        f"{name}: circuit open after "
+                        f"{self.breaker.consecutive_failures} consecutive "
+                        "failures"
+                    )
+                try:
+                    faults.fire(site)
+                    resp = method(request, timeout=deadline)
+                    # lost-response injection: the server DID process the
+                    # call; the caller never hears back
+                    faults.fire(site + ".recv")
+                except self.RETRYABLE as e:
+                    last = e
+                    self.breaker.record_failure()
+                    if attempt + 1 < attempts:
+                        delay = self._backoff(attempt)
+                        logger.warning(
+                            "%s failed (%s); retry %d/%d in %.2fs",
+                            name, _err_summary(e), attempt + 1,
+                            attempts - 1, delay,
+                        )
+                        self._sleep(delay)
+                    continue
+                except BaseException:
+                    # non-retryable error (closed channel, bad request
+                    # object, ...): record it so a half-open probe never
+                    # leaves _probe_in_flight latched — otherwise the
+                    # circuit would stay open forever against a healthy
+                    # master — then surface it unchanged
+                    self.breaker.record_failure()
+                    raise
+                self.breaker.record_success()
+                if self._on_success is not None:
+                    self._on_success()
+                return resp
+            raise last
+
+        setattr(self, name, call)
+        return call
+
+
+def _err_summary(e: BaseException) -> str:
+    code = getattr(e, "code", None)
+    try:
+        return str(code()) if callable(code) else repr(e)
+    except Exception:
+        return repr(e)
 
 
 def make_channel(addr: str) -> grpc.Channel:

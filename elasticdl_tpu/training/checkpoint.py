@@ -7,19 +7,96 @@ embedding shards over gRPC to assemble a checkpoint; here orbax writes each
 device's shard of the (mesh-sharded) TrainState directly — no gather, no
 single-host bottleneck, which is what makes preemption-triggered saves cheap
 enough for elasticity.
+
+Failure hardening on top of the plain orbax wrapper:
+
+- `restore()` with no explicit step walks BACK from the latest step when it
+  is corrupt or partially written (a crashed save, a torn copy), restoring
+  the newest step that loads and warning loudly about every step skipped.
+- A shape-mismatch restore failure is classified against the embedding
+  geometry descriptor recorded beside the checkpoints (ops/embedding.py):
+  instead of a raw orbax error, the caller gets told which vocab-padding
+  rule the checkpoint was written under and what `vocab_align=` to rebuild
+  the model with.
+- Fault-injection sites `ckpt.save` / `ckpt.restore` (common/faults.py) sit
+  in front of both operations, so chaos schedules can crash a save or fail
+  a restore deterministically. Save atomicity under a crash is orbax's
+  rename-commit; the chaos tests assert an injected crash-during-save never
+  makes a half-written step visible to `latest_step()`.
 """
 
 from __future__ import annotations
 
+import json
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
 import orbax.checkpoint as ocp
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
 
 logger = default_logger(__name__)
+
+GEOMETRY_FILE = "embedding_geometry.json"
+
+
+class CheckpointGeometryError(RuntimeError):
+    """A checkpoint cannot restore into this model because the embedding
+    vocab-padding geometry changed between write and restore."""
+
+
+def _current_geometry() -> Optional[dict]:
+    try:
+        from elasticdl_tpu.ops import embedding as emb_ops
+
+        return emb_ops.geometry_descriptor()
+    except Exception:  # pragma: no cover - embedding ops always importable
+        logger.exception("embedding geometry descriptor unavailable")
+        return None
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        for attr in ("key", "name", "idx"):
+            if hasattr(p, attr):
+                parts.append(str(getattr(p, attr)))
+                break
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def _leaf_shapes(tree: Any) -> dict:
+    out = {}
+    try:
+        for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+            if hasattr(leaf, "shape"):
+                out[_path_str(path)] = tuple(leaf.shape)
+    except Exception:  # pragma: no cover — diagnostics must not break restore
+        logger.exception("leaf-shape walk failed")
+    return out
+
+
+def _shape_mismatches(expected: Any, saved_metadata: Any) -> List[str]:
+    """Shape diffs between the requested abstract state and what the
+    checkpoint actually holds (its saved array metadata), matched by leaf
+    path name. Orbax's StandardRestore does NOT reliably fail on
+    global-shape changes — observed: restoring a (4, 2) saved array into
+    an (8, 2) sharded target silently returns an (8, 2) array — so a
+    geometry change (e.g. an embedding table padded under a different
+    vocab alignment) could otherwise "restore" into padding garbage
+    instead of erroring. Only paths present on both sides are compared, so
+    container-naming differences degrade to a no-op, never a false
+    positive."""
+    want, have = _leaf_shapes(expected), _leaf_shapes(saved_metadata)
+    return [
+        f"{path}: model wants {want[path]}, checkpoint holds {have[path]}"
+        for path in sorted(set(want) & set(have))
+        if want[path] != have[path]
+    ]
 
 
 class CheckpointManager:
@@ -32,10 +109,106 @@ class CheckpointManager:
                 max_to_keep=keep, create=True, enable_async_checkpointing=True
             ),
         )
+        self.last_restored_step: Optional[int] = None
+
+    # ------------------------------------------------------------------ #
+    # geometry metadata
+
+    def _geometry_path(self) -> str:
+        return os.path.join(self._dir, GEOMETRY_FILE)
+
+    def _record_geometry(self) -> None:
+        """Write the embedding padding rule beside the checkpoints,
+        refreshing a stale sidecar: the steps being written NOW carry the
+        CURRENT build's geometry, so a descriptor left by an older build
+        must not survive to misdiagnose later restore failures. Best-effort:
+        a failed sidecar write must not fail the save that carries the
+        actual training state. Known limitation: this records the
+        module-level rule, not per-layer Embedding(vocab_align=...)
+        overrides — the rule-matches-but-shapes-differ restore error spells
+        out that case."""
+        geo = _current_geometry()
+        if geo is None:
+            return
+        stored = self.stored_geometry()
+        if stored == geo:
+            return
+        if stored is not None:
+            logger.warning(
+                "embedding geometry sidecar is stale (%s); rewriting as %s "
+                "— steps saved from here on carry the current geometry",
+                stored, geo,
+            )
+        path = self._geometry_path()
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(geo, f, indent=2)
+            os.replace(tmp, path)
+        except OSError:
+            logger.exception("embedding geometry sidecar write failed")
+
+    def stored_geometry(self) -> Optional[dict]:
+        try:
+            with open(self._geometry_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _raise_geometry_error(self, step: int, err: BaseException) -> None:
+        """Turn a shape-mismatch restore failure into an actionable error
+        naming the alignment to rebuild with (round-5 advisor: the raw
+        orbax error gave users nothing to act on)."""
+        stored = self.stored_geometry()
+        current = _current_geometry()
+        if stored is not None and stored != current:
+            align = stored.get("vocab_align", 256)
+            raise CheckpointGeometryError(
+                f"checkpoint step {step} in {self._dir} was written under "
+                f"embedding geometry {stored} but this build pads with "
+                f"{current}. Rebuild the model with the checkpoint's "
+                f"alignment — Embedding(..., vocab_align={align}) / "
+                f"padded_vocab(v, align={align}) — or re-export the model "
+                "under the new geometry."
+            ) from err
+        if stored is None:
+            raise CheckpointGeometryError(
+                f"checkpoint step {step} in {self._dir} does not match the "
+                "model's parameter shapes and records no geometry metadata "
+                "(written before this version). If it predates the round-5 "
+                "large-vocab alignment change (256 -> 8192 for vocabs >= "
+                "64k), rebuild the model with Embedding(..., "
+                "vocab_align=256) / padded_vocab(v, align=256) to reproduce "
+                f"the old geometry. Original error: {err}"
+            ) from err
+        # The recorded padding RULE matches this build, yet shapes differ.
+        # The sidecar records the module default, not per-layer overrides,
+        # so this is either a checkpoint from a different model entirely or
+        # a vocab_align= override present on exactly one side (e.g. the
+        # checkpoint was written by a model rebuilt with the old alignment
+        # per the message above, then restored without it).
+        raise CheckpointGeometryError(
+            f"checkpoint step {step} in {self._dir} does not match the "
+            f"model's parameter shapes: {err}. The recorded padding rule "
+            f"({stored}) matches this build, so either this checkpoint "
+            "belongs to a different model, or one side was built with an "
+            "explicit Embedding(..., vocab_align=...) override — rebuild "
+            "with the same override the checkpoint was written with."
+        ) from err
+
+    # ------------------------------------------------------------------ #
 
     def save(self, state: Any, step: Optional[int] = None, wait: bool = False) -> int:
         step = int(state.model_version if step is None else step)
+        # chaos hook: ckpt.save:crash kills the process before orbax's
+        # rename-commit — the step must never become visible; :drop raises
+        # into the caller's save-failure path
+        faults.fire("ckpt.save")
+        self._record_geometry()
         self._mngr.save(step, args=ocp.args.StandardSave(state))
+        # chaos hook: ckpt.save.commit:crash dies with the async write in
+        # flight — orbax's rename-commit must leave no visible partial step
+        faults.fire("ckpt.save.commit")
         if wait:
             self._mngr.wait_until_finished()
         logger.info("checkpoint step %d -> %s", step, self._dir)
@@ -53,17 +226,75 @@ class CheckpointManager:
                 logger.exception("checkpoint manager reload failed")
         return self._mngr.latest_step()
 
+    def all_steps(self) -> List[int]:
+        return sorted(self._mngr.all_steps())
+
     def restore(self, abstract_state: Any, step: Optional[int] = None) -> Optional[Any]:
         """Restore into the sharding/structure of `abstract_state` (a pytree
-        of jax.ShapeDtypeStruct with shardings, or a concrete state)."""
-        step = self._mngr.latest_step() if step is None else step
-        if step is None:
+        of jax.ShapeDtypeStruct with shardings, or a concrete state).
+
+        With an explicit `step`, that step is tried alone. Otherwise steps
+        are tried newest-first: a corrupt/partial newest step (crashed save,
+        torn copy) is skipped with a loud warning and the previous step is
+        restored — losing one checkpoint interval beats dying at relaunch.
+        Shape mismatches are NOT walked past (every step shares the model's
+        geometry, so older steps would fail identically): they raise a
+        CheckpointGeometryError naming the alignment to rebuild with.
+        """
+        faults.fire("ckpt.restore")
+        if step is not None:
+            candidates = [step]
+        else:
+            candidates = sorted(self.all_steps(), reverse=True)
+        if not candidates:
             return None
-        restored = self._mngr.restore(
-            step, args=ocp.args.StandardRestore(abstract_state)
-        )
-        logger.info("restored checkpoint step %d from %s", step, self._dir)
-        return restored
+        last_err: Optional[BaseException] = None
+        for i, cand in enumerate(candidates):
+            try:
+                meta = self._mngr.item_metadata(cand)
+            except Exception:
+                meta = None  # unreadable metadata: let the restore attempt decide
+            if meta is not None:
+                mismatches = _shape_mismatches(abstract_state, meta)
+                if mismatches:
+                    self._raise_geometry_error(
+                        cand, ValueError("; ".join(mismatches))
+                    )
+            try:
+                restored = self._mngr.restore(
+                    cand, args=ocp.args.StandardRestore(abstract_state)
+                )
+            except Exception as e:  # noqa: BLE001 — corrupt/partial: walk back
+                # Geometry problems are detected by the metadata pre-check
+                # above, BEFORE orbax restores anything; an exception here
+                # is therefore treated as corruption, never classified by
+                # its error text (a checksum "mismatch" must walk back, not
+                # masquerade as a geometry diagnosis).
+                last_err = e
+                remaining = len(candidates) - i - 1
+                logger.warning(
+                    "checkpoint step %d in %s failed to restore (%s: %s); "
+                    "%s", cand, self._dir, type(e).__name__, e,
+                    f"falling back to step {candidates[i + 1]}"
+                    if remaining else "no older step left",
+                )
+                continue
+            if i > 0:
+                logger.warning(
+                    "restored FALLBACK checkpoint step %d (skipped %d newer "
+                    "corrupt/partial step(s): %s)",
+                    cand, i, candidates[:i],
+                )
+            else:
+                logger.info(
+                    "restored checkpoint step %d from %s", cand, self._dir
+                )
+            self.last_restored_step = cand
+            return restored
+        raise RuntimeError(
+            f"every checkpoint step in {self._dir} failed to restore "
+            f"(tried {candidates})"
+        ) from last_err
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()

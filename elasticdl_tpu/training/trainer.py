@@ -24,6 +24,9 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from elasticdl_tpu.common import jax_compat
+
+jax_compat.ensure()  # older-jax API adapters (no-op on current jax)
 import numpy as np
 import optax
 from flax import struct
@@ -197,6 +200,16 @@ def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
     return loss_sum / denom, new_vars, grads
 
 
+def _aval_signature(tree: Any) -> Tuple:
+    """Hashable (shape, dtype) signature of a pytree's array leaves —
+    identifies the XLA program a (state, batch) pair lowers to."""
+    return tuple(
+        (tuple(leaf.shape), str(leaf.dtype))
+        for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "shape")
+    )
+
+
 def resolve_remat_policy(name: str):
     """Map a config-level policy name to a jax.checkpoint policy. "" (full
     remat: save nothing the policy engine controls) returns None. The menu
@@ -250,7 +263,11 @@ class Trainer:
             dict(spec.eval_metrics_fn()) if spec.eval_metrics_fn else {}
         )
         self._train_step = None
-        self._cost_cache = None
+        # AOT cost-analysis results keyed by the (state, batch) aval
+        # signature — a second train_step_cost call with a different batch
+        # shape is a different XLA program and must not reuse the first
+        # result (round-5 advisor)
+        self._cost_cache: Dict[Any, Dict[str, float]] = {}
         self._train_many = None
         self._eval_step = None
         self._eval_many = None
@@ -478,16 +495,19 @@ class Trainer:
                 # executable's analysis is computed by the backend and
                 # does work there. This is a FRESH AOT compile of the
                 # single-step program (train_many's scan is a different
-                # program, so nothing is cached) — memoized so repeat
-                # callers pay it once per trainer.
-                if self._cost_cache is not None:
-                    d = self._cost_cache
+                # program, so nothing is cached) — memoized per (state,
+                # batch) aval signature, so repeat callers pay it once per
+                # distinct step shape and a different batch shape gets its
+                # own analysis instead of the stale first result.
+                key = _aval_signature((state, batch))
+                if key in self._cost_cache:
+                    d = self._cost_cache[key]
                 else:
                     try:
                         d = lowered.compile().cost_analysis() or {}
                     except Exception:
                         d = {}
-                    self._cost_cache = d
+                    self._cost_cache[key] = d
         return {
             "flops": float(d.get("flops", 0.0)),
             "bytes accessed": float(d.get("bytes accessed", 0.0)),

@@ -51,7 +51,7 @@ from elasticdl_tpu.parallel.elastic import (
     make_global_batch_stack,
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
-from elasticdl_tpu.proto.service import MasterStub, make_channel
+from elasticdl_tpu.proto.service import RetryingMasterStub, make_channel
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -80,7 +80,7 @@ class CohortWorker:
     def __init__(self, cfg: JobConfig, ctx: Optional[CohortContext] = None):
         self.cfg = cfg
         self.ctx = ctx or context_from_env(cfg)
-        self._stub: Optional[MasterStub] = None
+        self._stub: Optional[RetryingMasterStub] = None
         self._trainer = None
         self._state = None
         self._spec: Optional[ModelSpec] = None
@@ -212,7 +212,11 @@ class CohortWorker:
         import socket
 
         self._channel = make_channel(self.cfg.master_addr)
-        self._stub = MasterStub(self._channel)
+        # Hardened stub (deadlines, idempotent retries, circuit breaker);
+        # every successful RPC refreshes the master-unreachable clock.
+        self._stub = RetryingMasterStub(
+            self._channel, on_success=self._note_master_ok
+        )
         resp = self._stub.RegisterWorker(
             pb.RegisterWorkerRequest(
                 worker_name=f"cohort-{socket.gethostname()}:{os.getpid()}",
@@ -221,12 +225,14 @@ class CohortWorker:
             timeout=30,
         )
         self.worker_id = resp.worker_id
-        self._last_master_ok = time.monotonic()
         logger.info(
             "cohort leader registered as worker %d (%d processes, %d devices)",
             self.worker_id, self.ctx.num_processes,
             len(__import__("jax").devices()),
         )
+
+    def _note_master_ok(self) -> None:
+        self._last_master_ok = time.monotonic()
 
     def _master_unreachable(self) -> bool:
         """Leader-only, from RPC-failure paths: True (and flips the
@@ -272,7 +278,6 @@ class CohortWorker:
                     # rides the next control vector (lr_bits) so every
                     # process applies it at the same task boundary
                     self._pushed_lr = resp.learning_rate
-                self._last_master_ok = time.monotonic()
             except Exception as e:
                 logger.warning("cohort heartbeat failed: %s", e)
                 self._master_unreachable()
@@ -313,7 +318,6 @@ class CohortWorker:
             resp = self._stub.GetTask(
                 pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
             )
-            self._last_master_ok = time.monotonic()
         except Exception as e:
             logger.warning("cohort get_task failed: %s", e)
             if self._master_unreachable():

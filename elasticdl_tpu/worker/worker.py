@@ -18,12 +18,13 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
-from elasticdl_tpu.proto.service import MasterStub, make_channel
+from elasticdl_tpu.proto.service import RetryingMasterStub, make_channel
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -38,7 +39,7 @@ class Worker:
         self._state = None
         self._spec: Optional[ModelSpec] = None
         self._services: Dict[int, TaskDataService] = {}
-        self._stub: Optional[MasterStub] = None
+        self._stub: Optional[RetryingMasterStub] = None
         self.worker_id = -1
         self._membership_version = -1
         self._shutdown = threading.Event()
@@ -72,7 +73,12 @@ class Worker:
     def _connect(self) -> None:
         addr = self.cfg.master_addr
         self._channel = make_channel(addr)
-        self._stub = MasterStub(self._channel)
+        # Hardened stub: per-call deadlines, idempotent-only retries with
+        # backoff, circuit breaker. Every successful RPC (on any thread)
+        # refreshes the master-unreachable clock through on_success.
+        self._stub = RetryingMasterStub(
+            self._channel, on_success=self._note_master_ok
+        )
         name = f"{socket.gethostname()}:{os.getpid()}"
         preferred = int(os.environ.get(WorkerEnv.WORKER_ID, -1))
         resp = self._stub.RegisterWorker(
@@ -85,11 +91,15 @@ class Worker:
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
         self._last_known_workers = resp.num_workers
-        self._last_master_ok = time.monotonic()
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
         )
+
+    def _note_master_ok(self) -> None:
+        """RetryingMasterStub success hook (runs on whichever thread made
+        the call): the master answered, so the unreachable clock resets."""
+        self._last_master_ok = time.monotonic()
 
     def _master_unreachable(self) -> bool:
         """Called from RPC-failure paths: True (once; also flips
@@ -260,6 +270,10 @@ class Worker:
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
+                # chaos hook: worker.heartbeat:crash kills the process here
+                # (a hard worker death between task boundaries); drop/delay
+                # fall through the same except path as a network failure
+                faults.fire("worker.heartbeat")
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
                         worker_id=self.worker_id,
@@ -293,7 +307,6 @@ class Worker:
                     # set above — the push is job-global and wins
                     self._pushed_lr = resp.learning_rate
                     self._pending_lr = resp.learning_rate
-                self._last_master_ok = time.monotonic()
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
                 self._master_unreachable()
@@ -514,6 +527,7 @@ class Worker:
             records_done = 0
         delivered = False
         try:
+            faults.fire("worker.report_task")
             resp = self._stub.ReportTaskResult(
                 pb.ReportTaskResultRequest(
                     worker_id=self.worker_id,
@@ -653,7 +667,6 @@ class Worker:
                 resp = self._stub.GetTask(
                     pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
                 )
-                self._last_master_ok = time.monotonic()
             except Exception as e:
                 logger.warning("get_task failed: %s; retrying", e)
                 if self._master_unreachable():
@@ -719,6 +732,7 @@ class Worker:
                 report.success = False
                 report.err_message = str(e)[:512]
             try:
+                faults.fire("worker.report_task")
                 self._stub.ReportTaskResult(report, timeout=30)
                 if task.type == pb.TRAINING and report.success:
                     # state and task queue agree here: safe checkpoint point

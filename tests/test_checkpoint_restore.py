@@ -1,0 +1,197 @@
+"""CheckpointManager failure paths: walk-back restore over corrupt/partial
+steps, geometry-mismatch classification, and save atomicity under injected
+crash-during-save (training/checkpoint.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.training.checkpoint import (
+    GEOMETRY_FILE,
+    CheckpointGeometryError,
+    CheckpointManager,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def state(v: float):
+    return {"w": np.full((4, 2), v, np.float32), "b": np.arange(3.0, dtype=np.float32)}
+
+
+def corrupt_step_dir(root, step):
+    """Truncate every file under a committed step dir — a torn copy /
+    half-scrubbed checkpoint, the shape a crashed save must never leave
+    but external interference can."""
+    step_dir = os.path.join(root, str(step))
+    assert os.path.isdir(step_dir)
+    for dirpath, _dirs, files in os.walk(step_dir):
+        for f in files:
+            open(os.path.join(dirpath, f), "w").close()
+
+
+def test_restore_walks_back_past_corrupt_latest(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    mngr.save(state(1.0), step=1, wait=True)
+    mngr.save(state(2.0), step=2, wait=True)
+    mngr.save(state(3.0), step=3, wait=True)
+    mngr.close()
+    corrupt_step_dir(str(tmp_path), 3)
+
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    restored = mngr.restore(state(0.0))
+    assert restored is not None
+    np.testing.assert_array_equal(restored["w"], state(2.0)["w"])
+    assert mngr.last_restored_step == 2
+    mngr.close()
+
+
+def test_restore_walks_back_multiple_corrupt_steps(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    for step in (1, 2, 3):
+        mngr.save(state(float(step)), step=step, wait=True)
+    mngr.close()
+    corrupt_step_dir(str(tmp_path), 2)
+    corrupt_step_dir(str(tmp_path), 3)
+
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    restored = mngr.restore(state(0.0))
+    np.testing.assert_array_equal(restored["w"], state(1.0)["w"])
+    assert mngr.last_restored_step == 1
+    mngr.close()
+
+
+def test_restore_raises_when_every_step_is_corrupt(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    mngr.save(state(1.0), step=1, wait=True)
+    mngr.close()
+    corrupt_step_dir(str(tmp_path), 1)
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    # all-corrupt must be LOUD: silently returning None would let a worker
+    # retrain records the master already retired under these checkpoints
+    with pytest.raises(RuntimeError, match="failed to restore"):
+        mngr.restore(state(0.0))
+    mngr.close()
+
+
+def test_restore_returns_none_when_no_checkpoints(tmp_path):
+    mngr = CheckpointManager(str(tmp_path))
+    assert mngr.restore(state(0.0)) is None
+    mngr.close()
+
+
+def test_explicit_step_is_tried_alone(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    mngr.save(state(1.0), step=1, wait=True)
+    mngr.save(state(2.0), step=2, wait=True)
+    restored = mngr.restore(state(0.0), step=1)
+    np.testing.assert_array_equal(restored["w"], state(1.0)["w"])
+    mngr.close()
+
+
+# ---------------------------------------------------------------------- #
+# geometry metadata (round-5 advisor: actionable restore errors)
+
+
+def wrong_shape_state():
+    return {"w": np.zeros((8, 2), np.float32), "b": np.arange(3.0, dtype=np.float32)}
+
+
+def test_save_records_geometry_sidecar(tmp_path):
+    mngr = CheckpointManager(str(tmp_path))
+    mngr.save(state(1.0), step=1, wait=True)
+    geo = json.load(open(tmp_path / GEOMETRY_FILE))
+    from elasticdl_tpu.ops.embedding import geometry_descriptor
+
+    assert geo == geometry_descriptor()
+    mngr.close()
+
+
+def test_shape_mismatch_with_stale_geometry_names_the_alignment(tmp_path):
+    mngr = CheckpointManager(str(tmp_path))
+    mngr.save(state(1.0), step=1, wait=True)
+    # rewrite the sidecar as a v1-geometry checkpoint would have it
+    json.dump(
+        {"geometry_version": 1, "vocab_align": 256},
+        open(tmp_path / GEOMETRY_FILE, "w"),
+    )
+    with pytest.raises(CheckpointGeometryError, match="vocab_align=256"):
+        mngr.restore(wrong_shape_state())
+    mngr.close()
+
+
+def test_shape_mismatch_without_sidecar_suggests_legacy_alignment(tmp_path):
+    mngr = CheckpointManager(str(tmp_path))
+    mngr.save(state(1.0), step=1, wait=True)
+    os.remove(tmp_path / GEOMETRY_FILE)
+    with pytest.raises(CheckpointGeometryError, match="vocab_align=256"):
+        mngr.restore(wrong_shape_state())
+    mngr.close()
+
+
+def test_shape_mismatch_with_matching_geometry_mentions_override(tmp_path):
+    # geometry RULE agrees but shapes differ: either a different model's
+    # checkpoint or a per-layer vocab_align override on one side (the
+    # sidecar can't record overrides) — the error must spell both out
+    mngr = CheckpointManager(str(tmp_path))
+    mngr.save(state(1.0), step=1, wait=True)
+    with pytest.raises(CheckpointGeometryError, match="vocab_align"):
+        mngr.restore(wrong_shape_state())
+    mngr.close()
+
+
+# ---------------------------------------------------------------------- #
+# fault sites
+
+
+def test_injected_save_drop_leaves_previous_step_intact(tmp_path):
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    mngr.save(state(1.0), step=1, wait=True)
+    faults.install("ckpt.save:drop@at=1")
+    with pytest.raises(faults.FaultInjected):
+        mngr.save(state(2.0), step=2, wait=True)
+    assert mngr.latest_step(refresh=True) == 1
+    restored = mngr.restore(state(0.0))
+    np.testing.assert_array_equal(restored["w"], state(1.0)["w"])
+    mngr.close()
+
+
+@pytest.mark.chaos
+def test_crash_during_save_never_exposes_partial_step(tmp_path):
+    """Kill a real process with the async save in flight
+    (ckpt.save.commit:crash). Orbax's rename-commit must leave either the
+    old latest or a fully-restorable new step — never a partial one."""
+    script = f"""
+import numpy as np
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.training.checkpoint import CheckpointManager
+m = CheckpointManager({str(tmp_path)!r}, keep=5)
+m.save({{"a": np.full(64, 1.0)}}, step=1, wait=True)
+faults.install("ckpt.save.commit:crash@at=1,code=77")
+m.save({{"a": np.full(64, 2.0)}}, step=2)   # dies here, write in flight
+raise SystemExit("unreachable: crash did not fire")
+"""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-c", script], env=env, capture_output=True, text=True
+    )
+    assert proc.returncode == 77, proc.stderr[-2000:]
+
+    mngr = CheckpointManager(str(tmp_path), keep=5)
+    latest = mngr.latest_step(refresh=True)
+    assert latest in (1, 2)
+    restored = mngr.restore({"a": np.zeros(64)})
+    np.testing.assert_array_equal(
+        restored["a"], np.full(64, float(mngr.last_restored_step))
+    )
+    mngr.close()

@@ -116,10 +116,17 @@ def test_local_job_with_grouped_dispatch(tmp_path):
         manager.stop()
 
 
+@pytest.mark.slow
 def test_profiling_and_step_time_summaries(tmp_path):
     """Round-3 observability (SURVEY §5 tracing): --profile_dir produces
     jax.profiler trace files, and the master's train summary stream carries
-    per-step wall time alongside loss."""
+    per-step wall time alongside loss.
+
+    Marked slow: on the 0.4.x jaxlib this image bakes in,
+    jax.profiler.start_trace stalls the worker process for ~60s (heartbeats
+    included), so the master reaps it and the job burns the full wait
+    timeout — ~7 wall-clock minutes to report a known jaxlib limitation.
+    Runs in the slow tier where that cost is budgeted."""
     cfg = job_config(
         tmp_path,
         profile_dir=str(tmp_path / "profile"),

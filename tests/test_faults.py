@@ -1,0 +1,197 @@
+"""Fault-injection subsystem (common/faults.py): spec parsing, trigger
+semantics, seed determinism, actions, and the module-level singleton."""
+
+import os
+
+import pytest
+
+from elasticdl_tpu.common import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.reset()
+    os.environ.pop(faults.FAULTS_ENV, None)
+    os.environ.pop(faults.SEED_ENV, None)
+    os.environ.pop(faults.TRACE_ENV, None)
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------- #
+# parsing
+
+
+def test_parse_spec_full_grammar():
+    rules = faults.parse_spec(
+        "rpc.get_task:drop@p=0.05; ckpt.save:crash@step=3 ;"
+        "worker.heartbeat:delay@ms=250,every=2,max=4"
+    )
+    assert [(r.site, r.action) for r in rules] == [
+        ("rpc.get_task", "drop"),
+        ("ckpt.save", "crash"),
+        ("worker.heartbeat", "delay"),
+    ]
+    assert rules[0].params == {"p": 0.05}
+    assert rules[1].params == {"at": 3.0}          # step= is an alias of at=
+    assert rules[2].params == {"ms": 250.0, "every": 2.0, "max": 4.0}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "rpc.get_task",                 # no action
+        "rpc.get_task:explode",         # unknown action
+        "rpc.get_task:drop@p",          # malformed param
+        "rpc.get_task:drop@bogus=1",    # unknown param
+    ],
+)
+def test_parse_spec_rejects_typos_loudly(bad):
+    with pytest.raises(ValueError):
+        faults.parse_spec(bad)
+
+
+def test_wildcard_site_matching():
+    (rule,) = faults.parse_spec("rpc.*:drop")
+    assert rule.matches("rpc.get_task")
+    assert rule.matches("rpc.heartbeat.recv")
+    assert not rule.matches("ckpt.save")
+
+
+# ---------------------------------------------------------------------- #
+# triggers + determinism
+
+
+def decisions(spec, seed, site, n=40):
+    inj = faults.FaultInjector.from_spec(spec, seed=seed)
+    out = []
+    for _ in range(n):
+        rule = inj.check(site)
+        out.append(rule.action if rule else None)
+    return out
+
+
+def test_at_fires_exactly_once():
+    d = decisions("s:drop@at=3", 0, "s", n=10)
+    assert d == [None, None, "drop"] + [None] * 7
+
+
+def test_every_and_max():
+    d = decisions("s:drop@every=2,max=3", 0, "s", n=10)
+    assert d == [None, "drop", None, "drop", None, "drop", None, None, None, None]
+
+
+def test_probability_same_seed_reproduces_same_sequence():
+    a = decisions("s:drop@p=0.3", seed=42, site="s")
+    b = decisions("s:drop@p=0.3", seed=42, site="s")
+    assert a == b
+    assert any(x == "drop" for x in a) and any(x is None for x in a)
+
+
+def test_probability_different_seed_differs():
+    a = decisions("s:drop@p=0.3", seed=1, site="s", n=200)
+    b = decisions("s:drop@p=0.3", seed=2, site="s", n=200)
+    assert a != b
+
+
+def test_wildcard_probability_streams_are_per_site():
+    """A wildcard p= rule must give every matched site its own seeded RNG
+    stream: the decisions for one site cannot depend on how many hits other
+    sites took first (thread interleaving would otherwise change traces)."""
+
+    def site_decisions(interleave):
+        inj = faults.FaultInjector.from_spec("rpc.*:drop@p=0.5", seed=9)
+        out = {"rpc.a": [], "rpc.b": []}
+        for site in interleave:
+            fired = inj.check(site)
+            out[site].append(fired.action if fired else None)
+        return out
+
+    a_first = site_decisions(["rpc.a"] * 6 + ["rpc.b"] * 6)
+    mixed = site_decisions(["rpc.a", "rpc.b"] * 6)
+    assert a_first == mixed
+
+
+def test_wildcard_max_caps_per_matched_site():
+    inj = faults.FaultInjector.from_spec("rpc.*:drop@max=1")
+    assert inj.check("rpc.a") is not None
+    assert inj.check("rpc.a") is None          # rpc.a capped
+    assert inj.check("rpc.b") is not None      # rpc.b has its own budget
+
+
+def test_per_site_counters_are_independent():
+    inj = faults.FaultInjector.from_spec("a:drop@at=2;b:drop@at=1")
+    assert inj.check("a") is None
+    assert inj.check("b").site == "b"
+    assert inj.check("a").site == "a"
+    assert inj.hits("a") == 2 and inj.hits("b") == 1
+
+
+# ---------------------------------------------------------------------- #
+# actions
+
+
+def test_drop_raises_fault_injected():
+    inj = faults.FaultInjector.from_spec("s:drop")
+    with pytest.raises(faults.FaultInjected) as ei:
+        inj.fire("s")
+    assert ei.value.site == "s" and ei.value.hit == 1
+
+
+def test_delay_sleeps_then_continues(monkeypatch):
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    inj = faults.FaultInjector.from_spec("s:delay@ms=250")
+    inj.fire("s")  # no raise
+    assert slept == [0.25]
+
+
+def test_crash_exits_hard_and_flushes_trace(monkeypatch, tmp_path):
+    exits = []
+    monkeypatch.setattr(faults.os, "_exit", exits.append)
+    trace = tmp_path / "trace"
+    inj = faults.FaultInjector.from_spec(
+        "s:crash@code=7", trace_path=str(trace)
+    )
+    inj.fire("s")
+    assert exits == [7]
+    # the trace was flushed BEFORE _exit (atexit never runs after os._exit)
+    assert trace.read_text().splitlines() == ["s:crash#1"]
+
+
+def test_trace_records_fired_injections_in_order():
+    inj = faults.FaultInjector.from_spec("s:delay@every=2;t:delay")
+    for _ in range(3):
+        inj.fire("s")
+    inj.fire("t")
+    assert inj.trace == ["s:delay#2", "t:delay#1"]
+
+
+# ---------------------------------------------------------------------- #
+# module-level singleton
+
+
+def test_disabled_by_default_is_noop():
+    faults.fire("anything")        # no env, no install: must not raise
+    assert faults.get_injector() is None
+
+
+def test_env_installation(monkeypatch):
+    monkeypatch.setenv(faults.FAULTS_ENV, "s:drop@at=1")
+    monkeypatch.setenv(faults.SEED_ENV, "5")
+    faults.reset()
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("s")
+    assert faults.get_injector().seed == 5
+    faults.uninstall()
+    faults.fire("s")               # uninstalled: no-op again
+
+
+def test_check_handles_delay_inline(monkeypatch):
+    slept = []
+    monkeypatch.setattr(faults.time, "sleep", slept.append)
+    faults.install("proc.spawn:delay@ms=100")
+    rule = faults.check("proc.spawn")
+    assert rule.action == "delay" and slept == [0.1]
+    faults.install("proc.spawn:drop")
+    assert faults.check("proc.spawn").action == "drop"  # returned, not raised

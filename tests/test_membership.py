@@ -1,0 +1,131 @@
+"""Membership registry: reap vs concurrent heartbeats, death callbacks,
+re-registration (master/membership.py). The reap race matters because the
+master's wait loop reaps on a timer while the gRPC threadpool serves
+heartbeats concurrently — a worker must never be declared dead twice, and a
+heartbeat that lands after death must be rejected (its worker is about to
+be told to shut down and its tasks are already recovered)."""
+
+import threading
+import time
+
+from elasticdl_tpu.master.membership import Membership
+
+
+def test_register_heartbeat_reap_lifecycle():
+    m = Membership(heartbeat_timeout_s=0.05)
+    a = m.register("a").worker_id
+    b = m.register("b").worker_id
+    assert m.alive_count() == 2
+    # keep b alive while a lapses
+    reaped = []
+    deadline = time.time() + 2.0
+    while time.time() < deadline and not reaped:
+        m.heartbeat(b)
+        reaped = m.reap()
+        time.sleep(0.01)
+    assert reaped == [a]
+    assert [w.worker_id for w in m.alive_workers()] == [b]
+
+
+def test_death_callback_fires_exactly_once_per_worker():
+    m = Membership(heartbeat_timeout_s=30.0)
+    wid = m.register("w").worker_id
+    deaths = []
+    m.add_death_callback(deaths.append)
+    assert m.mark_dead(wid)
+    assert not m.mark_dead(wid)            # second declaration is a no-op
+    assert not m.heartbeat(wid)            # dead workers can't heartbeat back
+    assert deaths == [wid]
+
+
+def test_version_bumps_on_join_and_death_only():
+    m = Membership(heartbeat_timeout_s=30.0)
+    v0 = m.version
+    wid = m.register("w").worker_id
+    assert m.version == v0 + 1
+    m.heartbeat(wid)
+    assert m.version == v0 + 1             # heartbeats don't bump
+    m.mark_dead(wid)
+    assert m.version == v0 + 2
+
+
+def test_preferred_id_reuse_after_death():
+    m = Membership(heartbeat_timeout_s=30.0)
+    wid = m.register("w", preferred_id=0).worker_id
+    assert wid == 0
+    m.mark_dead(0)
+    # a relaunched worker asks for its old id back and gets it
+    assert m.register("w-relaunch", preferred_id=0).worker_id == 0
+    # but a LIVE id is never stolen
+    assert m.register("intruder", preferred_id=0).worker_id != 0
+
+
+def test_reap_racing_concurrent_heartbeats():
+    """Hammer heartbeats from worker threads while reap runs in a loop:
+    the kept-alive worker survives, the silent one dies exactly once, and
+    the registry never double-fires callbacks or corrupts counts."""
+    m = Membership(heartbeat_timeout_s=0.08)
+    alive_id = m.register("alive").worker_id
+    dead_id = m.register("silent").worker_id
+    deaths = []
+    m.add_death_callback(deaths.append)
+    stop = threading.Event()
+    errors = []
+
+    def beat():
+        try:
+            while not stop.is_set():
+                m.heartbeat(alive_id)
+                time.sleep(0.005)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def reap_loop():
+        try:
+            while not stop.is_set():
+                m.reap()
+                time.sleep(0.01)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=beat) for _ in range(4)]
+    threads += [threading.Thread(target=reap_loop) for _ in range(2)]
+    for t in threads:
+        t.start()
+    deadline = time.time() + 2.0
+    while time.time() < deadline and not deaths:
+        time.sleep(0.01)
+    time.sleep(0.2)  # extra reap cycles: give a double-fire the chance to happen
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+
+    assert not errors
+    assert deaths == [dead_id]             # exactly once, only the silent one
+    assert [w.worker_id for w in m.alive_workers()] == [alive_id]
+    # the survivor's heartbeats kept being accepted throughout
+    assert m.heartbeat(alive_id)
+    assert not m.heartbeat(dead_id)
+
+
+def test_concurrent_reaps_declare_each_lapsed_worker_once():
+    """Two reapers racing over the same lapsed set (the master wait loop +
+    a pod-watcher feeding mark_dead) must produce one death each."""
+    for _ in range(20):
+        m = Membership(heartbeat_timeout_s=0.0)   # everyone is instantly late
+        ids = [m.register(f"w{i}").worker_id for i in range(8)]
+        deaths = []
+        m.add_death_callback(deaths.append)
+        barrier = threading.Barrier(4)
+
+        def reap():
+            barrier.wait()
+            m.reap()
+
+        threads = [threading.Thread(target=reap) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sorted(deaths) == sorted(ids)      # every worker died once
+        assert m.alive_count() == 0

@@ -1,0 +1,63 @@
+"""common/net.py: free-port picking and the bind-retry TOCTOU closure."""
+
+import socket
+
+import pytest
+
+from elasticdl_tpu.common.net import PortBindError, bind_with_retry, free_port
+
+
+def test_free_port_is_bindable():
+    port = free_port()
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", port))
+
+
+def test_bind_with_retry_returns_first_success():
+    seen = []
+    port, result = bind_with_retry(lambda p: seen.append(p) or f"server:{p}")
+    assert result == f"server:{port}"
+    assert seen == [port]
+
+
+def test_bind_with_retry_retries_lost_races_with_fresh_ports():
+    attempts = []
+
+    def build(port):
+        attempts.append(port)
+        if len(attempts) < 3:
+            raise PortBindError(f"port {port} taken")
+        return "server"
+
+    port, result = bind_with_retry(build, attempts=5)
+    assert result == "server" and port == attempts[-1]
+    assert len(attempts) == 3
+    # (no distinct-port assertion: the OS may legally hand the same
+    # ephemeral port back since the fake build() never actually binds it)
+
+
+def test_bind_with_retry_gives_up_after_attempts():
+    def build(port):
+        raise PortBindError("always taken")
+
+    with pytest.raises(PortBindError):
+        bind_with_retry(build, attempts=3)
+
+
+def test_master_raises_port_bind_error_on_taken_port():
+    """Master's bind failure is the typed error bind_with_retry keys on."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.main import Master
+
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        s.listen(1)
+        taken = s.getsockname()[1]
+        cfg = JobConfig(
+            model_def="mnist.mnist_cnn.custom_model",
+            job_type="training_only",
+            training_data="synthetic://mnist?n=32&shards=1",
+            master_addr=f"localhost:{taken}",
+        )
+        with pytest.raises(PortBindError):
+            Master(cfg)

@@ -1,0 +1,216 @@
+"""RetryingMasterStub: deadlines, idempotent-only retries, backoff with
+jitter, circuit breaker, and fault-site wiring (proto/service.py)."""
+
+import random
+
+import grpc
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.proto import service
+from elasticdl_tpu.proto.service import (
+    DEFAULT_POLICIES,
+    CircuitBreaker,
+    MasterUnreachableError,
+    RetryingMasterStub,
+    RpcPolicy,
+    rpc_site,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+class FakeRpcError(grpc.RpcError):
+    def code(self):
+        return grpc.StatusCode.UNAVAILABLE
+
+
+class FakeStub:
+    """Records (rpc, timeout) calls; fails the first `fail_first` of each."""
+
+    def __init__(self, fail_first=0):
+        self.calls = []
+        self.fail_first = fail_first
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+
+        def call(request, timeout=None):
+            self.calls.append((name, timeout))
+            if len(self.calls) <= self.fail_first:
+                raise FakeRpcError()
+            return f"{name}-ok"
+
+        return call
+
+
+def make_stub(fake, **kw):
+    kw.setdefault("rng", random.Random(0))
+    kw.setdefault("sleep", lambda s: None)
+    return RetryingMasterStub(None, stub=fake, **kw)
+
+
+def test_policy_classification_is_complete_and_conservative():
+    # every RPC has a policy, and the mutating control-plane calls are
+    # never auto-retried (see RpcPolicy docstring for the per-RPC why)
+    assert set(DEFAULT_POLICIES) == set(service._RPCS)
+    for name in ("RegisterWorker", "GetTask", "ReportTaskResult", "Heartbeat"):
+        # Heartbeat is deliberately non-retryable: the servicer consumes
+        # the one-shot should_checkpoint flag on read, so a retry after a
+        # lost response would swallow a master-requested checkpoint
+        assert not DEFAULT_POLICIES[name].idempotent
+    for name in ("ReportEvaluationMetrics", "GetJobStatus"):
+        assert DEFAULT_POLICIES[name].idempotent
+
+
+def test_default_deadline_applied_and_explicit_timeout_wins():
+    fake = FakeStub()
+    stub = make_stub(fake)
+    stub.GetTask("req")
+    stub.GetTask("req", timeout=3.5)
+    stub.Heartbeat("req")
+    assert fake.calls == [
+        ("GetTask", DEFAULT_POLICIES["GetTask"].timeout_s),
+        ("GetTask", 3.5),
+        ("Heartbeat", DEFAULT_POLICIES["Heartbeat"].timeout_s),
+    ]
+
+
+def test_idempotent_rpc_retries_until_success():
+    fake = FakeStub(fail_first=2)
+    stub = make_stub(fake)
+    assert stub.GetJobStatus("req") == "GetJobStatus-ok"
+    assert len(fake.calls) == 3      # 2 failures + 1 success
+
+
+def test_non_idempotent_rpc_never_retries():
+    fake = FakeStub(fail_first=1)
+    stub = make_stub(fake)
+    with pytest.raises(grpc.RpcError):
+        stub.GetTask("req")
+    assert len(fake.calls) == 1
+
+
+def test_retries_exhausted_reraises_last_error():
+    fake = FakeStub(fail_first=100)
+    stub = make_stub(fake)
+    with pytest.raises(FakeRpcError):
+        stub.GetJobStatus("req")
+    assert len(fake.calls) == DEFAULT_POLICIES["GetJobStatus"].max_attempts
+
+
+def test_backoff_is_exponential_with_jitter_and_seed_deterministic():
+    def run(seed):
+        delays = []
+        fake = FakeStub(fail_first=100)
+        stub = make_stub(
+            fake,
+            rng=random.Random(seed),
+            sleep=delays.append,
+            policies={"Heartbeat": RpcPolicy(10.0, True, max_attempts=5)},
+        )
+        with pytest.raises(FakeRpcError):
+            stub.Heartbeat("req")
+        return delays
+
+    a, b = run(7), run(7)
+    assert a == b and len(a) == 4            # deterministic under one seed
+    assert run(8) != a                        # jitter is real
+    # each delay is bounded by the exponential cap base * 2^attempt
+    for i, d in enumerate(a):
+        assert 0 < d <= 0.2 * (2 ** i) + 1e-9
+
+
+def test_on_success_hook_fires_on_every_successful_call():
+    hits = []
+    fake = FakeStub()
+    stub = make_stub(fake, on_success=lambda: hits.append(1))
+    stub.Heartbeat("req")
+    stub.GetTask("req")
+    assert len(hits) == 2
+
+
+def test_circuit_opens_after_threshold_and_fails_fast():
+    fake = FakeStub(fail_first=100)
+    breaker = CircuitBreaker(failure_threshold=3, cooldown_s=60.0)
+    stub = make_stub(fake, breaker=breaker)
+    with pytest.raises(FakeRpcError):
+        stub.GetJobStatus("req")              # 3 attempts = 3 failures
+    assert breaker.is_open
+    wire_calls = len(fake.calls)
+    with pytest.raises(MasterUnreachableError):
+        stub.GetTask("req")                   # no wire traffic while open
+    assert len(fake.calls) == wire_calls
+
+
+def test_half_open_probe_raising_non_retryable_does_not_latch_circuit():
+    """A probe that dies with a NON-transport error (closed channel, bad
+    request object) must still release the probe slot — otherwise the
+    circuit stays open forever against a recovered master."""
+
+    class WeirdStub:
+        def __getattr__(self, name):
+            def call(request, timeout=None):
+                raise ValueError("Cannot invoke RPC on closed channel")
+
+            return call
+
+    breaker = CircuitBreaker(failure_threshold=1, cooldown_s=0.0)
+    breaker.record_failure()                  # circuit opens
+    assert breaker.is_open
+    stub = make_stub(WeirdStub(), breaker=breaker)
+    with pytest.raises(ValueError):
+        stub.Heartbeat("req")                 # admitted as the probe, raises
+    # the probe slot was released: the next call is admitted again
+    assert breaker.allow()
+
+
+def test_circuit_half_open_probe_recovers():
+    fake = FakeStub(fail_first=3)
+    breaker = CircuitBreaker(failure_threshold=3, cooldown_s=0.0)
+    stub = make_stub(fake, breaker=breaker)
+    with pytest.raises(FakeRpcError):
+        stub.GetJobStatus("req")
+    assert breaker.is_open
+    # cooldown elapsed (0s): one probe is admitted and succeeds
+    assert stub.GetJobStatus("req") == "GetJobStatus-ok"
+    assert not breaker.is_open and breaker.consecutive_failures == 0
+
+
+def test_send_fault_site_drops_call_before_the_wire():
+    faults.install("rpc.get_task:drop@at=1")
+    fake = FakeStub()
+    stub = make_stub(fake)
+    with pytest.raises(faults.FaultInjected):
+        stub.GetTask("req")
+    assert fake.calls == []                   # dropped before send
+    assert stub.GetTask("req") == "GetTask-ok"
+
+
+def test_recv_fault_site_loses_response_after_server_processed():
+    faults.install("rpc.report_task_result.recv:drop@at=1")
+    fake = FakeStub()
+    stub = make_stub(fake)
+    with pytest.raises(faults.FaultInjected):
+        stub.ReportTaskResult("req")
+    assert len(fake.calls) == 1               # the server DID see the call
+
+
+def test_injected_drops_are_retried_for_idempotent_rpcs():
+    faults.install("rpc.get_job_status:drop@at=1")
+    fake = FakeStub()
+    stub = make_stub(fake)
+    assert stub.GetJobStatus("req") == "GetJobStatus-ok"
+    assert len(fake.calls) == 1               # drop on attempt 1, retry hit wire
+
+
+def test_rpc_site_naming():
+    assert rpc_site("GetTask") == "rpc.get_task"
+    assert rpc_site("ReportEvaluationMetrics") == "rpc.report_evaluation_metrics"
+    assert rpc_site("Heartbeat") == "rpc.heartbeat"

@@ -199,6 +199,68 @@ def test_lease_timeout_requeues():
     assert t2 is not None and t2.task_id == t.task_id
 
 
+def test_lease_expiry_charges_retries_until_permanent_failure():
+    """Each expiry consumes a retry (a hung worker is indistinguishable
+    from a crashing one); when the budget runs out the task fails
+    permanently instead of ping-ponging between zombie workers forever."""
+    d = TaskDispatcher(
+        training_shards=[("s0", 0, 10)], records_per_task=10, shuffle=False,
+        task_timeout_s=0.01, max_task_retries=2,
+    )
+    failed = []
+    d.add_task_failed_callback(failed.append)
+    tid = None
+    for _ in range(3):                     # lease + 2 retries
+        t = d.get(0)
+        assert t is not None
+        tid = t.task_id
+        time.sleep(0.03)                   # let the lease lapse
+        d.poke()                           # master wait-loop reap
+    assert d.get(0) is None
+    assert d.finished()
+    assert d.counts()["failed_permanently"] == 1
+    assert [t.task_id for t in failed] == [tid]
+
+
+def test_expired_then_reported_success_is_rejected_and_not_double_counted():
+    """Worker A's lease expires and the task re-leases to worker B; A then
+    finishes anyway and reports success. The stale report must be rejected
+    — counting it AND B's eventual success would double-apply the span."""
+    d = make(num_records=20, rpt=10, task_timeout_s=0.05)
+    t = d.get(0)
+    time.sleep(0.1)
+    t2 = d.get(1)                          # reap + re-lease to worker 1
+    assert t2.task_id == t.task_id
+    assert not d.report(t.task_id, 0, True)     # stale holder rejected
+    assert d.counts()["finished_training"] == 0
+    assert d.report(t2.task_id, 1, True)        # current holder accepted
+    assert d.counts()["finished_training"] == 1
+    while (rest := d.get(1)) is not None:
+        assert d.report(rest.task_id, 1, True)
+    assert d.finished()
+    assert d.counts()["finished_training"] == 2
+
+
+def test_stale_preemption_drain_after_expiry_does_not_shrink_task():
+    """A stale drain report (records_processed > 0) from the old holder
+    must not advance the re-leased task's start — the new holder is
+    re-running the WHOLE span."""
+    d = TaskDispatcher(
+        training_shards=[("s0", 0, 10)], records_per_task=10, shuffle=False,
+        task_timeout_s=0.05,
+    )
+    t = d.get(0)
+    time.sleep(0.1)
+    t2 = d.get(1)
+    assert (t2.start, t2.end) == (0, 10)
+    assert not d.report(t.task_id, 0, False, preempted=True, records_processed=7)
+    # the live lease is untouched: full span, same holder
+    assert d.counts()["doing"] == 1
+    assert (t2.start, t2.end) == (0, 10)
+    assert d.report(t2.task_id, 1, True)
+    assert d.finished()
+
+
 def test_eval_tasks_jump_queue():
     d = TaskDispatcher(
         training_shards=[("t", 0, 30)],
