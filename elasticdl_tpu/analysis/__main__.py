@@ -1,0 +1,137 @@
+"""`python -m elasticdl_tpu.analysis` — run edl-lint over the tree.
+
+Exit codes: 0 clean (or every finding baselined), 1 new findings or
+parse errors, 2 usage errors. The default target is the installed
+`elasticdl_tpu` package directory; the default baseline is
+`.edl-lint-baseline.json` next to `pyproject.toml` (repo checkouts) or
+absent (installed wheels).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from elasticdl_tpu.analysis.core import (
+    all_rules,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+
+BASELINE_NAME = ".edl-lint-baseline.json"
+
+
+def _default_paths() -> List[str]:
+    import elasticdl_tpu
+
+    return [os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))]
+
+
+def _default_baseline(paths: List[str]) -> Optional[str]:
+    """Walk up from the first target looking for the checked-in baseline."""
+    probe = os.path.abspath(paths[0])
+    for _ in range(6):
+        candidate = os.path.join(probe, BASELINE_NAME)
+        if os.path.exists(candidate):
+            return candidate
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            break
+        probe = parent
+    return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.analysis",
+        description="project-specific static analysis (edl-lint)",
+    )
+    parser.add_argument(
+        "paths", nargs="*", help="files/directories to lint "
+        "(default: the elasticdl_tpu package)",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON output")
+    parser.add_argument(
+        "--baseline", default=None,
+        help=f"baseline file (default: nearest {BASELINE_NAME})",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore any baseline: report every finding as new",
+    )
+    parser.add_argument(
+        "--write-baseline", action="store_true",
+        help="write current findings to the baseline file and exit 0",
+    )
+    parser.add_argument(
+        "--select", default="",
+        help="comma-separated rule ids/names to run (default: all)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in all_rules():
+            print(f"{rule.id}  {rule.name}: {rule.doc}")
+        return 0
+
+    paths = args.paths or _default_paths()
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"error: no such path: {p}", file=sys.stderr)
+            return 2
+
+    baseline_path = args.baseline or _default_baseline(paths)
+    baseline = (
+        {} if args.no_baseline or not baseline_path
+        else load_baseline(baseline_path)
+    )
+    select = {s.strip() for s in args.select.split(",") if s.strip()} or None
+
+    result = run_analysis(paths, baseline=baseline, select=select)
+
+    if args.write_baseline:
+        target = baseline_path or os.path.join(os.getcwd(), BASELINE_NAME)
+        write_baseline(target, result.findings)
+        print(f"wrote {len(result.findings)} entries to {target}")
+        return 0
+
+    if args.json:
+        print(json.dumps(
+            {
+                "new": [f.__dict__ for f in result.new],
+                "baselined": [f.__dict__ for f in result.baselined],
+                "stale_baseline": result.stale_baseline,
+                "errors": result.errors,
+                "ok": result.ok,
+            },
+            indent=2,
+        ))
+    else:
+        for f in result.new:
+            print(f.render())
+        for err in result.errors:
+            print(f"parse error: {err}")
+        if result.stale_baseline:
+            print(
+                f"note: {len(result.stale_baseline)} stale baseline "
+                "entr(y/ies) — fixed findings; prune the baseline:"
+            )
+            for fp in result.stale_baseline:
+                print(f"  {fp}")
+        n_new, n_base = len(result.new), len(result.baselined)
+        print(
+            f"edl-lint: {n_new} new finding(s), {n_base} baselined, "
+            f"{len(result.errors)} error(s)"
+        )
+    return 0 if result.ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,292 @@
+"""EDL2xx: JAX performance/correctness hazards.
+
+These encode the ways a JAX hot path silently loses the throughput the
+paper claims (SURVEY §3.3, docs/performance.md): a host sync per batch
+re-serializes the device pipeline; a jit built per call recompiles every
+step; a `self.` mutation under trace leaks tracers; unordered iteration
+changes pytree structure between processes (distinct compiled programs —
+a cohort deadlock in SPMD mode).
+
+EDL201 host-sync-in-hot-loop
+    `float()/int()/bool()/.item()/np.asarray()/np.array()/jax.device_get()`
+    lexically inside a loop that dispatches device work (a call to one of
+    the Trainer step/many entry points). These force the device queue to
+    drain per iteration. Some syncs are the point (loss read-back that
+    times the step, mask-based record accounting) — those carry
+    `# edl-lint: disable=EDL201` with their justification.
+
+EDL202 jit-cache-churn
+    `jax.jit(...)` called inside a loop, or a `jax.jit(...)(...)`
+    immediate call: both build a fresh jitted callable per execution, so
+    XLA's compile cache keys on a new function object every time.
+    Cache the jitted callable (module/instance attribute) instead.
+
+EDL203 tracer-leak
+    assignment to `self.*` (or a nonlocal/global) inside a function that
+    is jitted (decorated, or passed to `jax.jit` in the same module).
+    Under trace this stores a Tracer into long-lived state; it escapes
+    the trace and fails — or worse, silently retraces — later.
+
+EDL204 unordered-iteration
+    iteration over a `set` (literal, comprehension, or `set(...)` call)
+    in a `for`/comprehension. Set order varies across processes
+    (PYTHONHASHSEED), so any pytree/spec built from it can differ
+    between cohort members. Sort first.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+#: calls that dispatch device work — a loop containing one is a hot loop
+_DISPATCH_METHODS = {
+    "train_step", "train_many", "eval_step", "eval_many",
+    "predict_step", "predict_many", "apply_gradients",
+}
+
+#: builtin conversions that force a host sync when fed a device value
+_SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _is_jax_jit(func: ast.AST) -> bool:
+    """`jax.jit`, bare `jit`, or `partial(jax.jit, ...)`."""
+    if isinstance(func, ast.Attribute) and func.attr == "jit":
+        return True
+    if isinstance(func, ast.Name) and func.id == "jit":
+        return True
+    if isinstance(func, ast.Call):
+        f = func.func
+        partial = (
+            isinstance(f, ast.Name) and f.id == "partial"
+        ) or (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if partial and func.args and _is_jax_jit(func.args[0]):
+            return True
+    return False
+
+
+def _called_attr_names(node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute):
+            out.add(sub.func.attr)
+    return out
+
+
+@register
+class HostSyncInHotLoopRule(Rule):
+    id = "EDL201"
+    name = "host-sync-in-hot-loop"
+    doc = (
+        "host-device sync (float/int/bool/.item/np.asarray/device_get) "
+        "inside a loop that dispatches device steps"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        reported: Set[int] = set()   # a call nested in two loops fires once
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            called = set()
+            for stmt in body:
+                called |= _called_attr_names(stmt)
+            if not (called & _DISPATCH_METHODS):
+                continue
+            for stmt in body:
+                yield from self._scan(ctx, stmt, reported)
+
+    def _scan(
+        self, ctx: ModuleContext, node: ast.AST, reported: Set[int]
+    ) -> Iterator[Finding]:
+        for sub in ast.walk(node):
+            if id(sub) in reported:
+                continue
+            reported.add(id(sub))
+            if not isinstance(sub, ast.Call):
+                continue
+            func = sub.func
+            if (
+                isinstance(func, ast.Name)
+                and func.id in _SYNC_BUILTINS
+                and sub.args
+                and not isinstance(sub.args[0], ast.Constant)
+            ):
+                yield self.finding(
+                    ctx, sub,
+                    f"{func.id}() in a dispatch loop forces a host sync "
+                    "per iteration; accumulate on device or hoist",
+                )
+            elif isinstance(func, ast.Attribute) and func.attr == "item":
+                yield self.finding(
+                    ctx, sub,
+                    ".item() in a dispatch loop forces a host sync per "
+                    "iteration; accumulate on device or hoist",
+                )
+            elif isinstance(func, ast.Attribute) and func.attr in (
+                "asarray", "array",
+            ) and isinstance(func.value, ast.Name) and func.value.id in (
+                "np", "numpy",
+            ):
+                yield self.finding(
+                    ctx, sub,
+                    f"np.{func.attr}() in a dispatch loop copies device "
+                    "data to host per iteration; accumulate on device or hoist",
+                )
+            elif isinstance(func, ast.Attribute) and func.attr == "device_get":
+                yield self.finding(
+                    ctx, sub,
+                    "jax.device_get() in a dispatch loop forces a host "
+                    "sync per iteration; accumulate on device or hoist",
+                )
+
+
+@register
+class JitCacheChurnRule(Rule):
+    id = "EDL202"
+    name = "jit-cache-churn"
+    doc = (
+        "jax.jit built per call (inside a loop, or immediately invoked) — "
+        "recompiles every execution; cache the jitted callable"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        loops = [
+            n for n in ast.walk(ctx.tree) if isinstance(n, (ast.For, ast.While))
+        ]
+        seen: Set[int] = set()
+        for loop in loops:
+            for sub in ast.walk(loop):
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_jax_jit(sub.func)
+                    and id(sub) not in seen
+                ):
+                    seen.add(id(sub))
+                    yield self.finding(
+                        ctx, sub,
+                        "jax.jit inside a loop builds a fresh callable per "
+                        "iteration (compile-cache miss every time); hoist "
+                        "and cache it",
+                    )
+        for sub in ast.walk(ctx.tree):
+            # jax.jit(f)(args): the jitted callable dies after one call
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Call)
+                and _is_jax_jit(sub.func.func)
+                and id(sub.func) not in seen
+            ):
+                yield self.finding(
+                    ctx, sub,
+                    "jax.jit(...)(...) immediate call discards the jitted "
+                    "callable — every execution recompiles; cache it on the "
+                    "module/instance",
+                )
+
+
+@register
+class TracerLeakRule(Rule):
+    id = "EDL203"
+    name = "tracer-leak"
+    doc = (
+        "assignment to self.*/nonlocal/global inside a jitted function — "
+        "stores a Tracer into long-lived state"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        jitted = self._jitted_functions(ctx)
+        for fn in jitted:
+            yield from self._scan_body(ctx, fn)
+
+    def _jitted_functions(self, ctx: ModuleContext) -> List[ast.AST]:
+        out: List[ast.AST] = []
+        by_name = {}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                by_name.setdefault(node.name, node)
+                if any(_is_jax_jit(d) or (
+                    isinstance(d, ast.Call) and _is_jax_jit(d.func)
+                ) for d in node.decorator_list):
+                    out.append(node)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_jax_jit(node.func) and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Lambda):
+                    out.append(arg)
+                elif isinstance(arg, ast.Name) and arg.id in by_name:
+                    out.append(by_name[arg.id])
+        return out
+
+    def _scan_body(self, ctx: ModuleContext, fn: ast.AST) -> Iterator[Finding]:
+        body = getattr(fn, "body", None)
+        if not isinstance(body, list):
+            return  # Lambda: a single expression can hold no assignments
+        declared: Set[str] = set()
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Nonlocal, ast.Global)):
+                    declared |= set(sub.names)
+        for node in body:
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                    targets = (
+                        sub.targets if isinstance(sub, ast.Assign) else [sub.target]
+                    )
+                    for t in targets:
+                        if (
+                            isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"
+                        ):
+                            yield self.finding(
+                                ctx, sub,
+                                f"assignment to self.{t.attr} inside a jitted "
+                                "function stores a Tracer into long-lived "
+                                "state; return it instead",
+                            )
+                        elif isinstance(t, ast.Name) and t.id in declared:
+                            yield self.finding(
+                                ctx, sub,
+                                f"assignment to nonlocal/global {t.id!r} "
+                                "inside a jitted function leaks a Tracer out "
+                                "of the trace; return it instead",
+                            )
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in ("set", "frozenset"):
+            return True
+    return False
+
+
+@register
+class UnorderedIterationRule(Rule):
+    id = "EDL204"
+    name = "unordered-iteration"
+    doc = (
+        "iterating a set: order varies across processes (hash seed), so "
+        "pytrees/specs built from it differ between cohort members"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            iters: List[ast.AST] = []
+            if isinstance(node, ast.For):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                iters.extend(gen.iter for gen in node.generators)
+            for it in iters:
+                if _is_set_expr(it):
+                    yield self.finding(
+                        ctx, it,
+                        "iteration over a set has process-dependent order; "
+                        "wrap in sorted() before building pytrees or specs",
+                    )

@@ -1,0 +1,214 @@
+"""EDL3xx: RPC / control-plane hygiene.
+
+PR 1 hardened the wire layer (RetryingMasterStub: deadlines, idempotent-
+only retries, circuit breaker). These rules keep callers from quietly
+eroding that hardening:
+
+EDL301 bare-master-stub
+    `MasterStub(...)` constructed outside proto/service.py: every
+    production caller must go through RetryingMasterStub, or it loses
+    deadlines, the breaker, and the fault-injection sites.
+
+EDL302 rpc-missing-deadline
+    a known Master-RPC method invoked without `timeout=`, when the
+    receiver was locally bound to a bare `MasterStub(...)` (tracked by
+    assignment within the module). RetryingMasterStub carries per-RPC
+    policy deadlines, so its callers may omit timeout; a bare stub call
+    without one blocks forever on a half-dead master.
+
+EDL303 silent-exception-swallow
+    a broad handler (bare `except`, `Exception`, `BaseException`) whose
+    body neither logs nor raises nor does anything else (only
+    pass/.../continue/return-constant). A narrowed handler
+    (`except OSError: pass`) is a reviewed decision and is not flagged.
+
+EDL304 sleep-retry-no-jitter
+    constant-argument `time.sleep` inside a loop that also catches
+    exceptions (the retry shape). Synchronized constant backoff is how a
+    relaunched fleet produces a thundering herd against a recovering
+    master; use the stub's jittered backoff or randomize the sleep.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Set
+
+from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+#: the Master service RPC surface (proto/service.py _RPCS)
+RPC_METHODS = {
+    "RegisterWorker", "GetTask", "ReportTaskResult",
+    "ReportEvaluationMetrics", "Heartbeat", "GetJobStatus",
+}
+
+#: modules allowed to construct the bare stub (the wrapper itself)
+_BARE_STUB_ALLOWED = ("proto/service.py",)
+
+_LOG_NAMES = {"logger", "logging", "log", "warnings", "print"}
+
+
+def _is_call_to(node: ast.AST, name: str) -> bool:
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Name) and f.id == name:
+            return True
+        if isinstance(f, ast.Attribute) and f.attr == name:
+            return True
+    return False
+
+
+@register
+class BareMasterStubRule(Rule):
+    id = "EDL301"
+    name = "bare-master-stub"
+    doc = (
+        "MasterStub constructed outside proto/service.py — use "
+        "RetryingMasterStub (deadlines, retries, breaker, fault sites)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.rel_path.endswith(_BARE_STUB_ALLOWED):
+            return
+        for node in ast.walk(ctx.tree):
+            if _is_call_to(node, "MasterStub"):
+                yield self.finding(
+                    ctx, node,
+                    "bare MasterStub bypasses RetryingMasterStub "
+                    "(no deadline policy, no retries, no circuit breaker)",
+                )
+
+
+@register
+class RpcMissingDeadlineRule(Rule):
+    id = "EDL302"
+    name = "rpc-missing-deadline"
+    doc = (
+        "Master RPC on a bare MasterStub without timeout= — blocks "
+        "forever against a half-dead master"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        bare = self._bare_stub_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in RPC_METHODS
+            ):
+                continue
+            recv = node.func.value
+            is_bare = (
+                isinstance(recv, ast.Name) and recv.id in bare
+            ) or _is_call_to(recv, "MasterStub")
+            if not is_bare:
+                continue
+            if not any(kw.arg == "timeout" for kw in node.keywords):
+                yield self.finding(
+                    ctx, node,
+                    f"{node.func.attr} on a bare MasterStub without "
+                    "timeout= has no deadline at all",
+                )
+
+    def _bare_stub_names(self, ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _is_call_to(
+                node.value, "MasterStub"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+
+def _body_is_silent(body: List[ast.stmt]) -> bool:
+    """True when the handler body visibly does nothing with the error."""
+    for stmt in body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        if isinstance(stmt, ast.Return):
+            v = stmt.value
+            if v is None or isinstance(v, ast.Constant):
+                continue
+            return False
+        return False
+    return True
+
+
+def _is_broad_exception(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    for node in [t] + (list(t.elts) if isinstance(t, ast.Tuple) else []):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+    return any(n in ("Exception", "BaseException") for n in names)
+
+
+@register
+class SilentExceptionSwallowRule(Rule):
+    id = "EDL303"
+    name = "silent-exception-swallow"
+    doc = (
+        "broad except whose body neither logs nor raises — failures "
+        "disappear; narrow the type, log, or re-raise"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad_exception(node):
+                continue
+            if _body_is_silent(node.body):
+                yield self.finding(
+                    ctx, node,
+                    "broad except silently swallows the error; narrow the "
+                    "exception type, log it, or re-raise",
+                )
+
+
+@register
+class SleepRetryNoJitterRule(Rule):
+    id = "EDL304"
+    name = "sleep-retry-no-jitter"
+    doc = (
+        "constant time.sleep in a retry loop — synchronized backoff "
+        "(thundering herd); add jitter or use the stub's backoff"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            has_try = any(
+                isinstance(sub, ast.Try)
+                for stmt in loop.body
+                for sub in ast.walk(stmt)
+            )
+            if not has_try:
+                continue
+            for stmt in loop.body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "sleep"
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == "time"
+                        and sub.args
+                        and isinstance(sub.args[0], ast.Constant)
+                    ):
+                        yield self.finding(
+                            ctx, sub,
+                            "constant sleep in a retry loop synchronizes "
+                            "retries across workers; jitter it (e.g. "
+                            "uniform(0.5, 1.5) * base) or reuse the stub's "
+                            "backoff",
+                        )

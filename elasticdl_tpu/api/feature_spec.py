@@ -32,7 +32,7 @@ own id space (own table per bag) rather than joining the shared offsets.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np

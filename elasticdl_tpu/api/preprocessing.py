@@ -16,7 +16,7 @@ the input pipeline where it belongs, and the device half fuses into the step.
 from __future__ import annotations
 
 import zlib
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -10,8 +10,6 @@ TPU slice unchanged.
 
 from __future__ import annotations
 
-import socket
-from contextlib import closing
 from typing import Dict, Optional
 
 from elasticdl_tpu.common.config import JobConfig

@@ -204,6 +204,12 @@ def parse_spec(spec: str, seed: int = 0) -> List[FaultRule]:
                 raise ValueError(f"p must be in (0, 1], got {v!r} in {entry!r}")
             if k in ("at", "every", "max") and val < 1:
                 raise ValueError(f"{k} must be >= 1, got {v!r} in {entry!r}")
+            if k in ("at", "every", "max") and val != int(val):
+                # decide() would int()-truncate silently — the same
+                # reinterpreted-typo class the checks above reject
+                raise ValueError(
+                    f"{k} must be an integer, got {v!r} in {entry!r}"
+                )
             if k == "ms" and val < 0:
                 raise ValueError(f"ms must be >= 0, got {v!r} in {entry!r}")
             params[k] = val
@@ -224,9 +230,12 @@ class FaultInjector:
     ):
         self.rules = rules
         self.seed = seed
+        # appended under the lock (check); read lock-free by flush_trace
+        # (atexit / pre-crash: single-threaded by then) and by tests after
+        # the run — a deliberate publication point, not a race
         self.trace: List[str] = []
         self._trace_path = trace_path
-        self._hits: Dict[str, int] = {}
+        self._hits: Dict[str, int] = {}      # guarded_by: _lock
         self._lock = threading.Lock()
         self._trace_flushed = False
         if trace_path:

@@ -28,7 +28,6 @@ feature set.
 from __future__ import annotations
 
 import contextlib
-import functools
 
 _done = False
 

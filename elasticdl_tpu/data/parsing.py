@@ -26,7 +26,6 @@ with an int64 offsets array of length n+1; record i is buf[off[i], off[i+1]).
 from __future__ import annotations
 
 import ctypes
-import functools
 from typing import Any, Callable, List, Sequence, Tuple
 
 import numpy as np

@@ -48,11 +48,12 @@ class EvaluationService:
         self._metrics = metrics or {}
         self._evaluation_steps = evaluation_steps
         self._start_delay = start_delay_steps
-        self._next_job_id = 0
-        self._jobs: Dict[int, _EvalJob] = {}
-        self._last_trigger_version = 0
-        self._latest_model_version = 0
-        self._latest_results: Dict[str, float] = {}
+        self._next_job_id = 0                        # guarded_by: _lock
+        self._jobs: Dict[int, _EvalJob] = {}         # guarded_by: _lock
+        self._last_trigger_version = 0               # guarded_by: _lock
+        self._latest_model_version = 0               # guarded_by: _lock
+        self._latest_results: Dict[str, float] = {}  # guarded_by: _lock
+        # registration-before-start contract; fired outside the lock
         self._result_callbacks: List[Callable[[int, Dict[str, float]], None]] = []
         dispatcher.add_epoch_end_callback(self._on_epoch_end)
         dispatcher.add_task_failed_callback(self._on_task_failed)

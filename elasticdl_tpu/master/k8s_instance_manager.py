@@ -182,22 +182,22 @@ class K8sInstanceManager:
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
-        self._status: Dict[int, str] = {}
-        self._relaunches: Dict[int, int] = {}
+        self._status: Dict[int, str] = {}            # guarded_by: _lock
+        self._relaunches: Dict[int, int] = {}        # guarded_by: _lock
         # Pod names carry a per-worker GENERATION suffix (worker-<id>-g<N>):
         # a relaunch under the SAME name would `kubectl apply` onto the dead
         # Failed pod object and no-op (no new container), and late DELETED
         # events for old pods would be misattributed to the healthy
         # replacement. Fresh names make relaunches real and stale events
         # distinguishable.
-        self._gen: Dict[int, int] = {}
+        self._gen: Dict[int, int] = {}               # guarded_by: _lock
         # deliberately removed workers terminate as DELETED, not FAILED
-        self._removed: set = set()
-        self._next_worker_id = 0
+        self._removed: set = set()                   # guarded_by: _lock
+        self._next_worker_id = 0                     # guarded_by: _lock
 
     # ------------------------------------------------------------------ #
 
-    def _pod_name(self, worker_id: int, gen: Optional[int] = None) -> str:
+    def _pod_name(self, worker_id: int, gen: Optional[int] = None) -> str:  # holds: _lock
         g = self._gen.get(worker_id, 0) if gen is None else gen
         return f"{self.cfg.job_name}-worker-{worker_id}-g{g}"
 

@@ -140,9 +140,26 @@ class Master:
         try:
             bound = self.server.add_insecure_port(f"[::]:{port}")
         except RuntimeError as e:
+            self._release_on_bind_failure()
             raise PortBindError(f"could not bind master port {port}: {e}") from e
         if bound == 0:
+            self._release_on_bind_failure()
             raise PortBindError(f"could not bind master port {port}")
+
+    def _release_on_bind_failure(self) -> None:
+        """A lost bind abandons this instance (bind_with_retry constructs a
+        fresh Master per attempt): release what __init__ already built, or
+        every failed attempt keeps its summary file handles and gRPC thread
+        pool alive for the rest of the job."""
+        try:
+            self.server.stop(None)
+        except Exception:
+            logger.exception("abandoned master: server stop failed")
+        if self.summary is not None:
+            try:
+                self.summary.close()
+            except Exception:
+                logger.exception("abandoned master: summary close failed")
 
     def start(self) -> None:
         self.server.start()

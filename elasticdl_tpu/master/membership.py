@@ -14,8 +14,8 @@ from __future__ import annotations
 
 import threading
 import time
-from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional
+from dataclasses import dataclass
+from typing import Callable, Dict, List
 
 from elasticdl_tpu.common.log_utils import default_logger
 
@@ -34,10 +34,13 @@ class WorkerInfo:
 class Membership:
     def __init__(self, heartbeat_timeout_s: float = 30.0):
         self._lock = threading.Lock()
-        self._workers: Dict[int, WorkerInfo] = {}
-        self._next_id = 0
-        self._version = 0
+        self._workers: Dict[int, WorkerInfo] = {}    # guarded_by: _lock
+        self._next_id = 0                            # guarded_by: _lock
+        self._version = 0                            # guarded_by: _lock
         self._timeout = heartbeat_timeout_s
+        # registration-before-start contract (wired while the master is
+        # single-threaded); mark_dead iterates OUTSIDE the lock on purpose —
+        # callbacks re-enter the dispatcher
         self._death_callbacks: List[Callable[[int], None]] = []
 
     def add_death_callback(self, cb: Callable[[int], None]) -> None:

@@ -16,7 +16,7 @@ import subprocess
 import sys
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common import faults
@@ -83,23 +83,23 @@ class ProcessManager:
         self._checkpoint_request_fn = checkpoint_request_fn
         self._resize_ckpt_timeout_s = resize_checkpoint_timeout_s
         self._probe_ckpt_mngr = None  # lazily built, reused across resizes
-        self._procs: Dict[int, _WorkerProc] = {}
+        self._procs: Dict[int, _WorkerProc] = {}     # guarded_by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
-        self._next_worker_id = 0
-        self._cohort_relaunches = 0
-        self._cohort_coordinator = ""
+        self._next_worker_id = 0                     # guarded_by: _lock
+        self._cohort_relaunches = 0                  # guarded_by: _lock
+        self._cohort_coordinator = ""                # guarded_by: _lock
         # dynamic world resizing state (cohort mode)
-        self._cohort_size = self.cfg.num_processes
-        self._world_version = 0
-        self._pending_resize: Optional[int] = None
-        self._infra_retries = 0
+        self._cohort_size = self.cfg.num_processes   # guarded_by: _lock
+        self._world_version = 0                      # guarded_by: _lock
+        self._pending_resize: Optional[int] = None   # guarded_by: _lock
+        self._infra_retries = 0                      # guarded_by: _lock
         # world-formation failures (coordinator-port TOCTOU etc.) retry
         # without consuming the relaunch budget, bounded by this cap
         self.infra_retry_max = 10
         # timestamped re-formation records: (wall_clock_s, old_size, new_size)
-        self.reformation_log: List[Tuple[float, int, int]] = []
+        self.reformation_log: List[Tuple[float, int, int]] = []  # guarded_by: _lock
 
     @property
     def _cohort_mode(self) -> bool:
@@ -113,8 +113,10 @@ class ProcessManager:
 
     # ------------------------------------------------------------------ #
 
-    def _spawn(self, worker_id: int, relaunches: int = 0,
+    def _spawn(self, worker_id: int, relaunches: int = 0,  # holds: _lock
                process_id: int = 0) -> _WorkerProc:
+        # called with the lock held: the cohort env block reads
+        # _cohort_coordinator/_cohort_size/_world_version
         env = dict(os.environ)
         env.update({str(k): str(v) for k, v in self.cfg.envs.items()})
         env.update(self._extra_env)
@@ -319,6 +321,7 @@ class ProcessManager:
                 return
             self._procs.clear()
             self._world_version += 1
+            world_version = self._world_version
             if new_size != old_size:
                 # a deliberate resize opens a fresh in-place relaunch budget
                 self._cohort_relaunches = 0
@@ -327,12 +330,12 @@ class ProcessManager:
         if new_size != old_size:
             logger.warning(
                 "cohort RESIZED %d -> %d processes (world v%d): %s",
-                old_size, new_size, self._world_version, reason,
+                old_size, new_size, world_version, reason,
             )
         else:
             logger.warning(
                 "cohort relaunched at %d processes (world v%d): %s",
-                new_size, self._world_version, reason,
+                new_size, world_version, reason,
             )
 
     def _await_resize_checkpoint(self) -> None:
@@ -375,6 +378,8 @@ class ProcessManager:
                     latest, before,
                 )
                 return
+            # local-disk poll by ONE watcher thread, not a fleet retrying a
+            # shared service — no herd to jitter: edl-lint: disable=EDL304
             time.sleep(0.2)
         logger.warning(
             "pre-resize checkpoint did not land within %.0fs; resizing anyway",
@@ -412,17 +417,26 @@ class ProcessManager:
             with self._lock:
                 items = list(self._procs.items())
                 pending = self._pending_resize
+                size_now = self._cohort_size
             codes = {pid: wp.proc.poll() for pid, wp in items}
             failed = [
                 pid for pid, c in codes.items() if c is not None and c != 0
             ]
-            if not failed and self._infra_retries:
-                # the retried generation has stayed up: the incident is over,
-                # so the next one gets a full budget-free retry budget
-                last = self.reformation_log[-1][0] if self.reformation_log else 0.0
-                if time.time() - last > 60:
-                    self._infra_retries = 0
-                    logger.info("world formation recovered; infra retry budget reset")
+            if not failed:
+                with self._lock:
+                    # the retried generation has stayed up: the incident is
+                    # over, so the next one gets a full budget-free retry
+                    # budget (read+reset under the lock — the old unlocked
+                    # read raced add/remove_worker; edl-lint EDL101 find)
+                    last = (
+                        self.reformation_log[-1][0]
+                        if self.reformation_log else 0.0
+                    )
+                    if self._infra_retries and time.time() - last > 60:
+                        self._infra_retries = 0
+                        logger.info(
+                            "world formation recovered; infra retry budget reset"
+                        )
             if failed and not self._job_finished_fn():
                 members = dict(items)
                 lost = [pid for pid in failed if members[pid].no_relaunch]
@@ -490,7 +504,7 @@ class ProcessManager:
                 self._reform_cohort(target, size, reason)
             elif (
                 pending is not None
-                and pending != self._cohort_size
+                and pending != size_now   # snapshot: _cohort_size is locked
                 and not self._job_finished_fn()
             ):
                 # planned resize of a HEALTHY cohort: quiesce first — ask for
@@ -514,8 +528,9 @@ class ProcessManager:
                 )
                 self._reform_cohort(pending, old, "operator resize request")
             elif all(c is not None for c in codes.values()) and codes:
-                for wp in self._procs.values():
-                    wp.status = PodStatus.SUCCEEDED
+                with self._lock:
+                    for wp in self._procs.values():
+                        wp.status = PodStatus.SUCCEEDED
                 return
             self._stop.wait(poll_s)
 

@@ -10,7 +10,7 @@ membership_version that drives elastic mesh re-formation.
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Optional
 
 import numpy as np
 
@@ -38,9 +38,14 @@ class MasterServicer:
         self._summary = summary_service
         self._wait_backoff_s = wait_backoff_s
         self._loss_lock = threading.Lock()
-        self._loss_sum = 0.0
-        self._loss_count = 0
-        self._checkpoint_requested = set()  # worker ids that should checkpoint
+        self._loss_sum = 0.0                # guarded_by: _loss_lock
+        self._loss_count = 0                # guarded_by: _loss_lock
+        # control-plane flags: mutated by gRPC handler threads (Heartbeat)
+        # AND master-side callers (request_checkpoint from the resize
+        # quiesce) — the old lock-free set.add/discard raced (edl-lint
+        # EDL101 find); worker ids that should checkpoint
+        self._ctrl_lock = threading.Lock()
+        self._checkpoint_requested = set()  # guarded_by: _ctrl_lock
         self._lr_override = 0.0             # 0 = no master-pushed LR
         self._shutdown = False
 
@@ -108,8 +113,11 @@ class MasterServicer:
 
     def Heartbeat(self, request, context):
         known = self._membership.heartbeat(request.worker_id, request.model_version)
-        should_ckpt = request.worker_id in self._checkpoint_requested
-        if should_ckpt:
+        with self._ctrl_lock:
+            # one atomic test-and-clear: the flag is one-shot, and two
+            # concurrent heartbeats from a relaunching worker must not both
+            # consume (or both miss) the same request
+            should_ckpt = request.worker_id in self._checkpoint_requested
             self._checkpoint_requested.discard(request.worker_id)
         return pb.HeartbeatResponse(
             membership_version=self._membership.version,
@@ -143,7 +151,8 @@ class MasterServicer:
     # ------------------------------------------------------------------ #
 
     def request_checkpoint(self, worker_id: int) -> None:
-        self._checkpoint_requested.add(worker_id)
+        with self._ctrl_lock:
+            self._checkpoint_requested.add(worker_id)
 
     def request_shutdown(self) -> None:
         self._shutdown = True

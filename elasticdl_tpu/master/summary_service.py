@@ -26,9 +26,10 @@ class SummaryWriter:
 
     def __init__(self, directory: str):
         os.makedirs(directory, exist_ok=True)
+        # guarded_by: _lock
         self._jsonl = open(os.path.join(directory, "events.jsonl"), "a")
         self._lock = threading.Lock()
-        self._tf_writer = None
+        self._tf_writer = None               # guarded_by: _lock
         try:
             import tensorflow as tf
 
@@ -65,7 +66,12 @@ class SummaryService:
     def __init__(self, summary_dir: str):
         self._dir = os.path.abspath(summary_dir)
         self._train = SummaryWriter(os.path.join(self._dir, "train"))
-        self._eval: Optional[SummaryWriter] = None
+        # lazily created on the first eval result, which arrives on a gRPC
+        # handler thread — two eval jobs can finalize concurrently, so the
+        # check-then-create must be locked (edl-lint EDL101 find: the old
+        # unlocked version could build two writers and leak one)
+        self._eval_lock = threading.Lock()
+        self._eval: Optional[SummaryWriter] = None   # guarded_by: _eval_lock
 
     def on_task_report(self, model_version: int, loss_sum: float, loss_count: int,
                        step_time_sum: float = 0.0, step_count: int = 0) -> None:
@@ -79,11 +85,14 @@ class SummaryService:
             self._train.scalars(model_version, scalars)
 
     def on_eval_results(self, model_version: int, results: Dict[str, float]) -> None:
-        if self._eval is None:
-            self._eval = SummaryWriter(os.path.join(self._dir, "eval"))
-        self._eval.scalars(model_version, results)
+        with self._eval_lock:
+            if self._eval is None:
+                self._eval = SummaryWriter(os.path.join(self._dir, "eval"))
+            writer = self._eval
+        writer.scalars(model_version, results)
 
     def close(self) -> None:
         self._train.close()
-        if self._eval is not None:
-            self._eval.close()
+        with self._eval_lock:
+            if self._eval is not None:
+                self._eval.close()

@@ -16,7 +16,7 @@ import random
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
@@ -83,35 +83,37 @@ class TaskDispatcher:
         self._evaluation_shards = list(evaluation_shards or [])
         self._prediction_shards = list(prediction_shards or [])
         self._records_per_task = max(1, records_per_task)
-        self._num_epochs = num_epochs
+        self._num_epochs = num_epochs                # guarded_by: _lock
         self._max_task_retries = max_task_retries
         self._shuffle = shuffle
-        self._rng = random.Random(shuffle_seed)
+        self._rng = random.Random(shuffle_seed)      # guarded_by: _lock
         self._task_timeout_s = task_timeout_s
 
-        self._todo: deque[TaskSpec] = deque()
-        self._doing: Dict[int, _Lease] = {}
-        self._next_task_id = 1
-        self._epoch = -1
-        self._finished_training = 0
-        self._failed_permanently = 0
-        self._training_done = False
-        self._stop_training = False
-        self._epoch_end_fired = False
-        self._job_end_fired = False
+        self._todo: deque[TaskSpec] = deque()        # guarded_by: _lock
+        self._doing: Dict[int, _Lease] = {}          # guarded_by: _lock
+        self._next_task_id = 1                       # guarded_by: _lock
+        self._epoch = -1                             # guarded_by: _lock
+        self._finished_training = 0                  # guarded_by: _lock
+        self._failed_permanently = 0                 # guarded_by: _lock
+        self._training_done = False                  # guarded_by: _lock
+        self._stop_training = False                  # guarded_by: _lock
+        self._epoch_end_fired = False                # guarded_by: _lock
+        self._job_end_fired = False                  # guarded_by: _lock
+        # callback lists: registration-before-start contract (wired while
+        # the master is single-threaded), fired outside the lock on purpose
         self._epoch_end_callbacks: List[Callable[[int], None]] = []
         self._job_end_callbacks: List[Callable[[], None]] = []
         self._task_failed_callbacks: List[Callable[[TaskSpec], None]] = []
         # permanently failed tasks whose callbacks haven't fired yet
         # (collected under the lock, flushed outside it)
-        self._pending_failed: List[TaskSpec] = []
+        self._pending_failed: List[TaskSpec] = []    # guarded_by: _lock
         # training version counter: bumps on every finished training task
-        self._completed_versions = 0
+        self._completed_versions = 0                 # guarded_by: _lock
         # final exclusive SAVE_MODEL task (reference: the master's save-model
         # task at job end, SURVEY §2.1): created once, after everything else
         # drains, before job-end fires
         self._final_save_model = final_save_model
-        self._save_model_created = False
+        self._save_model_created = False             # guarded_by: _lock
 
         if self._training_shards:
             self._start_next_epoch()
@@ -129,6 +131,7 @@ class TaskDispatcher:
     # task creation
 
     def _split(self, shards: List[Shard]) -> List[Tuple[str, int, int]]:
+        # pure over immutable config: safe with or without the lock
         spans = []
         for name, start, end in shards:
             s = start
@@ -138,7 +141,7 @@ class TaskDispatcher:
                 s = e
         return spans
 
-    def _create_tasks(
+    def _create_tasks(  # holds: _lock
         self, shards: List[Shard], task_type: int, eval_job_id: int = -1,
         front: bool = False,
     ) -> int:
@@ -165,7 +168,7 @@ class TaskDispatcher:
             self._todo.extend(tasks)
         return len(tasks)
 
-    def _start_next_epoch(self) -> None:
+    def _start_next_epoch(self) -> None:  # holds: _lock
         self._epoch += 1
         self._epoch_end_fired = False
         n = self._create_tasks(self._training_shards, pb.TRAINING)

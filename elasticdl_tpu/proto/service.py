@@ -119,9 +119,11 @@ class CircuitBreaker:
     def __init__(self, failure_threshold: int = 5, cooldown_s: float = 10.0):
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
+        # consecutive_failures is read lock-free by RetryingMasterStub's
+        # error message (a snapshot for humans, not a decision input)
         self.consecutive_failures = 0
-        self._opened_at: Optional[float] = None
-        self._probe_in_flight = False
+        self._opened_at: Optional[float] = None      # guarded_by: _lock
+        self._probe_in_flight = False                # guarded_by: _lock
         # shared by the worker's heartbeat thread and main task loop: the
         # counter increment and the half-open single-probe admission are
         # read-modify-write and need the lock to stay exact

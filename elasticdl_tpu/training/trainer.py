@@ -18,8 +18,7 @@ Model state is donated each step, so params update in place in HBM.
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Dict, Tuple
 
 import flax.linen as nn
 import jax
@@ -330,6 +329,10 @@ class Trainer:
                     rng=rng,
                 )
 
+            # one-shot by design: init runs once per job, and the sharded
+            # init MUST run under jit (shard-wise placement); caching the
+            # callable would pin example-batch avals for no benefit:
+            # edl-lint: disable=EDL202
             state = jax.jit(_create)(root_key)
         n = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
         logger.info("Initialized model %s: %.3fM params", self.spec.module_name, n / 1e6)

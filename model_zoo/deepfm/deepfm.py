@@ -15,7 +15,7 @@ Labels: (B,) {0,1} click. Output: (B,) logits.
 """
 
 import functools
-from typing import Sequence, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp

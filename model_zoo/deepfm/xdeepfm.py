@@ -13,7 +13,6 @@ import optax
 
 from model_zoo.deepfm.deepfm import (
     DeepFM,
-    NUM_CAT,
     dataset_fn,  # noqa: F401  (same Criteo record format)
     eval_metrics_fn,  # noqa: F401
     loss,  # noqa: F401

@@ -1,0 +1,589 @@
+"""edl-lint rule tests: every rule proves it fires on a known-bad fixture
+AND stays quiet on the idiomatic-good twin, plus suppression/baseline/CLI
+behavior. Pure AST — no JAX, no network; this file must stay fast (it
+runs early in the alphabetical tier-1 order)."""
+
+import json
+import os
+import textwrap
+
+from elasticdl_tpu.analysis.core import (
+    ModuleContext,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from elasticdl_tpu.analysis import __main__ as cli
+
+
+def findings_for(source: str, select=None, rel_path="fixture.py"):
+    src = textwrap.dedent(source)
+    ctx = ModuleContext("fixture.py", src, rel_path)
+    from elasticdl_tpu.analysis.core import all_rules
+
+    out = []
+    for rule in all_rules():
+        if select and rule.id not in select and rule.name not in select:
+            continue
+        for f in rule.check(ctx):
+            if not ctx.suppressed(f):
+                out.append(f)
+    return out
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ #
+# EDL101 guarded-by
+
+
+GUARDED_BAD = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._members = {}   # guarded_by: _lock
+
+        def lookup(self, k):
+            return self._members.get(k)     # BAD: no lock
+"""
+
+GUARDED_GOOD = """
+    import threading
+
+    class Registry:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._members = {}   # guarded_by: _lock
+
+        def lookup(self, k):
+            with self._lock:
+                return self._members.get(k)
+
+        def _count_locked(self):
+            return len(self._members)        # _locked suffix: caller holds
+
+        def annotated(self):  # holds: _lock
+            return len(self._members)
+"""
+
+
+def test_guarded_by_fires_on_unlocked_access():
+    fs = findings_for(GUARDED_BAD, select={"EDL101"})
+    assert rule_ids(fs) == ["EDL101"]
+    assert "self._members" in fs[0].message
+    assert fs[0].context == "Registry.lookup"
+
+
+def test_guarded_by_quiet_on_locked_and_annotated_access():
+    assert findings_for(GUARDED_GOOD, select={"EDL101"}) == []
+
+
+def test_guarded_by_write_detected_and_init_exempt():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0   # guarded_by: _lock
+                self._v = 1   # init re-assignment is exempt
+
+            def bump(self):
+                self._v += 1   # BAD: unlocked write
+    """
+    fs = findings_for(src, select={"EDL101"})
+    assert len(fs) == 1 and "write" in fs[0].message
+
+
+def test_guarded_by_comment_above_assignment_registers_the_attr():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                # guarded_by: _lock
+                self._stream = open("/dev/null", "a")
+
+            def write(self, rec):
+                self._stream.write(rec)   # BAD: unlocked
+    """
+    fs = findings_for(src, select={"EDL101"})
+    assert len(fs) == 1 and "_stream" in fs[0].message
+
+
+def test_guarded_by_nested_function_is_not_considered_locked():
+    src = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._v = 0   # guarded_by: _lock
+
+            def schedule(self):
+                with self._lock:
+                    def later():
+                        return self._v     # BAD: runs after release
+                    return later
+    """
+    fs = findings_for(src, select={"EDL101"})
+    assert len(fs) == 1
+
+
+# ------------------------------------------------------------------ #
+# EDL201 host-sync-in-hot-loop
+
+
+HOT_LOOP_BAD = """
+    def run(trainer, state, batches):
+        total = 0.0
+        for batch in batches:
+            state, logs = trainer.train_step(state, batch)
+            total += float(logs["loss"])       # BAD: sync per step
+            n = int(batch["mask"].sum())       # BAD: sync per step
+            v = logs["loss"].item()            # BAD
+        return total
+"""
+
+HOT_LOOP_GOOD = """
+    def run(trainer, state, batches):
+        losses = []
+        for batch in batches:
+            state, logs = trainer.train_step(state, batch)
+            losses.append(logs["loss"])        # device values accumulate
+        return float(sum(losses))              # one sync after the loop
+"""
+
+
+def test_host_sync_fires_inside_dispatch_loop():
+    fs = findings_for(HOT_LOOP_BAD, select={"EDL201"})
+    assert len(fs) == 3
+    assert all(f.rule == "EDL201" for f in fs)
+
+
+def test_host_sync_quiet_outside_loop_and_in_plain_loops():
+    assert findings_for(HOT_LOOP_GOOD, select={"EDL201"}) == []
+    plain = """
+        def tally(rows):
+            out = 0
+            for r in rows:
+                out += int(r)   # no device dispatch in this loop
+            return out
+    """
+    assert findings_for(plain, select={"EDL201"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL202 jit-cache-churn
+
+
+def test_jit_in_loop_and_immediate_call_fire():
+    bad = """
+        import jax
+
+        def recompiles_every_batch(batches, f):
+            for b in batches:
+                y = jax.jit(f)(b)          # BAD twice: in-loop AND immediate
+            return y
+
+        def immediate(f, x):
+            return jax.jit(f)(x)           # BAD: callable discarded
+    """
+    fs = findings_for(bad, select={"EDL202"})
+    assert len(fs) >= 2
+
+    good = """
+        import jax
+
+        _step = None
+
+        def cached(f, x):
+            global _step
+            if _step is None:
+                _step = jax.jit(f)
+            return _step(x)
+    """
+    assert findings_for(good, select={"EDL202"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL203 tracer-leak
+
+
+def test_tracer_leak_fires_on_self_mutation_under_jit():
+    bad = """
+        import jax
+
+        class T:
+            @jax.jit
+            def step(self, x):
+                self.last = x          # BAD: stores a Tracer
+                return x * 2
+    """
+    fs = findings_for(bad, select={"EDL203"})
+    assert len(fs) == 1 and "self.last" in fs[0].message
+
+    bad_named = """
+        import jax
+
+        def make(obj):
+            def step(x):
+                obj.cache = x          # attribute of a closure var: allowed
+                return x
+            def leaky(x):
+                nonlocal hits
+                hits = x               # BAD: nonlocal leak
+                return x
+            hits = None
+            return jax.jit(leaky), jax.jit(step)
+    """
+    fs = findings_for(bad_named, select={"EDL203"})
+    assert len(fs) == 1 and "nonlocal" in fs[0].message
+
+
+def test_tracer_leak_quiet_on_functional_step():
+    good = """
+        import jax
+
+        @jax.jit
+        def step(state, x):
+            return state.replace(v=x), x * 2
+    """
+    assert findings_for(good, select={"EDL203"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL204 unordered-iteration
+
+
+def test_set_iteration_fires_and_sorted_is_quiet():
+    bad = """
+        def build(params):
+            names = set(params)
+            return {k: params[k] for k in set(params)}   # BAD
+    """
+    fs = findings_for(bad, select={"EDL204"})
+    assert len(fs) == 1
+
+    good = """
+        def build(params):
+            return {k: params[k] for k in sorted(set(params))}
+    """
+    assert findings_for(good, select={"EDL204"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL301 / EDL302 bare stub + deadlines
+
+
+def test_bare_stub_flagged_outside_service_module():
+    bad = """
+        from elasticdl_tpu.proto.service import MasterStub, make_channel
+
+        def connect(addr):
+            return MasterStub(make_channel(addr))      # BAD
+    """
+    fs = findings_for(bad, select={"EDL301"})
+    assert len(fs) == 1
+
+    # the wrapper module itself is allowed to build it
+    assert findings_for(
+        bad, select={"EDL301"}, rel_path="elasticdl_tpu/proto/service.py"
+    ) == []
+
+
+def test_rpc_deadline_required_on_bare_stub_only():
+    bad = """
+        from elasticdl_tpu.proto.service import MasterStub
+
+        def poll(channel, req):
+            stub = MasterStub(channel)
+            return stub.GetTask(req)                   # BAD: no deadline
+    """
+    fs = findings_for(bad, select={"EDL302"})
+    assert len(fs) == 1 and "GetTask" in fs[0].message
+
+    good = """
+        from elasticdl_tpu.proto.service import MasterStub, RetryingMasterStub
+
+        def poll(channel, req):
+            stub = MasterStub(channel)
+            hardened = RetryingMasterStub(channel)
+            a = stub.GetTask(req, timeout=10)          # explicit deadline
+            b = hardened.GetTask(req)                  # policy deadline
+            return a, b
+    """
+    assert findings_for(good, select={"EDL302"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL303 silent swallow
+
+
+def test_silent_swallow_fires_only_on_broad_and_silent():
+    bad = """
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass                      # BAD
+    """
+    assert len(findings_for(bad, select={"EDL303"})) == 1
+
+    bare = """
+        def f(ch):
+            try:
+                ch.close()
+            except:
+                return None               # BAD: bare + silent
+    """
+    assert len(findings_for(bare, select={"EDL303"})) == 1
+
+    narrow = """
+        def f(ch):
+            try:
+                ch.close()
+            except OSError:
+                pass                      # narrowed: a reviewed decision
+    """
+    assert findings_for(narrow, select={"EDL303"}) == []
+
+    logged = """
+        import logging
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                logging.exception("close failed")
+    """
+    assert findings_for(logged, select={"EDL303"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL304 sleep retry jitter
+
+
+def test_constant_sleep_in_retry_loop_fires():
+    bad = """
+        import time
+
+        def poll(stub):
+            while True:
+                try:
+                    return stub.call()
+                except ConnectionError:
+                    time.sleep(2)          # BAD: synchronized beat
+    """
+    assert len(findings_for(bad, select={"EDL304"})) == 1
+
+    jittered = """
+        import random
+        import time
+
+        def poll(stub):
+            while True:
+                try:
+                    return stub.call()
+                except ConnectionError:
+                    time.sleep(2 * random.uniform(0.5, 1.5))
+    """
+    assert findings_for(jittered, select={"EDL304"}) == []
+
+    no_retry = """
+        import time
+
+        def tick():
+            while True:
+                time.sleep(1)              # plain poll loop, no try/except
+    """
+    assert findings_for(no_retry, select={"EDL304"}) == []
+
+
+# ------------------------------------------------------------------ #
+# suppressions, baseline, CLI
+
+
+def test_line_suppression_same_line_and_comment_above():
+    src = """
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass  # edl-lint: disable=EDL303
+    """
+    assert findings_for(src) == []
+
+    above = """
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                # teardown-only, reviewed: edl-lint: disable=silent-exception-swallow
+                pass
+    """
+    assert findings_for(above) == []
+
+
+def test_file_suppression():
+    src = """
+        # edl-lint: disable-file=EDL303
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass
+    """
+    assert findings_for(src) == []
+
+
+def test_unsuppressed_rule_still_fires_next_to_suppressed_one():
+    src = """
+        import time
+
+        def f(stub):
+            while True:
+                try:
+                    return stub.call()
+                except Exception:
+                    pass  # edl-lint: disable=EDL303
+                time.sleep(2)
+    """
+    assert rule_ids(findings_for(src)) == ["EDL304"]
+
+
+def test_baseline_roundtrip_and_stale_detection(tmp_path):
+    bad = textwrap.dedent("""
+        def f(ch):
+            try:
+                ch.close()
+            except Exception:
+                pass
+    """)
+    target = tmp_path / "mod.py"
+    target.write_text(bad)
+    result = run_analysis([str(target)])
+    assert len(result.new) == 1
+
+    baseline_path = tmp_path / ".edl-lint-baseline.json"
+    write_baseline(str(baseline_path), result.findings)
+    baseline = load_baseline(str(baseline_path))
+    result2 = run_analysis([str(target)], baseline=baseline)
+    assert result2.ok and len(result2.baselined) == 1
+
+    # fix the file: the entry goes stale and is reported for pruning
+    target.write_text("def f(ch):\n    ch.close()\n")
+    result3 = run_analysis([str(target)], baseline=baseline)
+    assert result3.ok and len(result3.stale_baseline) == 1
+
+
+def test_duplicate_findings_get_distinct_fingerprints(tmp_path):
+    src = textwrap.dedent("""
+        def f(a, b):
+            try:
+                a()
+            except Exception:
+                pass
+            try:
+                b()
+            except Exception:
+                pass
+    """)
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    result = run_analysis([str(target)])
+    assert len(result.new) == 2
+    baseline_path = tmp_path / "base.json"
+    write_baseline(str(baseline_path), result.findings)
+    # hand-drop one entry: exactly one finding must resurface as new
+    data = json.loads(baseline_path.read_text())
+    data["entries"] = data["entries"][:1]
+    baseline_path.write_text(json.dumps(data))
+    result2 = run_analysis(
+        [str(target)], baseline=load_baseline(str(baseline_path))
+    )
+    assert len(result2.new) == 1 and len(result2.baselined) == 1
+
+
+def test_write_baseline_covers_duplicate_findings(tmp_path):
+    """--write-baseline then an immediate re-run must be clean, even with
+    two identical findings in one scope (occurrence-suffixed entries)."""
+    src = textwrap.dedent("""
+        import time
+
+        def poll(stub):
+            while True:
+                try:
+                    return stub.call()
+                except ConnectionError:
+                    time.sleep(2)
+                time.sleep(2)
+    """)
+    target = tmp_path / "mod.py"
+    target.write_text(src)
+    result = run_analysis([str(target)])
+    assert len(result.new) == 2
+    baseline_path = tmp_path / "base.json"
+    write_baseline(str(baseline_path), result.findings)
+    result2 = run_analysis(
+        [str(target)], baseline=load_baseline(str(baseline_path))
+    )
+    assert result2.ok and len(result2.baselined) == 2
+
+
+def test_single_file_mode_keeps_directory_components_for_allowlists():
+    # linting proto/service.py ALONE must not flag its own internal
+    # MasterStub construction (the rel_path allowlist needs the dirs)
+    import elasticdl_tpu
+
+    pkg = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
+    service = os.path.join(pkg, "proto", "service.py")
+    result = run_analysis([service], select={"EDL301"})
+    assert result.findings == [], [f.render() for f in result.findings]
+
+
+def test_cli_clean_tree_exits_zero(capsys):
+    # THE acceptance gate: the shipped package must lint clean against the
+    # checked-in baseline (empty = no tolerated debt)
+    rc = cli.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+
+
+def test_cli_json_output_and_exit_code(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    rc = cli.main([str(bad), "--json", "--no-baseline"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["ok"] is False
+    assert payload["new"][0]["rule"] == "EDL303"
+
+
+def test_cli_list_rules(capsys):
+    assert cli.main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204",
+                "EDL301", "EDL302", "EDL303", "EDL304"):
+        assert rid in out
+
+
+def test_generated_proto_is_excluded():
+    import elasticdl_tpu
+
+    pkg = os.path.dirname(os.path.abspath(elasticdl_tpu.__file__))
+    from elasticdl_tpu.analysis.core import iter_python_files
+
+    files = [rel for _, rel in iter_python_files([pkg])]
+    assert not any(rel.endswith("elasticdl_tpu_pb2.py") for rel in files)
+    assert any(rel.endswith("master/task_dispatcher.py") for rel in files)

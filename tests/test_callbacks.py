@@ -3,13 +3,12 @@ master's wiring of module-level `callbacks()` (round-3, VERDICT #5 — the
 contract existed but was never invoked).
 """
 
-import os
 import textwrap
 
 import numpy as np
 import pytest
 
-from elasticdl_tpu.api.callbacks import Callback, EarlyStopping, JobContext
+from elasticdl_tpu.api.callbacks import EarlyStopping, JobContext
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 

@@ -23,6 +23,7 @@ import time
 
 import pytest
 
+from elasticdl_tpu.analysis.lockorder import LockOrderRecorder, instrument_master
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.master.membership import Membership
 from elasticdl_tpu.master.servicer import MasterServicer
@@ -70,6 +71,14 @@ def run_control_plane_scenario(seed: int):
     membership = Membership(heartbeat_timeout_s=1e9)
     membership.add_death_callback(dispatcher.recover_tasks)
     servicer = MasterServicer(dispatcher, membership, None)
+    # lock-order recording rides the whole scenario: any inversion
+    # introduced into the control plane raises at its acquire site, and
+    # the graph is certified acyclic before the scenario returns
+    lock_rec = LockOrderRecorder(raise_on_cycle=True)
+    instrument_master(
+        lock_rec, membership=membership, dispatcher=dispatcher,
+        servicer=servicer,
+    )
     server = make_server()
     add_master_servicer(server, servicer)
     port = server.add_insecure_port("localhost:0")
@@ -116,6 +125,7 @@ def run_control_plane_scenario(seed: int):
             pytest.fail("chaos smoke livelocked")
         counts = dispatcher.counts()
         trace = list(faults.get_injector().trace)
+        lock_rec.assert_no_cycles()
     finally:
         channel.close()
         server.stop(None)

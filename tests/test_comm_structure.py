@@ -17,7 +17,6 @@ import re
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from elasticdl_tpu.ops import embedding as emb
 from elasticdl_tpu.parallel.mesh import build_mesh

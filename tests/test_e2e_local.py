@@ -6,7 +6,6 @@ granularity: real process boundaries, real wire traffic, no mocks.
 """
 
 import os
-import sys
 import time
 
 import pytest

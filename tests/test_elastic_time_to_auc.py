@@ -10,7 +10,6 @@ import os
 import re
 import time
 
-import pytest
 
 from elasticdl_tpu.client.local import free_port
 from elasticdl_tpu.common.config import JobConfig

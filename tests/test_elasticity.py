@@ -7,7 +7,6 @@ a worker mid-job (SURVEY §4 fault-tolerance tests), at process granularity.
 import os
 import time
 
-import pytest
 
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.master.main import Master

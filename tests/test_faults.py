@@ -44,6 +44,8 @@ def test_parse_spec_full_grammar():
         "rpc.get_task:explode",         # unknown action
         "rpc.get_task:drop@p",          # malformed param
         "rpc.get_task:drop@bogus=1",    # unknown param
+        "ckpt.save:crash@at=3.7",       # fractional trigger would int()-truncate
+        "rpc.get_task:drop@every=1.5",  # ditto
     ],
 )
 def test_parse_spec_rejects_typos_loudly(bad):

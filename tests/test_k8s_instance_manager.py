@@ -442,7 +442,6 @@ def test_kubectl_watch_stream_parses_real_subprocess(tmp_path):
     """The incremental UTF-8 + JSON decode behind `kubectl --watch
     --output-watch-events -o json`, driven through a real pipe with
     adversarial write boundaries."""
-    import os
     import stat
 
     from elasticdl_tpu.master.k8s_instance_manager import KubectlApi

@@ -1,0 +1,238 @@
+"""Runtime lock-order recorder: inversion detection on synthetic locks,
+and a no-cycle certificate for the real master control plane driven
+concurrently (membership + dispatcher + process manager + servicer)."""
+
+import threading
+
+import pytest
+
+from elasticdl_tpu.analysis.lockorder import (
+    LockOrderRecorder,
+    LockOrderViolation,
+    instrument_master,
+)
+from elasticdl_tpu.common.config import JobConfig
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.process_manager import ProcessManager
+from elasticdl_tpu.master.servicer import MasterServicer
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+
+def test_injected_inversion_is_detected_without_deadlocking():
+    """A -> B in one thread, B -> A in another: a real deadlock needs the
+    threads to interleave just wrong; the graph detects it ALWAYS."""
+    rec = LockOrderRecorder(raise_on_cycle=False)
+    a = rec.wrap(threading.Lock(), "A")
+    b = rec.wrap(threading.Lock(), "B")
+
+    with a:
+        with b:
+            pass
+
+    def inverted():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+    cycles = rec.cycles()
+    assert cycles, "A->B->A inversion not detected"
+    assert sorted(cycles[0]) == ["A", "B"]
+    with pytest.raises(LockOrderViolation):
+        rec.assert_no_cycles()
+
+
+def test_inversion_raises_at_the_acquire_when_enabled():
+    rec = LockOrderRecorder(raise_on_cycle=True)
+    a = rec.wrap(threading.Lock(), "A")
+    b = rec.wrap(threading.Lock(), "B")
+    with a:
+        with b:
+            pass
+    errors = []
+
+    def inverted():
+        try:
+            with b:
+                with a:
+                    pass
+        except LockOrderViolation as e:
+            errors.append(e)
+
+    t = threading.Thread(target=inverted)
+    t.start()
+    t.join(timeout=10)
+    assert errors, "closing the cycle did not raise"
+    msg = str(errors[0])
+    assert "A" in msg and "B" in msg and "first seen at" in msg
+    # the violating acquire released its lock before raising and the
+    # outer `with` unwound: neither lock is stranded
+    for lock in (a, b):
+        assert lock.acquire(blocking=False) is True
+        lock.release()
+
+
+def test_three_lock_cycle_detected():
+    rec = LockOrderRecorder(raise_on_cycle=False)
+    locks = {n: rec.wrap(threading.Lock(), n) for n in "ABC"}
+    order = [("A", "B"), ("B", "C"), ("C", "A")]
+    for first, second in order:
+        def chain(f=first, s=second):
+            with locks[f]:
+                with locks[s]:
+                    pass
+        t = threading.Thread(target=chain)
+        t.start()
+        t.join(timeout=10)
+    cycles = rec.cycles()
+    assert cycles and sorted(cycles[0]) == ["A", "B", "C"]
+
+
+def test_reentrant_acquisition_reported():
+    rec = LockOrderRecorder(raise_on_cycle=False)
+    a = rec.wrap(threading.RLock(), "A")   # reentrant: safe to proceed
+    with a:
+        with a:
+            pass
+    assert any("re-entrant" in v for v in rec.violations())
+
+
+def test_reentrant_plain_lock_raises_even_in_observe_mode():
+    """Proceeding would self-deadlock the thread on the spot, so observe
+    mode still raises instead of hanging the test."""
+    rec = LockOrderRecorder(raise_on_cycle=False)
+    a = rec.wrap(threading.Lock(), "A")
+    with a:
+        with pytest.raises(LockOrderViolation, match="re-entrant"):
+            a.acquire()
+    # the outer hold survived the refused re-acquire and released cleanly
+    assert a.acquire(blocking=False) is True
+    a.release()
+    assert any("self-deadlock" in v for v in rec.violations())
+
+
+def test_consistent_order_produces_no_cycles():
+    rec = LockOrderRecorder(raise_on_cycle=True)
+    a = rec.wrap(threading.Lock(), "A")
+    b = rec.wrap(threading.Lock(), "B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert rec.cycles() == []
+    rec.assert_no_cycles()
+
+
+def test_failed_nonblocking_acquire_records_nothing():
+    rec = LockOrderRecorder(raise_on_cycle=True)
+    inner = threading.Lock()
+    a = rec.wrap(inner, "A")
+    held = threading.Lock()
+    inner.acquire()
+    try:
+        assert a.acquire(blocking=False) is False
+    finally:
+        inner.release()
+    assert rec.edges() == {}
+
+
+# ------------------------------------------------------------------ #
+# the real control plane, driven concurrently
+
+
+def test_master_control_plane_lock_order_is_acyclic():
+    """Membership + dispatcher + process manager + servicer hammered from
+    concurrent threads with the watch loop running: the recorder must see
+    a cycle-free acquisition graph (raise_on_cycle=True makes any
+    inversion fail loudly at its acquire site)."""
+    rec = LockOrderRecorder(raise_on_cycle=True)
+
+    dispatcher = TaskDispatcher(
+        training_shards=[("s0", 0, 400)],
+        evaluation_shards=[("e0", 0, 40)],
+        records_per_task=10,
+        task_timeout_s=1e9,
+    )
+    membership = Membership(heartbeat_timeout_s=0.05)
+    membership.add_death_callback(dispatcher.recover_tasks)
+    servicer = MasterServicer(dispatcher, membership, None)
+    cfg = JobConfig(
+        job_type="evaluation_only",
+        model_def="mnist.mnist_cnn.custom_model",
+        validation_data="synthetic://mnist?n=40",
+        num_workers=1,
+        master_addr="localhost:1",
+    )
+    manager = ProcessManager(cfg, membership=membership,
+                             job_finished_fn=dispatcher.finished)
+    instrument_master(
+        rec,
+        membership=membership,
+        dispatcher=dispatcher,
+        process_manager=manager,
+        servicer=servicer,
+    )
+
+    errors = []
+    stop = threading.Event()
+
+    def guard(fn):
+        def run():
+            try:
+                while not stop.is_set():
+                    fn()
+            except LockOrderViolation as e:   # pragma: no cover - failure path
+                errors.append(e)
+        return run
+
+    wid_box = {}
+
+    def worker_like():
+        info = membership.register("w")
+        wid_box["id"] = info.worker_id
+        task = dispatcher.get(info.worker_id)
+        if task is not None:
+            dispatcher.report(task.task_id, info.worker_id, True)
+        membership.heartbeat(info.worker_id)
+
+    def master_like():
+        membership.reap()
+        dispatcher.poke()
+        dispatcher.counts()
+        membership.alive_workers()
+        manager.statuses()
+        manager.all_exited()
+        manager.all_failed()
+
+    def control_like():
+        servicer.request_checkpoint(wid_box.get("id", 0))
+        servicer.mean_training_loss()
+        wid = wid_box.get("id")
+        if wid is not None:
+            membership.mark_dead(wid, reason="chaos")
+
+    threads = [
+        threading.Thread(target=guard(f))
+        for f in (worker_like, worker_like, master_like, control_like)
+    ]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    assert not errors, errors
+    rec.assert_no_cycles()
+    # the run actually nested locks somewhere (death callback paths etc.)
+    # or at minimum recorded independent acquisitions without inventing
+    # edges between them
+    for (a, b) in rec.edges():
+        assert a != b

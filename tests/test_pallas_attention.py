@@ -3,7 +3,6 @@ reference, forward and backward, in interpret mode on CPU (the kernel's
 compiled path needs a real TPU; numerics are identical by construction)."""
 
 import os
-import functools
 
 import jax
 import jax.numpy as jnp

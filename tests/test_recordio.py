@@ -2,9 +2,7 @@
 reader integration (reference: RecordIO + pyrecordio role, SURVEY §2.4)."""
 
 import os
-import struct
 
-import numpy as np
 import pytest
 
 from elasticdl_tpu.data import recordio as rio
@@ -164,8 +162,6 @@ def test_oversized_record_rejected_not_truncated(tmp_path, native_available):
     never silently wrap. (Exercised via the ctypes arg, not a real 4GiB buf.)"""
     if not native_available:
         pytest.skip("needs native writer")
-    import ctypes
-
     lib = rio._load_lib()
     h = lib.edlr_writer_open(str(tmp_path / "o.rio").encode(), 1 << 20)
     assert h

@@ -3,7 +3,6 @@ always, TensorBoard event files when TF is importable."""
 
 import glob
 import json
-import os
 
 from elasticdl_tpu.master.summary_service import SummaryService
 

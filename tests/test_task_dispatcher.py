@@ -3,7 +3,6 @@ todo/doing/recover, epochs, retries, exactly-once accounting)."""
 
 import time
 
-import pytest
 
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb

@@ -4,7 +4,6 @@ record parsers round-trip. Mirrors the reference's cifar10/resnet50 zoo
 coverage (reference: model_zoo/cifar10_functional_api, resnet50_subclass)."""
 
 import numpy as np
-import pytest
 
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.training.model_spec import ModelSpec
