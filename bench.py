@@ -526,6 +526,145 @@ def bench_time_to_auc(mesh, np, target=0.75):
     }
 
 
+def bench_rescale(mesh, np):
+    """Rescale fast path (ISSUE 3): a simulated cohort resize on the local
+    mesh (all devices -> half), measuring recovery BOTH ways in the same
+    run so the speedup claim is self-contained:
+
+    - cold: the pre-fast-path recovery shape — a fresh trainer on the new
+      mesh with a PRIVATE executable cache (every program re-traces, as a
+      re-formed process would) restoring state from the latest checkpoint;
+    - warm: speculative neighbor compilation beforehand (driven by the
+      master's pending-size announcement via the membership signal file),
+      live state handoff instead of the checkpoint-restore round trip, and
+      the shared executable cache.
+
+    Emits `time_to_recovery_s` (resize signal -> first post-resize step
+    done), the cold twin, `recompile_hit_rate` (warm-phase executable-cache
+    hit rate), and a bit-exactness check of handoff params against the
+    checkpoint-restore path. `mesh` is ignored (the scenario builds its own
+    sub-meshes) but keeps the leg signature uniform."""
+    import tempfile
+
+    import jax
+
+    from elasticdl_tpu.common import membership_signal
+    from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.parallel import elastic
+    from elasticdl_tpu.parallel.mesh import build_mesh
+    from elasticdl_tpu.training import compile_cache as cc
+    from elasticdl_tpu.training.checkpoint import CheckpointManager
+    from elasticdl_tpu.training.trainer import Trainer
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    new_n = max(1, n_dev // 2)
+    if new_n == n_dev:
+        return {"error": f"rescale needs >= 2 devices, have {n_dev}"}
+    batch_size = BATCH - (BATCH % (n_dev * 2)) or n_dev * 2
+
+    module, _ = load_module(os.path.join(REPO_ROOT, "model_zoo"),
+                            "census.wide_deep.custom_model")
+    from elasticdl_tpu.training.model_spec import ModelSpec
+
+    spec = ModelSpec(
+        model=module.custom_model(), loss=module.loss,
+        optimizer=module.optimizer(), dataset_fn=None,
+        eval_metrics_fn=getattr(module, "eval_metrics_fn", None),
+        module_name="census.wide_deep",
+    )
+    r = np.random.RandomState(11)
+    batch0 = {
+        "features": {
+            "dense": r.rand(batch_size, 5).astype(np.float32),
+            "cat": r.randint(0, 400, (batch_size, 9)).astype(np.int32),
+        },
+        "labels": r.randint(0, 2, (batch_size,)).astype(np.int32),
+    }
+    token = "bench-rescale"
+    cache = cc.CompileCache()
+
+    def make_trainer(size, use_cache):
+        sub = build_mesh({"data": size}, devices[:size])
+        return Trainer(spec, sub, cache_token=token, cache=use_cache), sub
+
+    # steady state at full size: init + a few steps
+    trainer_a, _ = make_trainer(n_dev, cache)
+    state = trainer_a.init_state(batch0)
+    for _ in range(2):
+        state, logs = trainer_a.train_step(state, batch0)
+    float(logs["loss"])  # force completion before the checkpoint
+
+    out = {"world_devices": n_dev, "resized_to_devices": new_n}
+    with tempfile.TemporaryDirectory() as tmp:
+        mngr = CheckpointManager(os.path.join(tmp, "ckpt"))
+        mngr.save(state, wait=True)
+
+        # ---- cold: fresh trainer, private cache, checkpoint restore ----
+        cold_cache = cc.CompileCache()
+        t0 = time.perf_counter()
+        trainer_cold, _ = make_trainer(new_n, cold_cache)
+        cold_state = mngr.restore(trainer_cold.init_state(batch0))
+        cold_params = jax.device_get(cold_state.params)  # exactness probe
+        cold_state, logs = trainer_cold.train_step(cold_state, batch0)
+        float(logs["loss"])
+        out["cold_recovery_s"] = round(time.perf_counter() - t0, 3)
+
+        # ---- speculative compile, driven by the master's announcement ----
+        signal_path = os.path.join(tmp, "membership_signal.json")
+        membership_signal.write_signal(
+            signal_path, world_size=n_dev, pending_size=new_n)
+
+        def compile_for_size(size):
+            if size < 1 or size > n_dev or batch_size % size:
+                raise cc.SpeculativeCompiler.SkipSize(
+                    f"{size} devices not representable (of {n_dev}, "
+                    f"batch {batch_size})"
+                )
+            t, sub = make_trainer(size, cache)
+            abs_state = t.abstract_train_state(batch0)
+            t.aot_compile_train_step(
+                abs_state, batch0, speculative=True, abstract=True)
+
+        t0 = time.perf_counter()
+        speculator = cc.SpeculativeCompiler(
+            compile_for_size, n_dev, max_size=n_dev, signal_path=signal_path)
+        compiled = speculator.precompile_once()
+        out["speculative_compile_s"] = round(time.perf_counter() - t0, 3)
+        out["speculative_sizes"] = compiled
+
+        # ---- warm: live handoff + shared (pre-warmed) executable cache ----
+        handoff = elastic.LiveStateHandoff().capture(state)
+        cache.reset_stats()  # hit rate below covers the recovery alone
+        t0 = time.perf_counter()
+        trainer_warm, new_mesh = make_trainer(new_n, cache)
+        warm_state = mngr.restore_or_handoff(
+            trainer_warm.abstract_train_state(batch0), handoff, new_mesh)
+        warm_params = jax.device_get(warm_state.params)  # exactness probe
+        warm_state, logs = trainer_warm.train_step(warm_state, batch0)
+        float(logs["loss"])
+        out["time_to_recovery_s"] = round(time.perf_counter() - t0, 3)
+        stats = cache.stats()
+        out["recompile_hit_rate"] = round(stats["hit_rate"], 3)
+        out["compile_cache"] = {k: round(v, 3) for k, v in stats.items()}
+        mngr.close()
+
+    # live handoff must be bit-exact vs the checkpoint-restore path (the
+    # acceptance gate: skipping the restore round trip changes nothing)
+    leaves_c = jax.tree_util.tree_leaves(cold_params)
+    leaves_w = jax.tree_util.tree_leaves(warm_params)
+    out["handoff_params_exact"] = bool(
+        len(leaves_c) == len(leaves_w)
+        and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(leaves_c, leaves_w)
+        )
+    )
+    cold, warm = out["cold_recovery_s"], out["time_to_recovery_s"]
+    out["recovery_speedup"] = round(cold / warm, 2) if warm else 0.0
+    return out
+
+
 def bench_host_pipeline(np):
     """Host half of the input path ONLY — disk → contiguous span read →
     binary decode — with no JAX backend touched anywhere (verified: the
@@ -682,6 +821,8 @@ def _run_leg(leg, mesh, np):
         return bench_embedding_modes(mesh, np)
     if leg == "time_to_auc":
         return bench_time_to_auc(mesh, np)
+    if leg == "rescale":
+        return bench_rescale(mesh, np)
     if leg == "transformer_lm":
         # the Pallas flash-attention kernel vs the XLA materialized-scores
         # path, same model/batch (ops/pallas_attention.py; TPU only — on CPU
@@ -721,7 +862,7 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
+    "rescale", "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
     "census_wide_deep", "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
@@ -831,6 +972,13 @@ def main():
             ))
         except Exception:
             pass   # cache is an optimization, never a failure
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "rescale":
+        # `python bench.py rescale`: the rescale scenario alone, one JSON
+        # line (CI uploads it as an artifact; tier-1 smoke asserts on it)
+        mesh = build_mesh({"data": len(jax.devices())})
+        print(json.dumps({"rescale": _run_leg("rescale", mesh, np)}))
+        return
 
     if len(sys.argv) >= 3 and sys.argv[1] == "--leg":
         # subprocess mode: one leg, one JSON line

@@ -32,11 +32,21 @@ EDL204 unordered-iteration
     in a `for`/comprehension. Set order varies across processes
     (PYTHONHASHSEED), so any pytree/spec built from it can differ
     between cohort members. Sort first.
+
+EDL205 unkeyed-jit-in-rescale-path
+    `jax.jit(...)` called inside a reform/rescale/resize/handoff code
+    path without going through the executable cache
+    (training/compile_cache.py get_or_build/store_aot). The rescale fast
+    path exists to make recovery compile-free; a fresh jit built during
+    recovery keys XLA's cache on a new function object and pays the full
+    re-trace the cache was built to avoid. Route it through the cache
+    (the builder lambda handed to `get_or_build` is exempt).
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterator, List, Set
 
 from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
@@ -254,6 +264,60 @@ class TracerLeakRule(Rule):
                                 "inside a jitted function leaks a Tracer out "
                                 "of the trace; return it instead",
                             )
+
+
+#: function names that ARE the rescale/recovery path — a compile here is
+#: paid at the worst possible time (mid-recovery), so it must be cache-keyed
+_RESCALE_PATH = re.compile(r"reform|rescale|resize|handoff", re.IGNORECASE)
+
+#: executable-cache entry points whose builder arguments legitimately
+#: construct the jit being cached
+_CACHE_BUILDERS = {"get_or_build", "store_aot", "cached_jit"}
+
+
+@register
+class UnkeyedJitInRescalePathRule(Rule):
+    id = "EDL205"
+    name = "unkeyed-jit-in-rescale-path"
+    doc = (
+        "jax.jit built inside a reform/rescale/resize/handoff code path "
+        "without the executable cache — recovery pays a fresh re-trace the "
+        "rescale fast path exists to avoid"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        reported: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _RESCALE_PATH.search(node.name):
+                continue
+            # anything under a cache entry point (the builder closure handed
+            # to get_or_build/store_aot) is the sanctioned construction site
+            exempt: Set[int] = set()
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _CACHE_BUILDERS
+                ):
+                    for inner in ast.walk(sub):
+                        exempt.add(id(inner))
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_jax_jit(sub.func)
+                    and id(sub) not in exempt
+                    and id(sub) not in reported
+                ):
+                    reported.add(id(sub))
+                    yield self.finding(
+                        ctx, sub,
+                        f"jax.jit inside rescale-path function "
+                        f"{node.name!r} defeats the executable cache — "
+                        "recovery recompiles; route it through "
+                        "compile_cache.get_or_build",
+                    )
 
 
 def _is_set_expr(node: ast.AST) -> bool:

@@ -155,6 +155,11 @@ class JobConfig:
     # <0 keeps JAX's default floor (~1 s: only expensive programs persist);
     # >=0 overrides it (tests use 0 so test-sized programs cache too).
     compilation_cache_min_compile_s: float = -1.0
+    # Rescale fast path: once steady state is reached, precompile the step
+    # programs for neighbor world sizes (N±1, plus any size announced by
+    # the master's pending-membership signal) in a background thread so a
+    # resize lands on a warm executable cache (training/compile_cache.py).
+    speculative_compile: bool = False
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2

@@ -1,0 +1,81 @@
+"""Master -> worker pending-membership announcement (file-based).
+
+The rescale fast path wants workers to know a resize is COMING before the
+teardown lands, so the speculative compiler can precompile the announced
+world size (training/compile_cache.py). The natural channel would be a
+`pending_world_size` field on HeartbeatResponse, but this image's proto
+toolchain cannot regenerate message bindings (no protoc/grpcio-tools), so
+the announcement rides a small JSON file on storage both sides already
+share — the log/checkpoint directory for the local process manager, a
+mounted volume or ConfigMap in the k8s flavor. Writes are atomic
+(tmp + rename), readers tolerate a missing/garbled file (None), and the
+file is advisory: losing it degrades to the pre-announcement behavior
+(the resize still happens, just against a colder cache).
+
+The process manager exports the path to spawned workers as
+`EDL_PENDING_WORLD_FILE`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from typing import Optional
+
+ENV_VAR = "EDL_PENDING_WORLD_FILE"
+
+logger = logging.getLogger(__name__)
+
+
+def write_signal(
+    path: str,
+    *,
+    world_size: int,
+    pending_size: Optional[int] = None,
+    world_version: int = 0,
+) -> bool:
+    """Atomically (re)write the membership signal. Best-effort: a failed
+    write is logged and must never take the caller (the master's watch
+    loop) down with it."""
+    payload = {
+        "world_size": int(world_size),
+        "pending_size": None if pending_size is None else int(pending_size),
+        "world_version": int(world_version),
+    }
+    try:
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        logger.exception("membership signal write failed (%s)", path)
+        return False
+
+
+def read_signal(path: Optional[str] = None) -> Optional[dict]:
+    """Read the signal file (default: $EDL_PENDING_WORLD_FILE). None when
+    unset, missing, or unreadable — all meaning 'no announcement'."""
+    path = path or os.environ.get(ENV_VAR, "")
+    if not path:
+        return None
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return data if isinstance(data, dict) else None
+
+
+def pending_size(path: Optional[str] = None) -> Optional[int]:
+    """The announced next world size, or None when nothing is pending."""
+    data = read_signal(path)
+    if not data:
+        return None
+    pending = data.get("pending_size")
+    try:
+        return int(pending) if pending is not None else None
+    except (TypeError, ValueError):
+        return None

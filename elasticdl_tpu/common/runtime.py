@@ -13,6 +13,8 @@ previous generation's executables instead.
 
 from __future__ import annotations
 
+import os
+
 from elasticdl_tpu.common.log_utils import default_logger
 
 logger = default_logger(__name__)
@@ -20,22 +22,28 @@ logger = default_logger(__name__)
 
 def configure_jax_runtime(cfg) -> None:
     """Apply config-driven JAX process settings. Call before building
-    trainers/meshes (idempotent; safe to call from every entrypoint)."""
-    if cfg.compilation_cache_dir:
+    trainers/meshes (idempotent; safe to call from every entrypoint).
+
+    `EDL_COMPILATION_CACHE_DIR` overrides an empty config value: re-formed
+    worker generations inherit the cache location through the environment
+    even when the job's immutable argv never carried it (the rescale fast
+    path's cross-process warmth channel)."""
+    cache_dir = (
+        getattr(cfg, "compilation_cache_dir", "")
+        or os.environ.get("EDL_COMPILATION_CACHE_DIR", "")
+    )
+    if cache_dir:
         import jax
 
-        jax.config.update(
-            "jax_compilation_cache_dir", cfg.compilation_cache_dir)
-        if cfg.compilation_cache_min_compile_s >= 0:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        min_compile_s = getattr(cfg, "compilation_cache_min_compile_s", -1.0)
+        if min_compile_s >= 0:
             # explicit floor override (tests set 0 so even test-sized
             # programs cache); production keeps JAX's defaults — writing
             # every sub-second jit to shared storage is churn, not savings
             jax.config.update(
                 "jax_persistent_cache_min_compile_time_secs",
-                float(cfg.compilation_cache_min_compile_s),
+                float(min_compile_s),
             )
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
-        logger.info(
-            "persistent XLA compilation cache at %s",
-            cfg.compilation_cache_dir,
-        )
+        logger.info("persistent XLA compilation cache at %s", cache_dir)

@@ -16,12 +16,27 @@ Wire compression (`cast="bfloat16"`): float32/float64 leaves are cast to
 bfloat16 on the host before transfer, halving float bytes on the wire. When
 the model's compute dtype is bfloat16 (the TPU default here), the values are
 cast there anyway, so the computation sees identical inputs.
+
+Elasticity (rescale fast path): in-flight device batches carry the OLD
+mesh's shardings across a re-formation, so the prefetcher keeps each
+pending batch's HOST copy alongside the device copy and exposes `drain()`
+— the worker calls it on reform/rescale, gets the pending host batches
+back, and requeues them through the new mesh instead of silently dropping
+them (exactly-once accounting is span-based, so a dropped-but-uncounted
+batch would be re-read anyway after a full teardown — but an IN-PLACE
+rescale has no teardown, and without the drain those records would be
+lost from the task's span).
+
+`depth` and `cast` resolve from the environment when not given:
+`EDL_PREFETCH_DEPTH` (default 2) and `EDL_PREFETCH_CAST` (default "") —
+so deployments can tune the lookahead window without a config/argv change.
 """
 
 from __future__ import annotations
 
+import os
 from collections import deque
-from typing import Any, Iterable, Iterator
+from typing import Any, Iterable, Iterator, List, Optional
 
 import numpy as np
 
@@ -29,6 +44,25 @@ from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 logger = default_logger(__name__)
+
+DEFAULT_DEPTH = 2
+
+
+def resolve_depth(depth: Optional[int]) -> int:
+    """None -> EDL_PREFETCH_DEPTH -> default; explicit values win."""
+    if depth is not None:
+        return int(depth)
+    try:
+        return int(os.environ.get("EDL_PREFETCH_DEPTH", DEFAULT_DEPTH))
+    except ValueError:
+        return DEFAULT_DEPTH
+
+
+def resolve_cast(cast: Optional[str]) -> str:
+    """None -> EDL_PREFETCH_CAST -> no cast; explicit values win."""
+    if cast is not None:
+        return cast
+    return os.environ.get("EDL_PREFETCH_CAST", "")
 
 
 def _wire_cast(batch: Any, cast: str) -> Any:
@@ -55,35 +89,90 @@ def _wire_cast(batch: Any, cast: str) -> Any:
     return out
 
 
-def prefetch_to_device(
-    mesh, batches: Iterable[Any], depth: int = 2, cast: str = "",
-    partition=None,
-) -> Iterator[Any]:
-    """Yield device-resident (batch-sharded) batches, keeping up to `depth`
-    transfers in flight ahead of the consumer. depth<=0 disables lookahead
-    but still device-puts (and wire-casts) each batch."""
-    it = iter(batches)
+class DevicePrefetcher:
+    """Iterator of device-resident (batch-sharded) batches keeping up to
+    `depth` transfers in flight ahead of the consumer, with an explicit
+    `drain()` for elastic re-formation. depth<=0 disables lookahead but
+    still device-puts (and wire-casts) each batch.
 
-    def put(host_batch):
-        return mesh_lib.shard_batch(mesh, _wire_cast(host_batch, cast), partition)
+    Each pending slot holds (host_batch, device_batch): the host copy costs
+    no extra materialization (the source yields host batches anyway) and is
+    what `drain()` hands back for requeueing — the device copies are
+    dropped, since their shardings die with the old mesh.
+    """
 
-    if depth <= 0:
-        for b in it:
-            yield put(b)
-        return
+    def __init__(
+        self,
+        mesh,
+        batches: Iterable[Any],
+        depth: Optional[int] = None,
+        cast: Optional[str] = None,
+        partition=None,
+    ):
+        self._mesh = mesh
+        self.source: Iterator[Any] = iter(batches)
+        self.depth = resolve_depth(depth)
+        self.cast = resolve_cast(cast)
+        self._partition = partition
+        self._buf: deque = deque()   # (host_batch, device_batch)
+        self._exhausted = False
+        self._drained = False
 
-    buf: deque = deque()
-    exhausted = False
-    while not exhausted and len(buf) < depth:
-        try:
-            buf.append(put(next(it)))
-        except StopIteration:
-            exhausted = True
-    while buf:
-        cur = buf.popleft()
-        if not exhausted:
+    def _put(self, host_batch):
+        return mesh_lib.shard_batch(
+            self._mesh, _wire_cast(host_batch, self.cast), self._partition
+        )
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._buf) < max(1, self.depth):
             try:
-                buf.append(put(next(it)))
+                host = next(self.source)
             except StopIteration:
-                exhausted = True
-        yield cur
+                self._exhausted = True
+                return
+            self._buf.append((host, self._put(host)))
+
+    def __iter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __next__(self):
+        if self._drained:
+            raise StopIteration
+        if self.depth <= 0:
+            return self._put(next(self.source))
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        _, device_batch = self._buf.popleft()
+        return device_batch
+
+    def drain(self) -> List[Any]:
+        """Invalidate the lookahead window: return the pending HOST batches
+        (oldest first) and stop this prefetcher. The caller requeues them —
+        through a new prefetcher on the new mesh, or back to the task
+        service — so no record silently disappears across a re-formation.
+        The un-consumed source remains available as `self.source`."""
+        pending = [host for host, _ in self._buf]
+        self._buf.clear()
+        self._drained = True
+        return pending
+
+    def close(self) -> None:
+        """Release the source (generator-based sources stop cleanly)."""
+        self._buf.clear()
+        self._drained = True
+        close = getattr(self.source, "close", None)
+        if close is not None:
+            close()
+
+
+def prefetch_to_device(
+    mesh, batches: Iterable[Any], depth: Optional[int] = None,
+    cast: Optional[str] = None, partition=None,
+) -> DevicePrefetcher:
+    """Yield device-resident (batch-sharded) batches, keeping up to `depth`
+    transfers in flight ahead of the consumer (see DevicePrefetcher; this
+    wrapper is the stable entry point call sites use)."""
+    return DevicePrefetcher(
+        mesh, batches, depth=depth, cast=cast, partition=partition
+    )

@@ -19,7 +19,7 @@ import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import faults, membership_signal
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.net import free_port
 from elasticdl_tpu.common.constants import ExitCode, PodStatus, WorkerEnv
@@ -68,6 +68,7 @@ class ProcessManager:
         job_finished_fn=None,
         checkpoint_request_fn=None,
         resize_checkpoint_timeout_s: float = 30.0,
+        membership_signal_path: Optional[str] = None,
     ):
         self.cfg = cfg
         self._membership = membership
@@ -100,6 +101,17 @@ class ProcessManager:
         self.infra_retry_max = 10
         # timestamped re-formation records: (wall_clock_s, old_size, new_size)
         self.reformation_log: List[Tuple[float, int, int]] = []  # guarded_by: _lock
+        # Pending-membership signal (rescale fast path): a planned resize is
+        # ANNOUNCED through this file before the teardown lands, so workers'
+        # speculative compilers precompile the next world size while the old
+        # one still trains. Default location: the log dir (shared with the
+        # workers on this manager's single host); "" disables.
+        if membership_signal_path is None:
+            base = log_dir or self.cfg.checkpoint_dir
+            membership_signal_path = (
+                os.path.join(base, "membership_signal.json") if base else ""
+            )
+        self._signal_path = membership_signal_path
 
     @property
     def _cohort_mode(self) -> bool:
@@ -109,6 +121,24 @@ class ProcessManager:
     def cohort_size(self) -> int:
         with self._lock:
             return self._cohort_size
+
+    def pending_size(self) -> Optional[int]:
+        """The announced (not yet applied) next cohort size, if any."""
+        with self._lock:
+            return self._pending_resize
+
+    def _announce_locked(self) -> None:  # holds: _lock
+        """(Re)write the pending-membership signal file from the current
+        locked state. Best-effort — the announcement is an optimization
+        for the workers' speculative compilers, never a failure source."""
+        if not self._signal_path:
+            return
+        membership_signal.write_signal(
+            self._signal_path,
+            world_size=self._cohort_size,
+            pending_size=self._pending_resize,
+            world_version=self._world_version,
+        )
 
 
     # ------------------------------------------------------------------ #
@@ -130,6 +160,9 @@ class ProcessManager:
             # differ from the argv's immutable cfg.num_processes
             env["EDL_NUM_PROCESSES"] = str(self._cohort_size)
             env["EDL_WORLD_VERSION"] = str(self._world_version)
+        if self._signal_path:
+            # where workers read the pending-membership announcement
+            env[membership_signal.ENV_VAR] = self._signal_path
         argv = self.cfg.to_argv()
         stdout = stderr = None
         if self._log_dir:
@@ -163,6 +196,10 @@ class ProcessManager:
 
     def start_workers(self) -> None:
         with self._lock:
+            # fresh job, fresh announcement: a stale pending_size left by a
+            # crashed previous run (same log dir) must not send the new
+            # workers' speculative compilers chasing a phantom resize
+            self._announce_locked()
             if self._cohort_mode:
                 self._spawn_cohort_locked()
             else:
@@ -201,6 +238,7 @@ class ProcessManager:
             with self._lock:
                 target = (self._pending_resize or self._cohort_size) + 1
                 self._pending_resize = target
+                self._announce_locked()
                 logger.info("cohort scale-out requested: -> %d processes", target)
                 return target
         _reject_plain_training_scale_out(self.cfg)
@@ -218,6 +256,7 @@ class ProcessManager:
         with self._lock:
             target = max(1, (self._pending_resize or self._cohort_size) - 1)
             self._pending_resize = target
+            self._announce_locked()
             logger.info("cohort scale-in requested: -> %d processes", target)
             return target
 
@@ -327,6 +366,9 @@ class ProcessManager:
                 self._cohort_relaunches = 0
             self._spawn_cohort_locked(new_size)
             self.reformation_log.append((t0, old_size, new_size))
+            # the resize landed: the announcement now carries the NEW world
+            # (pending cleared unless another resize is already queued)
+            self._announce_locked()
         if new_size != old_size:
             logger.warning(
                 "cohort RESIZED %d -> %d processes (world v%d): %s",

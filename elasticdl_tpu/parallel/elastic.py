@@ -165,6 +165,152 @@ def make_global_batch_stack(mesh, batches, partition=None) -> Any:
     return out
 
 
+def neighbor_world_sizes(
+    current: int,
+    pending: Optional[int] = None,
+    min_size: int = 1,
+    max_size: Optional[int] = None,
+) -> list:
+    """Candidate next world sizes for speculative compilation: the
+    master's announced pending size (first — it is the one about to
+    happen), then N-1 and N+1, clamped to [min_size, max_size]."""
+    sizes = {current - 1, current + 1}
+    if pending is not None:
+        sizes.add(int(pending))
+    sizes = {
+        s for s in sizes
+        if s >= min_size and (max_size is None or s <= max_size)
+        and s != current
+    }
+    return sorted(sizes, key=lambda s: (s != pending, abs(s - current), s))
+
+
+# ---------------------------------------------------------------------- #
+# Live state handoff (rescale fast path, part 3)
+#
+# A PLANNED resize does not need the checkpoint-restore round trip: the
+# donor arrays are still resident, and jax.device_put reshards them
+# directly onto the new mesh. Only shards whose owner set changes move;
+# a leaf already laid out identically passes through untouched.
+
+
+class _HostStaged:
+    """A state leaf pulled to host because its owner devices are about to
+    disappear (cross-process teardown path); carries the PartitionSpec it
+    had so `apply` can lay it back out on the new mesh."""
+
+    __slots__ = ("array", "spec")
+
+    def __init__(self, array, spec):
+        self.array = array
+        self.spec = spec
+
+
+def _leaf_spec(x):
+    from jax.sharding import PartitionSpec as P
+
+    sharding = getattr(x, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    return spec if spec is not None else P()
+
+
+def reshard_state(state: Any, new_mesh) -> Any:
+    """Reshard a TrainState (or any pytree of jax arrays) onto `new_mesh`,
+    preserving each leaf's PartitionSpec (pruned to the new mesh's axes).
+    Leaves whose layout is unchanged are untouched; a spec the new mesh
+    cannot satisfy (row count not divisible by the shrunken axis) falls
+    back to replication with a warning — correct, just larger."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def move(x):
+        if isinstance(x, _HostStaged):
+            value, spec = x.array, x.spec
+        elif isinstance(x, jax.Array):
+            value, spec = x, _leaf_spec(x)
+        else:
+            return x
+        spec = mesh_lib.prune_spec(new_mesh, spec)
+        try:
+            return jax.device_put(value, NamedSharding(new_mesh, spec))
+        except ValueError:
+            logger.warning(
+                "leaf %s cannot keep spec %s on the %s mesh; replicating",
+                getattr(value, "shape", "?"), spec,
+                dict(zip(new_mesh.axis_names, new_mesh.devices.shape)),
+            )
+            return jax.device_put(value, NamedSharding(new_mesh, P()))
+
+    return jax.tree_util.tree_map(
+        move, state, is_leaf=lambda x: isinstance(x, _HostStaged)
+    )
+
+
+class LiveStateHandoff:
+    """One planned-resize handoff: capture on the old world, apply on the
+    new — skipping the checkpoint-restore round trip.
+
+    `capture` is zero-copy (device arrays are kept by reference) and
+    records the step so the recipient can arbitrate against the newest
+    durable checkpoint. `stage_to_host` exists for teardown paths where
+    donor devices are about to vanish: ONLY leaves with at least one owner
+    outside the surviving set are pulled to host (the snapshot is scoped
+    to shards whose owner set changes; everything else stays on-device).
+    `apply` reshards everything onto the new mesh via `reshard_state` and
+    consumes the capture (one-shot)."""
+
+    def __init__(self):
+        self._state: Any = None
+        self._step: Optional[int] = None
+
+    @property
+    def captured(self) -> bool:
+        return self._state is not None
+
+    @property
+    def step(self) -> Optional[int]:
+        return self._step
+
+    def capture(self, state: Any) -> "LiveStateHandoff":
+        self._state = state
+        # host sync — callers sit at a task/step boundary by construction
+        self._step = int(jax.device_get(state.step)) if hasattr(
+            state, "step") else None
+        return self
+
+    def stage_to_host(self, surviving_device_ids) -> int:
+        """Pull to host the leaves with any owner OUTSIDE the surviving
+        device set; returns how many leaves were staged. In-process
+        resizes never need this (device_put reads donors directly);
+        teardown paths call it before the old world dies."""
+        surviving = set(int(d) for d in surviving_device_ids)
+        staged = 0
+
+        def maybe_stage(x):
+            nonlocal staged
+            if not isinstance(x, jax.Array):
+                return x
+            owners = {int(d.id) for d in x.sharding.device_set}
+            if owners <= surviving:
+                return x
+            staged += 1
+            return _HostStaged(np.asarray(jax.device_get(x)), _leaf_spec(x))
+
+        self._state = jax.tree_util.tree_map(maybe_stage, self._state)
+        return staged
+
+    def apply(self, new_mesh) -> Any:
+        """Reshard the captured state onto `new_mesh`; consumes the
+        capture so stale donors cannot be applied twice."""
+        if self._state is None:
+            raise RuntimeError("LiveStateHandoff.apply with nothing captured")
+        state, self._state = self._state, None
+        return reshard_state(state, new_mesh)
+
+    def discard(self) -> None:
+        self._state = None
+        self._step = None
+
+
 def context_from_env(cfg) -> Optional[CohortContext]:
     """Build the context for this process from config + env (the process
     manager exports EDL_PROCESS_ID per spawned cohort member).

@@ -220,6 +220,49 @@ def shard_batch_stack(mesh: Mesh, batches, partition=None):
     return out
 
 
+def abstract_batch(mesh: Mesh, batch, partition=None):
+    """ShapeDtypeStruct mirror of `shard_batch(mesh, batch)`: same leaves,
+    same NamedShardings, zero data movement. This is what execution-free
+    AOT lowering consumes (rescale fast path: a speculative compile for a
+    neighbor world must not device_put onto devices it cannot execute
+    on)."""
+    def sds_with(sh):
+        def sds(x):
+            x = x if hasattr(x, "shape") else np.asarray(x)
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=sh)
+        return sds
+
+    if not partition or not isinstance(batch, Mapping):
+        return jax.tree_util.tree_map(sds_with(batch_sharding(mesh)), batch)
+    out = {}
+    for key, value in batch.items():
+        sh = NamedSharding(mesh, batch_key_spec(mesh, key, partition))
+        out[key] = jax.tree_util.tree_map(sds_with(sh), value)
+    return out
+
+
+def abstract_batch_stack(mesh: Mesh, batch, k: int, partition=None):
+    """ShapeDtypeStruct mirror of `shard_batch_stack(mesh, [batch]*k)`:
+    leaves (K, B, ...) with P(None, <batch spec>) shardings, no data."""
+    def sds_with(spec):
+        sh = NamedSharding(mesh, P(None, *spec))
+
+        def sds(x):
+            x = x if hasattr(x, "shape") else np.asarray(x)
+            return jax.ShapeDtypeStruct((k,) + tuple(x.shape), x.dtype,
+                                        sharding=sh)
+        return sds
+
+    if not isinstance(batch, Mapping):
+        return jax.tree_util.tree_map(
+            sds_with(batch_key_spec(mesh, "", partition)), batch)
+    out = {}
+    for key, value in batch.items():
+        out[key] = jax.tree_util.tree_map(
+            sds_with(batch_key_spec(mesh, key, partition)), value)
+    return out
+
+
 def prune_spec(mesh: Mesh, spec: P) -> P:
     """Drop spec axes the mesh doesn't have: the same zoo config (e.g. tokens
     P('data','seq')) runs on a pure-data mesh without a seq axis."""

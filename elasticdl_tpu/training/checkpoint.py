@@ -214,6 +214,54 @@ class CheckpointManager:
         logger.info("checkpoint step %d -> %s", step, self._dir)
         return step
 
+    def save_overlapped(self, state: Any, overlap_fn, step: Optional[int] = None) -> int:
+        """Rescale fast path: start the (async) save, run the caller's
+        teardown work while orbax writes in the background, then block for
+        durability. Used by the planned-resize/preemption drain so the
+        final checkpoint write overlaps world teardown instead of
+        serializing in front of it. The overlap work failing does not lose
+        the checkpoint (the durability wait still runs); a failed save
+        surfaces only after the overlap work completed."""
+        step = self.save(state, step=step, wait=False)
+        try:
+            overlap_fn()
+        except Exception:
+            logger.exception("overlap work during final save failed")
+        self._mngr.wait_until_finished()
+        return step
+
+    def restore_or_handoff(
+        self, abstract_state: Any, handoff, new_mesh, step: Optional[int] = None
+    ) -> Optional[Any]:
+        """Prefer a live state handoff (parallel/elastic.LiveStateHandoff)
+        over the checkpoint-restore round trip when the captured state is
+        at least as new as the newest durable step — the planned-resize
+        case, where the donor arrays are still resident and resharding
+        beats deserializing. Anything older (or no capture at all) falls
+        back to a plain restore; a failed apply falls back too, so the
+        handoff is an optimization, never a new failure mode."""
+        if handoff is not None and handoff.captured:
+            latest = self.latest_step(refresh=True)
+            if latest is None or (handoff.step or 0) >= latest:
+                try:
+                    state = handoff.apply(new_mesh)
+                    logger.info(
+                        "live state handoff applied at step %s "
+                        "(checkpoint-restore skipped)", handoff.step,
+                    )
+                    self.last_restored_step = handoff.step
+                    return state
+                except Exception:
+                    logger.exception(
+                        "live handoff failed; falling back to restore")
+            else:
+                logger.info(
+                    "handoff step %s older than durable step %d; restoring",
+                    handoff.step, latest,
+                )
+                handoff.discard()
+        return self.restore(abstract_state, step=step)
+
     def latest_step(self, refresh: bool = False) -> Optional[int]:
         """refresh=True re-reads the directory — orbax caches the step list
         per manager instance, so observers polling for checkpoints written by

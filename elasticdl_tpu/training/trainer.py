@@ -33,6 +33,7 @@ from jax.sharding import Mesh
 
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.parallel import mesh as mesh_lib
+from elasticdl_tpu.training import compile_cache as cc
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.training import metrics as metrics_lib
 
@@ -199,14 +200,9 @@ def _accumulated_grads(forward, loss_fn, state, features, labels, mask,
     return loss_sum / denom, new_vars, grads
 
 
-def _aval_signature(tree: Any) -> Tuple:
-    """Hashable (shape, dtype) signature of a pytree's array leaves —
-    identifies the XLA program a (state, batch) pair lowers to."""
-    return tuple(
-        (tuple(leaf.shape), str(leaf.dtype))
-        for leaf in jax.tree_util.tree_leaves(tree)
-        if hasattr(leaf, "shape")
-    )
+# identifies the XLA program a (state, batch) pair lowers to; shared with
+# the executable cache so AOT keys and cost-cache keys agree
+_aval_signature = cc.aval_signature
 
 
 def resolve_remat_policy(name: str):
@@ -245,9 +241,29 @@ class Trainer:
         remat_policy: str = "",
         grad_accum: int = 1,
         seed: int = 0,
+        cache_token: str = "",
+        cache: "cc.CompileCache" = None,
     ):
         self.spec = spec
         self.mesh = mesh
+        # Executable-cache identity (rescale fast path): job entrypoints
+        # pass a config-derived token so pre/post-resize trainers (and the
+        # speculative compiler's neighbor trainers) share programs through
+        # the process-global cache. Ad-hoc trainers (no token) get a
+        # PRIVATE cache instead: entries — and the compiled executables
+        # plus closed-over models they pin — die with the trainer, exactly
+        # the pre-cache lifetime (a global insert would pin every
+        # short-lived trainer's programs until LRU pressure evicts them).
+        self.cache_token = cache_token or cc.instance_token()
+        if cache is not None:
+            self._cache = cache
+        elif cache_token:
+            self._cache = cc.global_cache()
+        else:
+            self._cache = cc.CompileCache()
+        # AOT executables pinned per kind: (aval signature, executable or
+        # None, cache AOT generation); resolved lazily per call kind
+        self._pinned_exe: Dict[str, Tuple[Any, Any, int]] = {}
         # a named policy implies remat on; "" + remat=True is full remat.
         # Resolved HERE so a bad name fails at construction, not at the
         # first train-step build after the job is already running.
@@ -274,6 +290,134 @@ class Trainer:
         self._predict_many = None
 
     # ------------------------------------------------------------------ #
+    # Executable cache plumbing (rescale fast path)
+
+    def _program_key(self, kind: str) -> Tuple:
+        """Identity of one step PROGRAM: config-derived token + mesh
+        fingerprint + every trainer knob that changes the trace. No world
+        version, no process identity — which is exactly what makes a
+        re-formed world at the same shape a cache HIT."""
+        return (
+            self.cache_token,
+            kind,
+            cc.mesh_fingerprint(self.mesh),
+            self.remat,
+            self.remat_policy,
+            self.grad_accum,
+            float(self.spec.aux_loss_weight or 0.0),
+        )
+
+    def _ensure(self, attr: str, kind: str, build,
+                speculative: bool = False) -> Any:
+        """Resolve the jitted callable for `kind` through the shared
+        executable cache, pinning it on the instance (one counted cache
+        lookup per trainer per kind — a post-resize trainer that finds the
+        previous generation's callable is the `recompile_hit_rate` hit;
+        speculative resolutions count as speculative, not misses)."""
+        fn = getattr(self, attr)
+        if fn is None:
+            fn = self._cache.get_or_build(
+                self._program_key(kind), build, speculative=speculative)
+            setattr(self, attr, fn)
+        return fn
+
+    def compile_stats(self) -> Dict[str, float]:
+        """Hit/miss/speculative counters of the shared executable cache."""
+        return self._cache.stats()
+
+    def _dispatch(self, kind: str, jitted, *args):
+        """Prefer a cache-resident AOT executable for these exact avals
+        (the speculative compiler's output); fall back to the jitted
+        callable. The common case — no AOT entry exists for this kind —
+        pays ZERO per-step overhead: once a negative lookup is pinned, the
+        cache's AOT generation counter (bumped on every store_aot) is the
+        only thing checked until a new executable could actually match.
+        Known trade: an AOT entry stored for a shape OTHER than the first
+        one dispatched, before any store bumps the generation again, can
+        be shadowed by the negative pin — it then just runs the (correct)
+        jitted path."""
+        gen = self._cache.aot_generation
+        pinned = self._pinned_exe.get(kind)
+        if pinned is not None and pinned[2] == gen and pinned[1] is None:
+            return jitted(*args)
+        sig = cc.aval_signature(args)
+        if pinned is None or pinned[0] != sig or pinned[2] != gen:
+            exe = self._cache.peek(self._program_key(kind) + ("aot", sig))
+            pinned = (sig, exe, gen)
+            self._pinned_exe[kind] = pinned
+        exe = pinned[1]
+        if exe is not None:
+            try:
+                return exe(*args)
+            except Exception:
+                # input sharding/layout drifted from what the executable
+                # was lowered with: drop to the jitted path (which
+                # reshards) for good on this shape
+                logger.warning(
+                    "AOT executable for %s rejected its inputs; falling "
+                    "back to the jitted path", kind, exc_info=True,
+                )
+                self._pinned_exe[kind] = (sig, None, gen)
+        return jitted(*args)
+
+    def _aot_compile(self, attr: str, kind: str, build, args,
+                     speculative: bool = False):
+        """`.lower().compile()` the program for these exact (sharded) args
+        and park the executable in the shared cache — which also feeds the
+        persistent on-disk XLA cache when one is configured. Idempotent per
+        aval signature."""
+        fn = self._ensure(attr, kind, build, speculative=speculative)
+        key = self._program_key(kind) + ("aot", cc.aval_signature(args))
+        exe = self._cache.peek(key)
+        if exe is not None:
+            return exe
+        with jax.set_mesh(self.mesh):
+            exe = fn.lower(*args).compile()
+        return self._cache.store_aot(key, exe, speculative=speculative)
+
+    def _aot_batch(self, batch, abstract: bool):
+        """Concrete callers get the real sharded batch; abstract callers
+        (speculative compiles for worlds this process cannot execute on)
+        get the ShapeDtypeStruct mirror — identical avals and shardings,
+        zero data movement."""
+        if abstract:
+            return mesh_lib.abstract_batch(
+                self.mesh, batch, self.spec.batch_partition)
+        return mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
+
+    def aot_compile_train_step(self, state, batch, speculative: bool = False,
+                               abstract: bool = False):
+        return self._aot_compile(
+            "_train_step", "train_step", self._build_train_step,
+            (state, self._aot_batch(batch, abstract)), speculative=speculative,
+        )
+
+    def aot_compile_eval_step(self, state, batch, speculative: bool = False,
+                              abstract: bool = False):
+        return self._aot_compile(
+            "_eval_step", "eval_step", self._build_eval_step,
+            (state, self._aot_batch(batch, abstract), self.new_metric_states()),
+            speculative=speculative,
+        )
+
+    def aot_compile_predict_step(self, state, batch, speculative: bool = False,
+                                 abstract: bool = False):
+        return self._aot_compile(
+            "_predict_step", "predict_step", self._build_predict_step,
+            (state, self._aot_batch(batch, abstract)), speculative=speculative,
+        )
+
+    def aot_compile_train_many(self, state, stacked_batch,
+                               speculative: bool = False):
+        """AOT twin for the scan-of-steps program (callers on the grouped
+        dispatch path — steps_per_dispatch > 1 — hand a stacked batch built
+        with shard_batch_stack / make_global_batch_stack)."""
+        return self._aot_compile(
+            "_train_many", "train_many", self._build_train_many,
+            (state, stacked_batch), speculative=speculative,
+        )
+
+    # ------------------------------------------------------------------ #
     # State creation
 
     def init_state(self, example_batch: Dict[str, Any]) -> TrainState:
@@ -291,10 +435,10 @@ class Trainer:
         features, _, _ = _split_batch(example_batch)
         root_key = jax.random.PRNGKey(self.seed)
 
-        def _variables(rng):
-            return model.init({"params": rng, "dropout": rng}, features, training=False)
+        def _variables(rng, feats):
+            return model.init({"params": rng, "dropout": rng}, feats, training=False)
 
-        with jax.set_mesh(self.mesh):
+        def build_create():
             # Derive shardings from flax partitioning metadata. Optimizer
             # slots (Adam mu/nu, …) must shard exactly like their params —
             # the PS slot tables of the reference (elasticdl/pkg/ps/
@@ -302,16 +446,16 @@ class Trainer:
             # tree ops preserve nn.Partitioned boxes, so running tx.init on
             # the *boxed* abstract params yields boxed slots whose specs we
             # can read; GSPMD propagation alone leaves them replicated.
-            def _abstract(rng):
-                variables = _variables(rng)
+            def _abstract(rng, feats):
+                variables = _variables(rng, feats)
                 return variables, tx.init(variables["params"])
 
-            abstract, abstract_opt = jax.eval_shape(_abstract, root_key)
+            abstract, abstract_opt = jax.eval_shape(_abstract, root_key, features)
             param_shardings = nn.get_sharding(abstract, self.mesh)
             opt_shardings = nn.get_sharding(abstract_opt, self.mesh)
 
-            def _create(rng):
-                variables = nn.meta.unbox(_variables(rng))
+            def _create(rng, feats):
+                variables = nn.meta.unbox(_variables(rng, feats))
                 variables = jax.tree_util.tree_map(
                     jax.lax.with_sharding_constraint, variables, param_shardings
                 )
@@ -329,14 +473,74 @@ class Trainer:
                     rng=rng,
                 )
 
-            # one-shot by design: init runs once per job, and the sharded
-            # init MUST run under jit (shard-wise placement); caching the
-            # callable would pin example-batch avals for no benefit:
-            # edl-lint: disable=EDL202
-            state = jax.jit(_create)(root_key)
+            return jax.jit(_create)
+
+        with jax.set_mesh(self.mesh):
+            # Cache-keyed like the step programs (a re-formed world at an
+            # unchanged shape must not re-trace model init). The key carries
+            # the example-feature avals because the derived shardings bake
+            # the parameter shapes in; features are an ARGUMENT of the
+            # jitted program (not a closure constant), so a cached program
+            # re-run with a different example batch stays value-correct
+            # even for data-dependent initializers.
+            create = self._cache.get_or_build(
+                self._program_key("init") + (cc.aval_signature(features),),
+                build_create,
+            )
+            state = create(root_key, features)
         n = sum(x.size for x in jax.tree_util.tree_leaves(state.params))
         logger.info("Initialized model %s: %.3fM params", self.spec.module_name, n / 1e6)
         return state
+
+    def abstract_train_state(self, example_batch: Dict[str, Any]) -> TrainState:
+        """Execution-free twin of `init_state`: the same TrainState pytree
+        as ShapeDtypeStructs carrying their NamedShardings. Consumed by
+        checkpoint-restore targets and by AOT lowering for worlds this
+        process cannot execute on (speculative neighbor compilation: on a
+        real multi-process mesh, running init from one process would hang
+        on collectives its peers never joined — lowering does not)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        model, tx = self.spec.model, self.spec.optimizer
+        features, _, _ = _split_batch(example_batch)
+        root_key = jax.random.PRNGKey(self.seed)
+
+        with jax.set_mesh(self.mesh):
+            def _abstract(rng, feats):
+                variables = model.init(
+                    {"params": rng, "dropout": rng}, feats, training=False)
+                return variables, tx.init(variables["params"])
+
+            abstract, abstract_opt = jax.eval_shape(_abstract, root_key, features)
+            param_shardings = nn.get_sharding(abstract, self.mesh)
+            opt_shardings = nn.get_sharding(abstract_opt, self.mesh)
+            repl = NamedSharding(self.mesh, P())
+
+            def strip_boxes(tree):
+                # nn.meta.unbox applies a sharding constraint (trace-only);
+                # here we just want the boxed avals out of their metadata
+                is_box = lambda x: isinstance(x, nn.meta.AxisMetadata)  # noqa: E731
+                return jax.tree_util.tree_map(
+                    lambda x: x.value if is_box(x) else x, tree, is_leaf=is_box
+                )
+
+            def sds(leaf, sharding):
+                return jax.ShapeDtypeStruct(
+                    tuple(leaf.shape), leaf.dtype, sharding=sharding)
+
+            variables = jax.tree_util.tree_map(
+                sds, strip_boxes(abstract), param_shardings)
+            params = variables.pop("params")
+            opt_state = jax.tree_util.tree_map(
+                sds, strip_boxes(abstract_opt), opt_shardings)
+            return TrainState(
+                step=jax.ShapeDtypeStruct((), jnp.int32, sharding=repl),
+                params=params,
+                opt_state=opt_state,
+                extra_vars=variables,
+                rng=jax.ShapeDtypeStruct(
+                    tuple(root_key.shape), root_key.dtype, sharding=repl),
+            )
 
     # ------------------------------------------------------------------ #
     # Steps
@@ -443,11 +647,10 @@ class Trainer:
     # Public API
 
     def train_step(self, state: TrainState, batch: Dict[str, Any]):
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+        fn = self._ensure("_train_step", "train_step", self._build_train_step)
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            return self._train_step(state, batch)
+            return self._dispatch("train_step", fn, state, batch)
 
     def train_many(self, state: TrainState, stacked_batch):
         """K train steps in ONE XLA dispatch: `lax.scan` of the step over a
@@ -457,18 +660,17 @@ class Trainer:
         round-trip per minibatch — SURVEY §3.3; through this sandbox's TPU
         tunnel one dispatch costs ~10-70 ms, dwarfing small steps). Returns
         (new_state, metrics stacked over the K steps)."""
-        self._ensure_train_many()
+        fn = self._ensure("_train_many", "train_many", self._build_train_many)
         with jax.set_mesh(self.mesh):
-            return self._train_many(state, stacked_batch)
+            return self._dispatch("train_many", fn, state, stacked_batch)
 
-    def _ensure_train_many(self) -> None:
-        """Build the scan-of-step program once."""
-        if self._train_many is None:
-            raw = self._raw_train_step()
-            self._train_many = jax.jit(
-                lambda s, stacked: jax.lax.scan(raw, s, stacked),
-                donate_argnums=(0,),
-            )
+    def _build_train_many(self):
+        """The scan-of-step program."""
+        raw = self._raw_train_step()
+        return jax.jit(
+            lambda s, stacked: jax.lax.scan(raw, s, stacked),
+            donate_argnums=(0,),
+        )
 
     def train_step_cost(self, state: TrainState, batch) -> Dict[str, float]:
         """XLA cost analysis of ONE train step (the scan body `train_many`
@@ -485,11 +687,10 @@ class Trainer:
         'bytes accessed' counts every pre-fusion intermediate and so
         upper-bounds real HBM traffic. This is the analytic numerator for
         the MFU the bench reports."""
-        if self._train_step is None:
-            self._train_step = self._build_train_step()
+        fn = self._ensure("_train_step", "train_step", self._build_train_step)
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            lowered = self._train_step.lower(state, batch)
+            lowered = fn.lower(state, batch)
             ca = lowered.cost_analysis()
             d = ca if isinstance(ca, dict) else (ca[0] if ca else {})
             if not d.get("flops"):
@@ -531,11 +732,10 @@ class Trainer:
         return states
 
     def eval_step(self, state: TrainState, batch, metric_states):
-        if self._eval_step is None:
-            self._eval_step = self._build_eval_step()
+        fn = self._ensure("_eval_step", "eval_step", self._build_eval_step)
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            return self._eval_step(state, batch, metric_states)
+            return self._dispatch("eval_step", fn, state, batch, metric_states)
 
     def eval_many(self, state: TrainState, stacked_batch, metric_states):
         """K eval steps in ONE XLA dispatch: `lax.scan` of the eval step
@@ -546,34 +746,39 @@ class Trainer:
         numerically equivalent to K sequential `eval_step` calls (the scan
         body compiles separately — XLA fusion may round the last bit
         differently)."""
-        if self._eval_many is None:
-            raw = self._raw_eval_step()
-            self._eval_many = jax.jit(
-                lambda s, stacked, ms: jax.lax.scan(
-                    lambda carry, b: (raw(s, b, carry), None), ms, stacked
-                )[0]
-            )
+        fn = self._ensure("_eval_many", "eval_many", self._build_eval_many)
         with jax.set_mesh(self.mesh):
-            return self._eval_many(state, stacked_batch, metric_states)
+            return fn(state, stacked_batch, metric_states)
+
+    def _build_eval_many(self):
+        raw = self._raw_eval_step()
+        return jax.jit(
+            lambda s, stacked, ms: jax.lax.scan(
+                lambda carry, b: (raw(s, b, carry), None), ms, stacked
+            )[0]
+        )
 
     def predict_step(self, state: TrainState, batch):
-        if self._predict_step is None:
-            self._predict_step = self._build_predict_step()
+        fn = self._ensure(
+            "_predict_step", "predict_step", self._build_predict_step)
         batch = mesh_lib.shard_batch(self.mesh, batch, self.spec.batch_partition)
         with jax.set_mesh(self.mesh):
-            return self._predict_step(state, batch)
+            return self._dispatch("predict_step", fn, state, batch)
 
     def predict_many(self, state: TrainState, stacked_batch):
         """K predict steps in ONE dispatch (`lax.map` over the stacked
         batch pytree): outputs come back stacked (K, B, ...) — the
         prediction twin of train_many/eval_many dispatch amortization."""
-        if self._predict_many is None:
-            raw = self._raw_predict_step()
-            self._predict_many = jax.jit(
-                lambda s, stacked: jax.lax.map(lambda b: raw(s, b), stacked)
-            )
+        fn = self._ensure(
+            "_predict_many", "predict_many", self._build_predict_many)
         with jax.set_mesh(self.mesh):
-            return self._predict_many(state, stacked_batch)
+            return fn(state, stacked_batch)
+
+    def _build_predict_many(self):
+        raw = self._raw_predict_step()
+        return jax.jit(
+            lambda s, stacked: jax.lax.map(lambda b: raw(s, b), stacked)
+        )
 
     def metric_results(self, metric_states) -> Dict[str, float]:
         states = {k: np.asarray(jax.device_get(v)) for k, v in metric_states.items()}

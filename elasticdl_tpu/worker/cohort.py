@@ -34,12 +34,14 @@ redoes at most the records of one partially-reported task instead of
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from elasticdl_tpu.common import membership_signal
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import ExitCode
 from elasticdl_tpu.common.log_utils import default_logger
@@ -103,6 +105,10 @@ class CohortWorker:
         self._pushed_lr = 0.0         # leader: last LR override from heartbeat
         self._ctrl_pushed_lr = 0.0    # all: latest override from the ctrl vector
         self._applied_push_lr = 0.0   # all: last override applied to state
+        # rescale fast path: first trained host batch (the speculative
+        # compiler's example input) + the background compiler itself
+        self._example_host_batch = None
+        self._spec_compiler = None
         self.worker_id = -1
 
     # ------------------------------------------------------------------ #
@@ -118,10 +124,16 @@ class CohortWorker:
         configure_jax_runtime(self.cfg)
         self._spec = ModelSpec.from_config(self.cfg)
         self._mesh = build_job_mesh(self.cfg, jax.devices())
+        from elasticdl_tpu.training import compile_cache as cc
+
+        # config-derived token: a re-formed generation at the same mesh
+        # shape (and the speculative compiler's neighbor trainers) share
+        # executables instead of re-tracing
         self._trainer = Trainer(
             self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy,
             grad_accum=self.cfg.grad_accum_steps,
             seed=self.cfg.shuffle_seed,
+            cache_token=cc.job_cache_token(self.cfg),
         )
 
     def _data_service(self, task_type: int) -> TaskDataService:
@@ -360,6 +372,83 @@ class CohortWorker:
         ]
 
     # ------------------------------------------------------------------ #
+    # rescale fast path: speculative neighbor-world compilation
+
+    def _maybe_start_speculative_compiler(self) -> None:
+        """Steady state reached (first training batches ran): start the
+        background precompiler for neighbor world sizes — N±1 plus any size
+        the master's pending-membership signal announces — so the reform,
+        when it lands, finds its executables already in the in-memory cache
+        (same process: in-place/test worlds) or the persistent on-disk
+        cache (re-formed processes). Opt-in via --speculative_compile;
+        everything here is best-effort and must never take training down.
+
+        Scale-up caveat: a larger world's devices may not be visible from
+        this process (real multi-host TPU) — those sizes are skipped, and
+        the persistent cache populated by the first post-reform process
+        is the warmth mechanism instead."""
+        if (
+            self._spec_compiler is not None
+            or not self.cfg.speculative_compile
+            or self._example_host_batch is None
+            or self._state is None
+        ):
+            return
+        import jax
+
+        from elasticdl_tpu.training import compile_cache as cc
+
+        local = max(1, len(jax.local_devices()))
+        total = len(jax.devices())
+        example = self._example_host_batch
+        k = max(1, self.cfg.steps_per_dispatch)
+        cfg, spec = self.cfg, self._spec
+
+        def compile_for_size(size: int) -> None:
+            need = size * local
+            if need < 1 or need > total:
+                raise cc.SpeculativeCompiler.SkipSize(
+                    f"world size {size} needs {need} devices, "
+                    f"{total} visible"
+                )
+            from elasticdl_tpu.parallel.mesh import build_job_mesh
+            from elasticdl_tpu.training.trainer import Trainer
+
+            mesh = build_job_mesh(cfg, jax.devices()[:need])
+            trainer = Trainer(
+                spec, mesh, remat=cfg.remat, remat_policy=cfg.remat_policy,
+                grad_accum=cfg.grad_accum_steps, seed=cfg.shuffle_seed,
+                cache_token=cc.job_cache_token(cfg),
+            )
+            # execution-free: lower+compile against abstract state/batch —
+            # never runs anything on the neighbor mesh (whose peers, in a
+            # real multi-process world, would not be there to collectivize)
+            abs_state = trainer.abstract_train_state(example)
+            trainer.aot_compile_train_step(
+                abs_state, example, speculative=True, abstract=True)
+            if k > 1:
+                from elasticdl_tpu.parallel.mesh import abstract_batch_stack
+
+                trainer.aot_compile_train_many(
+                    abs_state,
+                    abstract_batch_stack(mesh, example, k,
+                                         spec.batch_partition),
+                    speculative=True,
+                )
+
+        self._spec_compiler = cc.SpeculativeCompiler(
+            compile_for_size,
+            self.ctx.num_processes,
+            signal_path=os.environ.get(membership_signal.ENV_VAR, ""),
+            poll_s=max(1.0, self.cfg.worker_heartbeat_s / 2),
+        )
+        self._spec_compiler.start()
+        logger.info(
+            "speculative compiler started (world size %d, candidates %s)",
+            self.ctx.num_processes, self._spec_compiler.candidate_sizes(),
+        )
+
+    # ------------------------------------------------------------------ #
     # collective task execution (every process)
 
     def _process_predictions(self, outputs, host_batch) -> None:
@@ -571,6 +660,10 @@ class CohortWorker:
             # (mask exempted by _wire_cast; cohort reports count by span,
             # not mask, so accounting is unaffected either way)
             host_batch = _wire_cast(host_batch, self.cfg.wire_dtype)
+            if task_type == pb.TRAINING and self._example_host_batch is None:
+                # the speculative compiler's example input: post-cast, so
+                # neighbor-world programs lower with the real wire dtypes
+                self._example_host_batch = host_batch
             if task_type == pb.TRAINING:
                 if self._state is None:
                     self._ensure_state(make_global_batch(
@@ -718,43 +811,63 @@ class CohortWorker:
                     continue
                 if op == OP_TASK:
                     self._run_task(ctrl)
+                    # steady state (a task ran): arm the neighbor-world
+                    # precompiler so a future reform lands on a warm cache
+                    self._maybe_start_speculative_compiler()
                     continue
                 if op in (OP_DONE, OP_ABORT):
-                    if op == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT:
-                        # preemption drain: one final collective save so the
-                        # relaunched cohort resumes at the pre-kill step
-                        mngr = self._checkpoint_manager()
-                        if mngr is not None and self._state is not None:
-                            mngr.save(self._state, wait=True)
-                            self._last_ckpt_step = self._state.model_version
-                            logger.info(
-                                "preemption checkpoint saved at step %d",
-                                self._last_ckpt_step,
-                            )
                     if op == OP_DONE:
                         self._export_final_model()
                     break
-            processor = (
-                self._spec.prediction_outputs_processor if self._spec else None
-            )
-            if processor is not None:
-                # only the leader's processor ever received outputs, but
-                # close() on every process is harmless and guarantees the
-                # leader's buffered tail is flushed (base-class contract)
-                try:
-                    processor.close()
-                except Exception:
-                    logger.exception(
-                        "prediction outputs processor close failed")
-            self._shutdown.set()
-            if self.ctx.is_leader:
-                try:
-                    self._channel.close()
-                except Exception:
-                    # teardown-only; still worth a trace for post-mortems
-                    logger.debug(
-                        "grpc channel close failed at exit", exc_info=True
+
+            def finish():
+                """Post-loop teardown (runs UNDER the drain checkpoint's
+                async write when one is in flight — the overlap that keeps
+                the final save off the critical teardown path)."""
+                if self._spec_compiler is not None:
+                    self._spec_compiler.stop()
+                processor = (
+                    self._spec.prediction_outputs_processor
+                    if self._spec else None
+                )
+                if processor is not None:
+                    # only the leader's processor ever received outputs, but
+                    # close() on every process is harmless and guarantees the
+                    # leader's buffered tail is flushed (base-class contract)
+                    try:
+                        processor.close()
+                    except Exception:
+                        logger.exception(
+                            "prediction outputs processor close failed")
+                self._shutdown.set()
+                if self.ctx.is_leader:
+                    try:
+                        self._channel.close()
+                    except Exception:
+                        # teardown-only; still worth a trace for post-mortems
+                        logger.debug(
+                            "grpc channel close failed at exit", exc_info=True
+                        )
+
+            if op == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT:
+                # preemption drain: one final collective save so the
+                # relaunched cohort resumes at the pre-kill step. The write
+                # is async and overlapped with the teardown work above —
+                # save_overlapped blocks for durability before we return
+                # (and before ctx.shutdown tears the world down).
+                mngr = self._checkpoint_manager()
+                if mngr is not None and self._state is not None:
+                    mngr.save_overlapped(self._state, finish)
+                    self._last_ckpt_step = self._state.model_version
+                    logger.info(
+                        "preemption checkpoint saved at step %d "
+                        "(write overlapped with teardown)",
+                        self._last_ckpt_step,
                     )
+                else:
+                    finish()
+            else:
+                finish()
             # ABORT = the master evicted us without job completion (e.g. a
             # heartbeat lapse marked the leader dead and our tasks were
             # requeued): exit EX_TEMPFAIL so the manager relaunches the

@@ -67,6 +67,11 @@ class Worker:
         self._ckpt_requested = False  # heartbeat should_checkpoint bit
         self._last_master_ok = time.monotonic()  # last successful master RPC
         self._master_lost = False     # unreachable past the config timeout
+        # In-place rescale (rescale fast path): a pending (axis_sizes,
+        # devices) target applied at the next batch/task boundary — live
+        # state handoff + executable-cache reuse, no teardown/restore.
+        self._pending_rescale = None
+        self.last_recovery_s: Optional[float] = None
 
     # ------------------------------------------------------------------ #
     # setup
@@ -125,17 +130,27 @@ class Worker:
 
     def _build_trainer(self) -> None:
         from elasticdl_tpu.common.runtime import configure_jax_runtime
-        from elasticdl_tpu.parallel.mesh import build_job_mesh, data_axis
-        from elasticdl_tpu.training.trainer import Trainer
+        from elasticdl_tpu.parallel.mesh import build_job_mesh
         import jax
 
         configure_jax_runtime(self.cfg)
         self._spec = ModelSpec.from_config(self.cfg)
         if self._mesh is None:
             self._mesh = build_job_mesh(self.cfg, jax.devices())
-        self._trainer = Trainer(
-            self._spec, self._mesh, remat=self.cfg.remat, remat_policy=self.cfg.remat_policy,
-            grad_accum=self.cfg.grad_accum_steps, seed=self.cfg.shuffle_seed
+        self._trainer = self._make_trainer(self._mesh)
+
+    def _make_trainer(self, mesh):
+        """One Trainer construction path for boot AND in-place rescale: the
+        config-derived cache token is what lets the post-rescale trainer
+        find the speculatively-compiled executables (compile_cache.py)."""
+        from elasticdl_tpu.training import compile_cache as cc
+        from elasticdl_tpu.training.trainer import Trainer
+
+        return Trainer(
+            self._spec, mesh, remat=self.cfg.remat,
+            remat_policy=self.cfg.remat_policy,
+            grad_accum=self.cfg.grad_accum_steps, seed=self.cfg.shuffle_seed,
+            cache_token=cc.job_cache_token(self.cfg),
         )
 
     def _data_service(self, task_type: int) -> TaskDataService:
@@ -169,12 +184,17 @@ class Worker:
     def _prefetched(self, batches):
         """Overlap host->device transfer with compute (data/prefetch.py).
         Batches arrive pre-sharded, so the train step's shard_batch is a
-        no-op for them."""
+        no-op for them. Depth/cast come from the config, overridable via
+        EDL_PREFETCH_DEPTH / EDL_PREFETCH_CAST (env wins — operators tune
+        the lookahead without touching the job's immutable argv)."""
         from elasticdl_tpu.data.prefetch import prefetch_to_device
 
+        depth = (None if "EDL_PREFETCH_DEPTH" in os.environ
+                 else self.cfg.prefetch_batches)
+        cast = (None if "EDL_PREFETCH_CAST" in os.environ
+                else self.cfg.wire_dtype)
         return prefetch_to_device(
-            self._mesh, batches, self.cfg.prefetch_batches,
-            cast=self.cfg.wire_dtype,
+            self._mesh, batches, depth, cast=cast,
             partition=self._spec.batch_partition if self._spec else None,
         )
 
@@ -341,6 +361,60 @@ class Worker:
             )
 
     # ------------------------------------------------------------------ #
+    # in-place rescale (single-process worlds)
+
+    def request_rescale(self, axis_sizes=None, devices=None) -> None:
+        """Ask for an in-place mesh rescale, applied at the next batch/task
+        boundary by the run/task loops. Single-process worlds only (the
+        plain worker owns all its devices): the multi-process cohort
+        re-forms through the instance manager instead — its fast path is
+        the persistent compile cache + speculative neighbor compilation.
+        Thread-safe in the signal-handler sense: just stores the target."""
+        self._pending_rescale = (axis_sizes, devices)
+
+    def _rescale_in_place(self, reset_services: bool = True) -> None:
+        """Apply a pending rescale without the teardown/checkpoint-restore
+        round trip: build the new mesh, hand the live state over
+        (parallel/elastic.reshard_state moves only shards whose owner set
+        changes), and swap in a Trainer that — sharing the executable
+        cache and the config-derived token — reuses any speculatively
+        compiled programs instead of re-tracing.
+
+        `reset_services=False` for MID-TASK rescales: the in-flight task's
+        source generator belongs to the live data service, and its batch
+        shape must stay static anyway; task-boundary rescales rebuild the
+        services so batch_multiple re-derives from the new data axis."""
+        from elasticdl_tpu.parallel import elastic
+        from elasticdl_tpu.parallel.mesh import build_mesh
+
+        target, self._pending_rescale = self._pending_rescale, None
+        if target is None:
+            return
+        axis_sizes, devices = target
+        t0 = time.perf_counter()
+        # build everything fallible FIRST, swap worker state LAST: a failed
+        # construction must leave the old mesh/trainer/state fully intact
+        new_mesh = build_mesh(axis_sizes, devices)
+        new_trainer = self._make_trainer(new_mesh)
+        new_state = self._state
+        if new_state is not None:
+            handoff = elastic.LiveStateHandoff().capture(new_state)
+            new_state = handoff.apply(new_mesh)
+        self._state = new_state
+        self._mesh = new_mesh
+        self._trainer = new_trainer
+        if reset_services:
+            for svc in self._services.values():
+                svc.close()
+            self._services.clear()
+        self.last_recovery_s = time.perf_counter() - t0
+        logger.info(
+            "in-place rescale to %s in %.3fs (compile cache: %s)",
+            dict(zip(new_mesh.axis_names, new_mesh.devices.shape)),
+            self.last_recovery_s, self._trainer.compile_stats(),
+        )
+
+    # ------------------------------------------------------------------ #
     # task execution
 
     def _maybe_profile(self) -> None:
@@ -394,7 +468,33 @@ class Worker:
         step_time_sum = 0.0
         interrupted = False
         self._mid_training_task = True
-        for batch in self._prefetched(svc.batches(task.shard_name, task.start, task.end)):
+        prefetcher = self._prefetched(
+            svc.batches(task.shard_name, task.start, task.end))
+        while True:
+            if self._pending_rescale is not None and not self._shutdown.is_set():
+                # mid-task in-place rescale: the lookahead window holds
+                # device batches with the OLD mesh's shardings — drain it
+                # (pending HOST batches come back), rescale, and requeue
+                # the drained batches through a prefetcher on the new mesh
+                # so the task's record span stays exactly-once. A failed
+                # rescale (bad advisory target) must cost a log line, not
+                # the task: the drained batches are requeued either way,
+                # on whatever mesh the worker ends up holding.
+                import itertools
+
+                leftover = prefetcher.drain()
+                source = prefetcher.source
+                try:
+                    self._rescale_in_place(reset_services=False)
+                except Exception:
+                    logger.exception(
+                        "mid-task in-place rescale failed; mesh kept")
+                prefetcher = self._prefetched(
+                    itertools.chain(iter(leftover), source))
+            try:
+                batch = next(prefetcher)
+            except StopIteration:
+                break
             if self._shutdown.is_set():
                 # preemption mid-task: stop before the next batch; the drain
                 # report below hands the unprocessed remainder back
@@ -713,6 +813,14 @@ class Worker:
                     self._maybe_checkpoint(force=True)
                 except Exception:
                     logger.exception("master-requested checkpoint failed")
+            if self._pending_rescale is not None:
+                # planned in-place rescale at a clean task boundary: live
+                # handoff + executable-cache reuse, no teardown (the
+                # pending target is consumed either way — no retry loop)
+                try:
+                    self._rescale_in_place()
+                except Exception:
+                    logger.exception("in-place rescale failed; mesh kept")
             if task.type == pb.WAIT:
                 time.sleep(resp.backoff_seconds or 1.0)
                 continue

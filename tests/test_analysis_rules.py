@@ -278,6 +278,56 @@ def test_set_iteration_fires_and_sorted_is_quiet():
 
 
 # ------------------------------------------------------------------ #
+# EDL205 unkeyed-jit-in-rescale-path
+
+
+def test_unkeyed_jit_in_rescale_path_fires():
+    bad = """
+        import jax
+
+        def rescale_in_place(self, f, state):
+            step = jax.jit(f)              # BAD: recovery recompiles
+            return step(state)
+
+        def _reform_cohort(f, state):
+            return jax.jit(f)(state)       # BAD (also EDL202's immediate)
+    """
+    fs = findings_for(bad, select={"EDL205"})
+    assert len(fs) == 2
+    assert all(f.rule == "EDL205" for f in fs)
+    assert "rescale_in_place" in fs[0].message
+
+
+def test_cache_keyed_jit_in_rescale_path_is_quiet():
+    good = """
+        import jax
+
+        def rescale_in_place(cache, key, f, state):
+            step = cache.get_or_build(key, lambda: jax.jit(f))
+            return step(state)
+
+        def handoff_apply(cache, key, exe):
+            return cache.store_aot(key, exe)
+
+        def steady_loop(f):
+            return jax.jit(f)              # not a rescale path: out of scope
+    """
+    assert findings_for(good, select={"EDL205"}) == []
+
+
+def test_rescale_rule_covers_nested_functions():
+    bad = """
+        import jax
+
+        def on_resize(f):
+            def inner(state):
+                return jax.jit(f)(state)   # BAD: still the rescale path
+            return inner
+    """
+    assert len(findings_for(bad, select={"EDL205"})) == 1
+
+
+# ------------------------------------------------------------------ #
 # EDL301 / EDL302 bare stub + deadlines
 
 
@@ -573,7 +623,7 @@ def test_cli_json_output_and_exit_code(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204",
+    for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
                 "EDL301", "EDL302", "EDL303", "EDL304"):
         assert rid in out
 

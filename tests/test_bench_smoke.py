@@ -79,6 +79,21 @@ def test_time_to_auc_leg_smoke(bench, mesh8, monkeypatch):
     assert res["seconds_to_auc"] >= 0.0
 
 
+def test_rescale_leg_reports_recovery_and_exactness(bench, mesh8, monkeypatch):
+    """The rescale fast-path scenario (ISSUE 3 acceptance): runs in the
+    tier-1 budget, reports time_to_recovery_s + recompile_hit_rate, warm
+    recovery beats the cold-recompile path >= 2x in the SAME run, and the
+    live handoff is bit-exact vs checkpoint-restore."""
+    monkeypatch.setattr(bench, "BATCH", 64)
+    res = bench._run_leg("rescale", mesh8, np)
+    assert res["handoff_params_exact"] is True, res
+    assert res["recompile_hit_rate"] == 1.0, res
+    assert res["time_to_recovery_s"] > 0
+    assert res["cold_recovery_s"] > 0
+    assert res["recovery_speedup"] >= 2.0, res
+    assert res["speculative_sizes"], res
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)

@@ -62,6 +62,56 @@ def test_depth_zero_passthrough(mesh8):
     assert isinstance(out[0]["features"], jax.Array)
 
 
+def test_drain_returns_pending_host_batches(mesh8):
+    """Reform hook: drain() hands back the lookahead window's HOST batches
+    (device copies die with the old mesh) and ends iteration; the
+    un-consumed source survives for requeueing."""
+    from elasticdl_tpu.data.prefetch import DevicePrefetcher
+
+    pf = DevicePrefetcher(mesh8, host_batches(6), depth=3)
+    first = next(pf)                       # fills the window to 3
+    np.testing.assert_array_equal(np.asarray(first["features"]), 0)
+    pending = pf.drain()
+    assert [int(b["features"][0, 0]) for b in pending] == [1, 2]
+    assert all(isinstance(b["features"], np.ndarray) for b in pending)
+    with pytest.raises(StopIteration):
+        next(pf)
+    # batches never pulled into the window remain on the source
+    rest = [int(b["features"][0, 0]) for b in pf.source]
+    assert rest == [3, 4, 5]
+
+
+def test_drain_then_requeue_covers_every_batch(mesh8):
+    """The worker's rescale flow: drained + remaining batches re-enter a
+    new prefetcher — every batch is delivered exactly once."""
+    import itertools
+
+    from elasticdl_tpu.data.prefetch import DevicePrefetcher
+
+    pf = DevicePrefetcher(mesh8, host_batches(8), depth=2)
+    seen = [int(np.asarray(next(pf)["features"])[0, 0]) for _ in range(2)]
+    leftover, source = pf.drain(), pf.source
+    pf2 = DevicePrefetcher(mesh8, itertools.chain(iter(leftover), source),
+                           depth=2)
+    seen += [int(np.asarray(b["features"])[0, 0]) for b in pf2]
+    assert seen == list(range(8))
+
+
+def test_depth_and_cast_resolve_from_env(mesh8, monkeypatch):
+    from elasticdl_tpu.data import prefetch
+
+    monkeypatch.setenv("EDL_PREFETCH_DEPTH", "5")
+    monkeypatch.setenv("EDL_PREFETCH_CAST", "bfloat16")
+    pf = prefetch.prefetch_to_device(mesh8, host_batches(1))
+    assert pf.depth == 5 and pf.cast == "bfloat16"
+    # explicit arguments win over the environment
+    pf2 = prefetch.prefetch_to_device(mesh8, host_batches(1), 1, cast="")
+    assert pf2.depth == 1 and pf2.cast == ""
+    # garbage depth falls back to the default
+    monkeypatch.setenv("EDL_PREFETCH_DEPTH", "nope")
+    assert prefetch.resolve_depth(None) == prefetch.DEFAULT_DEPTH
+
+
 def test_wire_cast_bfloat16(mesh8):
     import jax.numpy as jnp
 
