@@ -526,6 +526,70 @@ def bench_time_to_auc(mesh, np, target=0.75):
     }
 
 
+def _scrape_rescale_metrics(trace_records):
+    """Stand up the real /metrics endpoint, scrape it over HTTP, and pull
+    out the headline series (compile-cache hit rate, stub retries,
+    prefetcher drains). With EDL_BENCH_ARTIFACT_DIR set, the scraped text
+    and the resize's trace.jsonl are written there for CI upload."""
+    import json as _json
+    import urllib.request
+
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    # make sure the wire/prefetch metric families exist in this process's
+    # registry even though this simulated resize had no live RPCs to count
+    import elasticdl_tpu.data.prefetch  # noqa: F401
+    import elasticdl_tpu.proto.service  # noqa: F401
+
+    out = {"scraped": False}
+    server = ObservabilityServer(role="bench")
+    try:
+        port = server.start()
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=10
+        ).read().decode()
+        health = _json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ).read().decode())
+        out["scraped"] = True
+        out["healthz"] = health.get("status")
+        out["series"] = sum(
+            1 for ln in text.splitlines()
+            if ln and not ln.startswith("#")
+        )
+        for key in (
+            "edl_compile_cache_hit_rate",
+            "edl_compile_cache_hits",
+            "edl_compile_cache_speculative_compiles",
+            "edl_rpc_client_retries_total",
+            "edl_prefetch_drains_total",
+            "edl_ckpt_handoffs_total",
+        ):
+            for ln in text.splitlines():
+                if ln.startswith(key + " ") or ln.startswith(key + "{"):
+                    try:
+                        out[key] = float(ln.rsplit(" ", 1)[1])
+                    except ValueError:
+                        pass
+                    break
+        art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+        if art_dir:
+            os.makedirs(art_dir, exist_ok=True)
+            with open(os.path.join(art_dir, "bench-rescale-trace.jsonl"),
+                      "w") as f:
+                for rec in trace_records:
+                    f.write(_json.dumps(rec) + "\n")
+            with open(os.path.join(art_dir, "bench-rescale-metrics.prom"),
+                      "w") as f:
+                f.write(text)
+            out["artifacts"] = art_dir
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        server.stop()
+    return out
+
+
 def bench_rescale(mesh, np):
     """Rescale fast path (ISSUE 3): a simulated cohort resize on the local
     mesh (all devices -> half), measuring recovery BOTH ways in the same
@@ -543,13 +607,23 @@ def bench_rescale(mesh, np):
     done), the cold twin, `recompile_hit_rate` (warm-phase executable-cache
     hit rate), and a bit-exactness check of handoff params against the
     checkpoint-restore path. `mesh` is ignored (the scenario builds its own
-    sub-meshes) but keeps the leg signature uniform."""
+    sub-meshes) but keeps the leg signature uniform.
+
+    Observability (ISSUE 4): the whole resize runs under ONE trace id —
+    announced through the signal file exactly as the master announces a
+    real resize — and the warm recovery is split into `phase.settle`
+    (mesh + trainer construction on the new world), `phase.handoff`
+    (state movement), and `phase.compile` (first-step dispatch against
+    the warm cache). `phases` in the output comes from those spans, the
+    scrape block from a live /metrics endpoint; set
+    EDL_BENCH_ARTIFACT_DIR to also write trace.jsonl + metrics.prom."""
     import tempfile
 
     import jax
 
     from elasticdl_tpu.common import membership_signal
     from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.observability import tracing
     from elasticdl_tpu.parallel import elastic
     from elasticdl_tpu.parallel.mesh import build_mesh
     from elasticdl_tpu.training import compile_cache as cc
@@ -582,7 +656,14 @@ def bench_rescale(mesh, np):
         "labels": r.randint(0, 2, (batch_size,)).astype(np.int32),
     }
     token = "bench-rescale"
-    cache = cc.CompileCache()
+    # the PROCESS-GLOBAL cache (cleared for a clean measurement): its
+    # counters are what /metrics exports as edl_compile_cache_*, so the
+    # scrape below reports the real warm-phase hit rate
+    cache = cc.global_cache()
+    cache.clear()
+
+    tracing.configure(role="bench", world_version=0)
+    trace_id = tracing.new_trace_id()
 
     def make_trainer(size, use_cache):
         sub = build_mesh({"data": size}, devices[:size])
@@ -613,7 +694,9 @@ def bench_rescale(mesh, np):
         # ---- speculative compile, driven by the master's announcement ----
         signal_path = os.path.join(tmp, "membership_signal.json")
         membership_signal.write_signal(
-            signal_path, world_size=n_dev, pending_size=new_n)
+            signal_path, world_size=n_dev, pending_size=new_n,
+            trace_id=trace_id)
+        out["trace_id"] = trace_id
 
         def compile_for_size(size):
             if size < 1 or size > n_dev or batch_size % size:
@@ -629,7 +712,10 @@ def bench_rescale(mesh, np):
         t0 = time.perf_counter()
         speculator = cc.SpeculativeCompiler(
             compile_for_size, n_dev, max_size=n_dev, signal_path=signal_path)
-        compiled = speculator.precompile_once()
+        # the speculative pass joins the resize trace (the real worker path
+        # reads the trace id from the signal file the same way)
+        with tracing.adopt(trace_id):
+            compiled = speculator.precompile_once()
         out["speculative_compile_s"] = round(time.perf_counter() - t0, 3)
         out["speculative_sizes"] = compiled
 
@@ -637,16 +723,33 @@ def bench_rescale(mesh, np):
         handoff = elastic.LiveStateHandoff().capture(state)
         cache.reset_stats()  # hit rate below covers the recovery alone
         t0 = time.perf_counter()
-        trainer_warm, new_mesh = make_trainer(new_n, cache)
-        warm_state = mngr.restore_or_handoff(
-            trainer_warm.abstract_train_state(batch0), handoff, new_mesh)
-        warm_params = jax.device_get(warm_state.params)  # exactness probe
-        warm_state, logs = trainer_warm.train_step(warm_state, batch0)
-        float(logs["loss"])
+        tracing.set_world_version(1)  # the resize opens world generation 1
+        with tracing.span("rescale", trace_id=trace_id,
+                          old_devices=n_dev, new_devices=new_n):
+            with tracing.span("phase.settle"):
+                # membership settling: the new world's mesh + trainer
+                trainer_warm, new_mesh = make_trainer(new_n, cache)
+            with tracing.span("phase.handoff"):
+                warm_state = mngr.restore_or_handoff(
+                    trainer_warm.abstract_train_state(batch0), handoff,
+                    new_mesh)
+                # exactness probe (also forces the handoff's data movement)
+                warm_params = jax.device_get(warm_state.params)
+            with tracing.span("phase.compile"):
+                # cache hit -> dispatch only; miss -> the full re-trace
+                warm_state, logs = trainer_warm.train_step(warm_state, batch0)
+                float(logs["loss"])
         out["time_to_recovery_s"] = round(time.perf_counter() - t0, 3)
         stats = cache.stats()
         out["recompile_hit_rate"] = round(stats["hit_rate"], 3)
         out["compile_cache"] = {k: round(v, 3) for k, v in stats.items()}
+        # per-phase breakdown SOURCED FROM THE SPANS (not re-timed): the
+        # same records land in trace.jsonl for the artifact upload
+        records = list(tracing.get_tracer().records)
+        out["phases"] = tracing.phase_durations(records, trace_id)
+
+        # ---- scrape the live /metrics surface (Prometheus text) ----
+        out["metrics"] = _scrape_rescale_metrics(records)
         mngr.close()
 
     # live handoff must be bit-exact vs the checkpoint-restore path (the

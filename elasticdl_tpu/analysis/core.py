@@ -192,7 +192,12 @@ def register(rule_cls: type) -> type:
 def all_rules() -> List[Rule]:
     """Every registered rule (importing the rule modules registers them)."""
     # imported lazily so `core` has no import cycle with the rule modules
-    from elasticdl_tpu.analysis import jax_rules, locks, rpc_rules  # noqa: F401
+    from elasticdl_tpu.analysis import (  # noqa: F401
+        jax_rules,
+        locks,
+        observability_rules,
+        rpc_rules,
+    )
 
     return list(_RULES)
 

@@ -1,0 +1,83 @@
+"""EDL4xx: observability hygiene.
+
+EDL401 metric-name-pattern
+    A metric registered through the registry factories (`counter`,
+    `gauge`, `histogram`) with a literal name that does not match the
+    project naming pattern `edl_<subsystem>_<name>` (lowercase,
+    underscore-separated — observability/registry._NAME_RE). The runtime
+    registry rejects bad names too; this rule catches them at lint time,
+    before the first scrape, and covers names the runtime path may not
+    reach in tests (conditionally-registered metrics).
+
+    Only literal string names are checkable statically; dynamic names are
+    the runtime validator's job.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+#: kept textually in sync with observability/registry._NAME_RE (a test
+#: pins the two together)
+METRIC_NAME_RE = re.compile(r"^edl_[a-z][a-z0-9]*_[a-z0-9_]*[a-z0-9]$")
+
+_FACTORIES = {"counter", "gauge", "histogram"}
+
+
+def _metric_name_arg(node: ast.Call) -> "ast.Constant | None":
+    """The literal name argument of a registry-factory call, if any."""
+    if node.args and isinstance(node.args[0], ast.Constant) \
+            and isinstance(node.args[0].value, str):
+        return node.args[0]
+    for kw in node.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value
+    return None
+
+
+@register
+class MetricNamePatternRule(Rule):
+    id = "EDL401"
+    name = "metric-name-pattern"
+    doc = (
+        "metric name outside the registry naming pattern "
+        "edl_<subsystem>_<name> — keep the scrape surface grep-able"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            attr = (
+                func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else ""
+            )
+            if attr not in _FACTORIES:
+                continue
+            name_node = _metric_name_arg(node)
+            if name_node is None:
+                continue
+            value = name_node.value
+            # only metric-shaped literals are in scope: a bare
+            # `counter("x")` from an unrelated library (collections-style
+            # counters take iterables, not names) would otherwise flag —
+            # the registry convention is that every metric name starts
+            # with edl_, so anything else passed to these factories is
+            # either a naming violation (starts wrong) or not a metric at
+            # all; the distinguishing signal is an identifier-looking
+            # string
+            if not re.match(r"^[A-Za-z_][A-Za-z0-9_]*$", value):
+                continue
+            if not METRIC_NAME_RE.match(value):
+                yield self.finding(
+                    ctx, name_node,
+                    f"metric name {value!r} does not match "
+                    "edl_<subsystem>_<name> (EDL401; see "
+                    "docs/observability.md)",
+                )

@@ -126,6 +126,15 @@ class JobConfig:
     profile_start_step: int = 5    # skip compile + warmup steps
     profile_steps: int = 20        # trace this many steps, then stop
 
+    # --- observability (metrics registry + trace spans; observability/) ---
+    # /metrics + /healthz HTTP endpoint per process: 0 = ephemeral port
+    # (default), -1 disables; the EDL_METRICS_PORT env overrides either.
+    metrics_port: int = 0
+    # control-plane trace spans (trace.jsonl, one file per role under
+    # <trace_dir>/<role>/): "" derives <summary_dir>/trace when summary_dir
+    # is set (spans stay in-memory otherwise); "off" disables the file sink.
+    trace_dir: str = ""
+
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
     # manager, or the k8s StatefulSet's own self-healing); "k8s" = the MASTER

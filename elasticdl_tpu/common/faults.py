@@ -34,6 +34,13 @@ Sites threaded through the stack (exact-match, or a `prefix.*` wildcard):
                         (master/process_manager.py); `drop` spawns a process
                         that exits 1 immediately instead of suppressing the
                         spawn (exercising the relaunch path)
+    metrics_scrape      each /metrics//healthz HTTP request
+                        (observability/http.py). Scraping is strictly
+                        best-effort, so the terminal actions are remapped
+                        at the site: `drop` aborts the connection with no
+                        response; `crash` kills the ENDPOINT (the HTTP
+                        server shuts down — NOT the process; training must
+                        never die, or even stall, because a scraper did)
 
 Actions:
 

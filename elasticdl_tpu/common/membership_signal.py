@@ -34,14 +34,21 @@ def write_signal(
     world_size: int,
     pending_size: Optional[int] = None,
     world_version: int = 0,
+    trace_id: Optional[str] = None,
 ) -> bool:
     """Atomically (re)write the membership signal. Best-effort: a failed
     write is logged and must never take the caller (the master's watch
-    loop) down with it."""
+    loop) down with it.
+
+    `trace_id` stitches the resize's observability timeline across roles:
+    the master stamps the reform trace id here, workers adopt it for their
+    rescale/boot spans (observability/tracing.py) — one resize, one trace
+    id in both `trace.jsonl` files."""
     payload = {
         "world_size": int(world_size),
         "pending_size": None if pending_size is None else int(pending_size),
         "world_version": int(world_version),
+        "trace_id": trace_id or None,
     }
     try:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -67,6 +74,16 @@ def read_signal(path: Optional[str] = None) -> Optional[dict]:
     except (OSError, ValueError):
         return None
     return data if isinstance(data, dict) else None
+
+
+def trace_id(path: Optional[str] = None) -> Optional[str]:
+    """The announced resize's trace id, or None (no announcement / an
+    announcement written before tracing existed)."""
+    data = read_signal(path)
+    if not data:
+        return None
+    tid = data.get("trace_id")
+    return str(tid) if tid else None
 
 
 def pending_size(path: Optional[str] = None) -> Optional[int]:

@@ -41,11 +41,28 @@ from typing import Any, Iterable, Iterator, List, Optional
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 logger = default_logger(__name__)
 
 DEFAULT_DEPTH = 2
+
+# prefetch telemetry: batch flow + drain accounting (a drain is the
+# rescale-path event — its batch count is how much lookahead a resize
+# had to requeue). The depth gauge tracks the most recent prefetcher's
+# configured lookahead (one live prefetcher per worker in practice).
+_reg = default_registry()
+_PF_BATCHES = _reg.counter(
+    "edl_prefetch_batches_total", "device batches served to the step loop")
+_PF_DRAINS = _reg.counter(
+    "edl_prefetch_drains_total", "drain() calls (reform/rescale requeues)")
+_PF_DRAINED_BATCHES = _reg.counter(
+    "edl_prefetch_drained_batches_total",
+    "pending host batches handed back by drains")
+_PF_DEPTH = _reg.gauge(
+    "edl_prefetch_depth", "configured lookahead of the latest prefetcher")
 
 
 def resolve_depth(depth: Optional[int]) -> int:
@@ -117,6 +134,7 @@ class DevicePrefetcher:
         self._buf: deque = deque()   # (host_batch, device_batch)
         self._exhausted = False
         self._drained = False
+        _PF_DEPTH.set(self.depth)
 
     def _put(self, host_batch):
         return mesh_lib.shard_batch(
@@ -139,11 +157,13 @@ class DevicePrefetcher:
         if self._drained:
             raise StopIteration
         if self.depth <= 0:
+            _PF_BATCHES.inc()
             return self._put(next(self.source))
         self._fill()
         if not self._buf:
             raise StopIteration
         _, device_batch = self._buf.popleft()
+        _PF_BATCHES.inc()
         return device_batch
 
     def drain(self) -> List[Any]:
@@ -152,9 +172,13 @@ class DevicePrefetcher:
         through a new prefetcher on the new mesh, or back to the task
         service — so no record silently disappears across a re-formation.
         The un-consumed source remains available as `self.source`."""
-        pending = [host for host, _ in self._buf]
-        self._buf.clear()
-        self._drained = True
+        with tracing.span("prefetch.drain") as sp:
+            pending = [host for host, _ in self._buf]
+            self._buf.clear()
+            self._drained = True
+            sp.set(pending_batches=len(pending))
+        _PF_DRAINS.inc()
+        _PF_DRAINED_BATCHES.inc(len(pending))
         return pending
 
     def close(self) -> None:

@@ -30,6 +30,12 @@ class Master:
     def __init__(self, cfg: JobConfig, k8s_api=None):
         cfg.validate()
         self.cfg = cfg
+        # observability first: every span/log below carries the role, and
+        # trace.jsonl lands under <trace_dir|summary_dir/trace>/master/
+        from elasticdl_tpu.observability import tracing
+
+        tracing.configure_from_config(cfg, role="master")
+        self.metrics_server = None
         # cfg.instance_manager == "k8s": this master owns worker pods
         # (created in start()); k8s_api injects a fake for tests
         self._k8s_api = k8s_api
@@ -164,6 +170,13 @@ class Master:
     def start(self) -> None:
         self.server.start()
         logger.info("master serving on %s", self.cfg.master_addr)
+        # /metrics + /healthz (best-effort; never a boot failure; a set
+        # EDL_METRICS_PORT overrides cfg.metrics_port either way)
+        from elasticdl_tpu.observability.http import start_server
+
+        self.metrics_server = start_server(
+            role="master", port=self.cfg.metrics_port
+        )
         if self.cfg.instance_manager == "k8s":
             # the reference's k8s flavor: the master creates worker pods and
             # watches their events (pod death drives task recovery directly)
@@ -194,6 +207,12 @@ class Master:
         while not self.dispatcher.finished():
             self.membership.reap()
             self.dispatcher.poke()
+            if self.summary is not None:
+                # control-plane metrics ride the summary stream (rate-
+                # limited inside; never raises)
+                self.summary.maybe_snapshot_registry(
+                    self.dispatcher.completed_versions
+                )
             if deadline and time.time() > deadline:
                 return False
             if abort_fn is not None and abort_fn():
@@ -222,7 +241,21 @@ class Master:
         # only after the server stops: late reports may still hit the
         # summary writer while RPCs are in flight
         if self.summary is not None:
+            try:
+                # one final registry snapshot so the job-end metric state
+                # is in events.jsonl, then close durably
+                self.summary.snapshot_registry(
+                    self.dispatcher.completed_versions
+                )
+            except Exception:
+                logger.exception("final registry snapshot failed")
             self.summary.close()
+        if self.metrics_server is not None:
+            self.metrics_server.stop()
+            self.metrics_server = None
+        from elasticdl_tpu.observability import tracing
+
+        tracing.get_tracer().close()
 
     def run(self) -> int:
         self.start()

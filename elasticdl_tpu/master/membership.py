@@ -18,8 +18,23 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_MB_REGISTERED = _reg.counter(
+    "edl_membership_registrations_total", "worker registrations")
+_MB_DEATHS = _reg.counter(
+    "edl_membership_deaths_total", "workers declared dead (any reason)")
+_MB_REAPED = _reg.counter(
+    "edl_membership_reaped_total",
+    "workers declared dead by heartbeat-timeout reaping")
+_MB_ALIVE = _reg.gauge(
+    "edl_membership_alive_workers", "currently alive workers")
+_MB_VERSION = _reg.gauge(
+    "edl_membership_version", "current membership version")
 
 
 @dataclass
@@ -61,11 +76,19 @@ class Membership:
             info = WorkerInfo(worker_id=wid, name=name, last_heartbeat=time.time())
             self._workers[wid] = info
             self._version += 1
+            version = self._version     # the version THIS join created
+            _MB_REGISTERED.inc()
+            _MB_ALIVE.set(self._alive_count_locked())
+            _MB_VERSION.set(self._version)
             logger.info(
                 "worker %d (%s) joined; membership v%d, %d alive",
                 wid, name, self._version, self._alive_count_locked(),
             )
-            return info
+        tracing.event(
+            "membership.join", worker_id=info.worker_id, worker_name=name,
+            version=version,
+        )
+        return info
 
     def heartbeat(self, worker_id: int, model_version: int = 0) -> bool:
         with self._lock:
@@ -83,11 +106,19 @@ class Membership:
                 return False
             info.alive = False
             self._version += 1
+            version = self._version     # the version THIS death created
+            _MB_DEATHS.inc()
+            _MB_ALIVE.set(self._alive_count_locked())
+            _MB_VERSION.set(self._version)
             logger.warning(
                 "worker %d declared dead (%s); membership v%d, %d alive",
                 worker_id, reason or "unknown", self._version,
                 self._alive_count_locked(),
             )
+        tracing.event(
+            "membership.death", worker_id=worker_id, reason=reason or "",
+            version=version,
+        )
         for cb in self._death_callbacks:
             cb(worker_id)
         return True
@@ -102,7 +133,8 @@ class Membership:
                 if info.alive and now - info.last_heartbeat > self._timeout
             ]
         for wid in lapsed:
-            self.mark_dead(wid, reason="heartbeat timeout")
+            if self.mark_dead(wid, reason="heartbeat timeout"):
+                _MB_REAPED.inc()
         return lapsed
 
     def _alive_count_locked(self) -> int:

@@ -25,8 +25,20 @@ from elasticdl_tpu.common.net import free_port
 from elasticdl_tpu.common.constants import ExitCode, PodStatus, WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_REFORMS = _reg.counter(
+    "edl_reform_total", "cohort re-formations", labels=("kind",))
+_REFORM_S = _reg.histogram(
+    "edl_reform_seconds", "respawn wall time of a re-formation")
+_SPAWNS = _reg.counter(
+    "edl_reform_worker_spawns_total", "worker processes spawned")
+_COHORT_SIZE = _reg.gauge(
+    "edl_reform_cohort_size", "current cohort process count")
 
 
 def _reject_plain_training_scale_out(cfg: JobConfig) -> None:
@@ -112,6 +124,10 @@ class ProcessManager:
                 os.path.join(base, "membership_signal.json") if base else ""
             )
         self._signal_path = membership_signal_path
+        # one trace id per announced/active resize: stamped into the signal
+        # file (workers adopt it) and onto every reform.* span this manager
+        # opens, so master + workers share a timeline per resize
+        self._reform_trace_id: Optional[str] = None   # guarded_by: _lock
 
     @property
     def _cohort_mode(self) -> bool:
@@ -138,6 +154,7 @@ class ProcessManager:
             world_size=self._cohort_size,
             pending_size=self._pending_resize,
             world_version=self._world_version,
+            trace_id=self._reform_trace_id,
         )
 
 
@@ -191,6 +208,7 @@ class ProcessManager:
             stderr=stderr,
         )
         wp = _WorkerProc(worker_id=worker_id, proc=proc, relaunches=relaunches)
+        _SPAWNS.inc()
         logger.info("spawned worker %d (pid %d)", worker_id, proc.pid)
         return wp
 
@@ -238,9 +256,16 @@ class ProcessManager:
             with self._lock:
                 target = (self._pending_resize or self._cohort_size) + 1
                 self._pending_resize = target
+                if self._reform_trace_id is None:
+                    self._reform_trace_id = tracing.new_trace_id()
+                tid = self._reform_trace_id
                 self._announce_locked()
                 logger.info("cohort scale-out requested: -> %d processes", target)
-                return target
+            tracing.event(
+                "reform.announce", trace_id=tid, pending_size=target,
+                direction="up",
+            )
+            return target
         _reject_plain_training_scale_out(self.cfg)
         with self._lock:
             wid = self._next_worker_id
@@ -256,9 +281,16 @@ class ProcessManager:
         with self._lock:
             target = max(1, (self._pending_resize or self._cohort_size) - 1)
             self._pending_resize = target
+            if self._reform_trace_id is None:
+                self._reform_trace_id = tracing.new_trace_id()
+            tid = self._reform_trace_id
             self._announce_locked()
             logger.info("cohort scale-in requested: -> %d processes", target)
-            return target
+        tracing.event(
+            "reform.announce", trace_id=tid, pending_size=target,
+            direction="down",
+        )
+        return target
 
     def kill_worker(
         self, worker_id: int, relaunch: bool = True, graceful: bool = False
@@ -349,26 +381,42 @@ class ProcessManager:
         restores from the latest checkpoint and keeps the global batch and
         LR unchanged (strong scaling — only per-device slice sizes move)."""
         t0 = time.time()
-        with self._lock:
-            if self._stop.is_set():
-                # stop() raced us between teardown and re-form: spawning a
-                # fresh generation now would outlive stop()'s kill loop (it
-                # only waits grace_s for the watcher) and leak workers that
-                # run forever — observed as orphan processes hours after a
-                # test's manager.stop()
-                logger.info("re-formation skipped: manager stopping")
-                return
-            self._procs.clear()
-            self._world_version += 1
-            world_version = self._world_version
-            if new_size != old_size:
-                # a deliberate resize opens a fresh in-place relaunch budget
-                self._cohort_relaunches = 0
-            self._spawn_cohort_locked(new_size)
-            self.reformation_log.append((t0, old_size, new_size))
-            # the resize landed: the announcement now carries the NEW world
-            # (pending cleared unless another resize is already queued)
-            self._announce_locked()
+        # the span wraps the lock (not the reverse) so its exit — a
+        # trace.jsonl write — never runs under the control-plane lock
+        with tracing.span(
+            "reform.spawn", new_size=new_size, old_size=old_size,
+        ) as spawn_span:
+            with self._lock:
+                if self._stop.is_set():
+                    # stop() raced us between teardown and re-form: spawning
+                    # a fresh generation now would outlive stop()'s kill loop
+                    # (it only waits grace_s for the watcher) and leak
+                    # workers that run forever — observed as orphan processes
+                    # hours after a test's manager.stop()
+                    spawn_span.set(outcome="skipped_manager_stopping")
+                    logger.info("re-formation skipped: manager stopping")
+                    return
+                self._procs.clear()
+                self._world_version += 1
+                world_version = self._world_version
+                if new_size != old_size:
+                    # a deliberate resize opens a fresh in-place relaunch
+                    # budget
+                    self._cohort_relaunches = 0
+                self._spawn_cohort_locked(new_size)
+                self.reformation_log.append((t0, old_size, new_size))
+                if self._pending_resize is None:
+                    # this resize's timeline ends when its world is up; a
+                    # QUEUED next resize keeps its own announced trace id
+                    self._reform_trace_id = None
+                # the resize landed: the announcement now carries the NEW
+                # world (pending cleared unless another resize is already
+                # queued)
+                self._announce_locked()
+                _COHORT_SIZE.set(self._cohort_size)
+        tracing.set_world_version(world_version)
+        _REFORMS.inc(kind="resize" if new_size != old_size else "relaunch")
+        _REFORM_S.observe(time.time() - t0)
         if new_size != old_size:
             logger.warning(
                 "cohort RESIZED %d -> %d processes (world v%d): %s",
@@ -533,17 +581,28 @@ class ProcessManager:
                         # a formed-then-failed world proves the coordinator
                         # path works: fresh infra budget for the next incident
                         self._infra_retries = 0
-                self._teardown_cohort(
-                    items, reason=f"cohort member(s) {failed} died"
-                )
-                if target < 1:
-                    logger.error(
-                        "cohort cannot continue: no survivors to re-form"
-                    )
-                    for wp in members.values():
-                        wp.status = PodStatus.FAILED
-                    return
-                self._reform_cohort(target, size, reason)
+                with self._lock:
+                    if self._reform_trace_id is None:
+                        # crash-path reform: no announcement preceded it, so
+                        # the timeline starts here
+                        self._reform_trace_id = tracing.new_trace_id()
+                    reform_tid = self._reform_trace_id
+                with tracing.span(
+                    "reform", trace_id=reform_tid, reason=reason,
+                    old_size=size, new_size=target,
+                ):
+                    with tracing.span("reform.teardown"):
+                        self._teardown_cohort(
+                            items, reason=f"cohort member(s) {failed} died"
+                        )
+                    if target < 1:
+                        logger.error(
+                            "cohort cannot continue: no survivors to re-form"
+                        )
+                        for wp in members.values():
+                            wp.status = PodStatus.FAILED
+                        return
+                    self._reform_cohort(target, size, reason)
             elif (
                 pending is not None
                 and pending != size_now   # snapshot: _cohort_size is locked
@@ -553,22 +612,39 @@ class ProcessManager:
                 # a checkpoint and wait for it, so only sub-task progress is
                 # redone at the new size (a crash path can't do this; a
                 # deliberate one shouldn't skip it)
-                self._await_resize_checkpoint()
-                if self._job_finished_fn():
-                    # the job ran out from under the resize: nothing to do
+                with self._lock:
+                    reform_tid = (
+                        self._reform_trace_id or tracing.new_trace_id()
+                    )
+                    self._reform_trace_id = reform_tid
+                with tracing.span(
+                    "reform", trace_id=reform_tid,
+                    reason="operator resize request", new_size=pending,
+                    old_size=size_now,
+                ):
+                    with tracing.span("reform.quiesce"):
+                        self._await_resize_checkpoint()
+                    if self._job_finished_fn():
+                        # the job ran out from under the resize: nothing to
+                        # do — and this resize's trace id dies with it (a
+                        # later reform is a DIFFERENT incident and must
+                        # open its own timeline)
+                        with self._lock:
+                            if self._pending_resize == pending:
+                                self._pending_resize = None
+                            self._reform_trace_id = None
+                            self._announce_locked()
+                        continue
                     with self._lock:
                         if self._pending_resize == pending:
                             self._pending_resize = None
-                    continue
-                with self._lock:
-                    if self._pending_resize == pending:
-                        self._pending_resize = None
-                    old = self._cohort_size
-                    self._cohort_size = pending
-                self._teardown_cohort(
-                    items, reason=f"cohort resize to {pending}"
-                )
-                self._reform_cohort(pending, old, "operator resize request")
+                        old = self._cohort_size
+                        self._cohort_size = pending
+                    with tracing.span("reform.teardown"):
+                        self._teardown_cohort(
+                            items, reason=f"cohort resize to {pending}"
+                        )
+                    self._reform_cohort(pending, old, "operator resize request")
             elif all(c is not None for c in codes.values()) and codes:
                 with self._lock:
                     for wp in self._procs.values():

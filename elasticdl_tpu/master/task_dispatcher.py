@@ -20,9 +20,32 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = default_logger(__name__)
+
+# lease-lifecycle telemetry. Counters are process-global (one dispatcher
+# per master in production; concurrent test dispatchers share the series).
+_reg = default_registry()
+_TASKS_LEASED = _reg.counter(
+    "edl_dispatcher_tasks_leased_total", "task leases handed to workers")
+_TASKS_FINISHED = _reg.counter(
+    "edl_dispatcher_tasks_finished_total", "training tasks retired")
+_TASKS_REQUEUED = _reg.counter(
+    "edl_dispatcher_tasks_requeued_total",
+    "tasks requeued (failure retry, death recovery, preemption remainder)")
+_TASKS_FAILED = _reg.counter(
+    "edl_dispatcher_tasks_failed_total", "tasks failed permanently")
+_LEASES_EXPIRED = _reg.counter(
+    "edl_dispatcher_lease_expired_total", "leases reaped by timeout")
+_STALE_REPORTS = _reg.counter(
+    "edl_dispatcher_stale_reports_total", "stale/unknown task reports")
+_QUEUE_TODO = _reg.gauge(
+    "edl_dispatcher_todo_tasks", "queued tasks")
+_QUEUE_DOING = _reg.gauge(
+    "edl_dispatcher_doing_tasks", "leased (in-flight) tasks")
 
 
 @dataclass
@@ -202,10 +225,23 @@ class TaskDispatcher:
         self._flush_callbacks(callbacks)
         with self._lock:
             if not self._todo:
+                self._set_queue_gauges_locked()
                 return None
             task = self._todo.popleft()
             self._doing[task.task_id] = _Lease(worker_id, task, time.time())
-            return task
+            self._set_queue_gauges_locked()
+        # lease-transition event OUTSIDE the lock (file I/O never runs
+        # under the dispatcher lock)
+        _TASKS_LEASED.inc()
+        tracing.event(
+            "task.lease", task_id=task.task_id, worker_id=worker_id,
+            task_type=task.type,
+        )
+        return task
+
+    def _set_queue_gauges_locked(self) -> None:  # holds: _lock
+        _QUEUE_TODO.set(len(self._todo))
+        _QUEUE_DOING.set(len(self._doing))
 
     def _flush_callbacks(self, callbacks: List[Callable]) -> None:
         with self._lock:
@@ -231,11 +267,13 @@ class TaskDispatcher:
         with self._lock:
             lease = self._doing.get(task_id)
             if lease is None:
+                _STALE_REPORTS.inc()
                 logger.warning(
                     "stale/unknown task report: task=%d worker=%d", task_id, worker_id
                 )
                 return False
             if lease.worker_id != worker_id:
+                _STALE_REPORTS.inc()
                 # The lease expired and was re-leased to another worker; this
                 # report is from the original (stale) holder. Accepting it
                 # would retire records the new holder is still re-running —
@@ -251,6 +289,7 @@ class TaskDispatcher:
                 if task.type == pb.TRAINING:
                     self._finished_training += 1
                     self._completed_versions += 1
+                _TASKS_FINISHED.inc()
             elif preempted:
                 # Drain report: the first `records_processed` records were
                 # applied (and are covered by the worker's preemption
@@ -278,6 +317,11 @@ class TaskDispatcher:
                 else:
                     self._fail_permanently_locked(task, err)
             callbacks = self._maybe_advance_epoch_locked()
+            self._set_queue_gauges_locked()
+        tracing.event(
+            "task.report", task_id=task_id, worker_id=worker_id,
+            success=bool(success), preempted=bool(preempted),
+        )
         self._flush_callbacks(callbacks)
         return True
 
@@ -292,10 +336,12 @@ class TaskDispatcher:
                 task.task_id, why,
             )
             return
+        _TASKS_REQUEUED.inc()
         self._todo.appendleft(task)
 
     def _fail_permanently_locked(self, task: TaskSpec, err: str) -> None:
         self._failed_permanently += 1
+        _TASKS_FAILED.inc()
         self._pending_failed.append(task)
         logger.error(
             "task %d failed permanently after %d retries: %s",
@@ -310,6 +356,7 @@ class TaskDispatcher:
             for tid in stale:
                 task = self._doing.pop(tid).task
                 self._requeue_locked(task, f"worker {worker_id} died")
+            self._set_queue_gauges_locked()
         if stale:
             logger.info("recovered %d tasks from worker %d", len(stale), worker_id)
         return len(stale)
@@ -323,6 +370,7 @@ class TaskDispatcher:
         ]
         for tid in expired:
             lease = self._doing.pop(tid)
+            _LEASES_EXPIRED.inc()
             lease.task.retries += 1
             if lease.task.retries <= self._max_task_retries:
                 logger.warning(
@@ -332,6 +380,8 @@ class TaskDispatcher:
                 self._requeue_locked(lease.task, "lease expired")
             else:
                 self._fail_permanently_locked(lease.task, "lease expired")
+        if expired:
+            self._set_queue_gauges_locked()
 
     def _maybe_advance_epoch_locked(self) -> List[Callable]:
         """If the current epoch's training drained, fire epoch-end exactly

@@ -1,0 +1,196 @@
+"""/metrics + /healthz scrape endpoint (stdlib http.server).
+
+Strictly best-effort and off the hot path: serving runs on daemon threads
+(ThreadingHTTPServer), a failed bind or a dead server never takes the
+process down, and the `metrics_scrape` fault site lets chaos schedules
+abort scrapes (`drop`), slow them (`delay`), or kill the ENDPOINT
+(`crash` — the server shuts down; the training process must not notice).
+
+Binding goes through `net.bind_with_retry` for the ephemeral-port case
+(the launcher TOCTOU discipline every other server here follows); a
+fixed port raises PortBindError so callers can retry or disable.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.common.net import PortBindError, bind_with_retry
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+logger = default_logger(__name__)
+
+#: fault-injection site fired per scrape request (see common/faults.py)
+SCRAPE_FAULT_SITE = "metrics_scrape"
+
+#: env knob for the default servers master/worker start: a port number,
+#: "0" = ephemeral (the default), "-1"/"off" = disabled
+PORT_ENV = "EDL_METRICS_PORT"
+
+
+class ObservabilityServer:
+    """One /metrics + /healthz endpoint over a registry."""
+
+    def __init__(self, registry: Optional[MetricsRegistry] = None,
+                 role: str = "", host: str = "127.0.0.1"):
+        self.registry = registry or default_registry()
+        self.role = role
+        self.host = host
+        self.port: Optional[int] = None
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------ #
+
+    def _handler_class(self):
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # never let a slow/half-open scraper pin a handler thread
+            timeout = 10
+
+            def do_GET(self):
+                fired = faults.check(SCRAPE_FAULT_SITE)
+                if fired is not None and fired.action == "drop":
+                    # abort the connection with no response — the scraper
+                    # sees a reset, training sees nothing
+                    self.close_connection = True
+                    return
+                if fired is not None and fired.action == "crash":
+                    # kill the ENDPOINT, not the process: serving is
+                    # best-effort; chaos tests assert training continues
+                    outer.stop(_from_handler=True)
+                    self.close_connection = True
+                    return
+                if self.path.split("?")[0] == "/metrics":
+                    body = outer.registry.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/healthz":
+                    body = (json.dumps({
+                        "status": "ok",
+                        "role": outer.role,
+                        "world_version": tracing.get_tracer().world_version,
+                        "pid": os.getpid(),
+                    }) + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet by default
+                logger.debug("metrics endpoint: " + fmt, *args)
+
+        return Handler
+
+    def _build(self, port: int) -> ThreadingHTTPServer:
+        handler = self._handler_class()
+        try:
+            srv = ThreadingHTTPServer((self.host, port), handler)
+        except OSError as e:
+            if e.errno == errno.EADDRINUSE:
+                raise PortBindError(
+                    f"metrics endpoint lost port {port} to the bind race"
+                ) from e
+            raise
+        srv.daemon_threads = True
+        return srv
+
+    def start(self, port: int = 0) -> int:
+        """Bind and serve on a daemon thread; returns the bound port.
+        port=0 picks an ephemeral port through net.bind_with_retry."""
+        if self._server is not None:
+            return self.port
+        if port == 0:
+            self.port, self._server = bind_with_retry(self._build)
+        else:
+            self._server = self._build(port)
+            self.port = port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="edl-metrics-http",
+            daemon=True,
+        )
+        self._thread.start()
+        logger.info(
+            "metrics endpoint serving on http://%s:%d/metrics (role %s)",
+            self.host, self.port, self.role or "?",
+        )
+        return self.port
+
+    def stop(self, _from_handler: bool = False) -> None:
+        server, self._server = self._server, None
+        thread, self._thread = self._thread, None
+        if server is None:
+            return
+        if _from_handler:
+            # shutdown() deadlocks when called from a handler thread of the
+            # same server; hand it to a throwaway thread
+            def _kill():
+                server.shutdown()
+                server.server_close()
+
+            threading.Thread(
+                target=_kill, name="edl-metrics-kill", daemon=True
+            ).start()
+            return
+        server.shutdown()
+        server.server_close()
+        if thread is not None:
+            thread.join(timeout=5)
+
+    @property
+    def address(self) -> Optional[str]:
+        return f"http://{self.host}:{self.port}" if self.port else None
+
+
+def start_server(role: str = "", port: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 ) -> Optional[ObservabilityServer]:
+    """Best-effort endpoint start for the master/worker entrypoints.
+    A set (non-empty) EDL_METRICS_PORT env overrides `port` in BOTH
+    directions: it can disable a configured endpoint ("-1"/"off") or
+    enable/repoint one the config disabled. Otherwise `port` decides:
+    None/0 = ephemeral, < 0 = disabled. Returns None instead of raising —
+    observability must never be the reason a job fails to boot."""
+    raw = os.environ.get(PORT_ENV)
+    if raw is not None and raw.strip():
+        raw = raw.strip().lower()
+        if raw in ("-1", "off", "disabled", "none"):
+            return None
+        try:
+            port = int(raw)
+        except ValueError:
+            # a typo'd override must not silently bind a random port the
+            # operator's scraper will never find — disable, loudly
+            logger.warning(
+                "%s=%r is not a port number; metrics endpoint disabled",
+                PORT_ENV, raw,
+            )
+            return None
+    if port is None:
+        port = 0
+    if port < 0:
+        return None
+    server = ObservabilityServer(registry=registry, role=role)
+    try:
+        server.start(port)
+    except Exception:
+        logger.exception("metrics endpoint failed to start; continuing")
+        return None
+    return server

@@ -26,9 +26,20 @@ import jax
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.parallel import mesh as mesh_lib
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_HANDOFF_STAGED = _reg.counter(
+    "edl_handoff_staged_leaves_total",
+    "state leaves pulled to host because their owner devices vanish")
+_HANDOFF_REPLICATED = _reg.counter(
+    "edl_handoff_replicated_leaves_total",
+    "leaves that lost their spec on the new mesh and fell back to "
+    "replication (correct but larger — watch this on shrinks)")
 
 
 class CohortContext:
@@ -233,6 +244,7 @@ def reshard_state(state: Any, new_mesh) -> Any:
         try:
             return jax.device_put(value, NamedSharding(new_mesh, spec))
         except ValueError:
+            _HANDOFF_REPLICATED.inc()
             logger.warning(
                 "leaf %s cannot keep spec %s on the %s mesh; replicating",
                 getattr(value, "shape", "?"), spec,
@@ -295,7 +307,10 @@ class LiveStateHandoff:
             staged += 1
             return _HostStaged(np.asarray(jax.device_get(x)), _leaf_spec(x))
 
-        self._state = jax.tree_util.tree_map(maybe_stage, self._state)
+        with tracing.span("handoff.stage_to_host") as sp:
+            self._state = jax.tree_util.tree_map(maybe_stage, self._state)
+            sp.set(staged_leaves=staged)
+        _HANDOFF_STAGED.inc(staged)
         return staged
 
     def apply(self, new_mesh) -> Any:

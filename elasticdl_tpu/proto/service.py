@@ -26,11 +26,35 @@ import grpc
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.constants import GRPC
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
 logger = default_logger(__name__)
 
 SERVICE_NAME = "elasticdl_tpu.Master"
+
+# control-plane wire metrics (scraped via /metrics; docs/observability.md)
+_reg = default_registry()
+_RPC_CALLS = _reg.counter(
+    "edl_rpc_client_calls_total",
+    "client RPC attempts (per method, incl. retries)", labels=("method",))
+_RPC_RETRIES = _reg.counter(
+    "edl_rpc_client_retries_total",
+    "retry attempts after a retryable failure", labels=("method",))
+_RPC_FAILURES = _reg.counter(
+    "edl_rpc_client_failures_total",
+    "failed RPC attempts (any error)", labels=("method",))
+_RPC_DEADLINE = _reg.counter(
+    "edl_rpc_client_deadline_exceeded_total",
+    "attempts that hit their deadline", labels=("method",))
+_BREAKER_OPEN = _reg.gauge(
+    "edl_rpc_breaker_open", "1 while the master circuit breaker is open")
+_BREAKER_TRIPS = _reg.counter(
+    "edl_rpc_breaker_trips_total", "circuit-breaker open transitions")
+_RPC_LATENCY = _reg.histogram(
+    "edl_rpc_client_latency_seconds",
+    "successful-call wall latency", labels=("method",))
 
 # rpc name -> (request type, response type)
 _RPCS = {
@@ -44,6 +68,10 @@ _RPCS = {
     "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatResponse),
     "GetJobStatus": (pb.Empty, pb.JobStatusResponse),
 }
+
+#: methods whose server-side handling opens a span when the client sent a
+#: trace context (Heartbeat excluded: 1/s/worker would drown the timeline)
+_TRACED_SERVER_RPCS = frozenset(_RPCS) - {"Heartbeat"}
 
 
 def rpc_site(name: str) -> str:
@@ -155,6 +183,8 @@ class CircuitBreaker:
             self._opened_at = None
             self._probe_in_flight = False
         if reopened:
+            _BREAKER_OPEN.set(0)
+            tracing.event("rpc.breaker_closed")
             logger.info("master circuit closed again (probe succeeded)")
 
     def record_failure(self) -> None:
@@ -169,6 +199,9 @@ class CircuitBreaker:
                 opened_now = True
             failures = self.consecutive_failures
         if opened_now:
+            _BREAKER_OPEN.set(1)
+            _BREAKER_TRIPS.inc()
+            tracing.event("rpc.breaker_open", consecutive_failures=failures)
             logger.warning(
                 "master circuit OPEN after %d consecutive RPC failures; "
                 "failing fast for %.1fs between probes",
@@ -176,11 +209,36 @@ class CircuitBreaker:
             )
 
 
+def _traced_handler(name: str, method: Callable) -> Callable:
+    """Wrap a servicer method so an incoming trace context (gRPC metadata
+    set by RetryingMasterStub) re-opens on the handler thread: the worker's
+    span becomes the parent of a server-side `rpc.server.<method>` span,
+    and one resize reads as one timeline across both roles."""
+    span_name = "rpc.server." + rpc_site(name)[len("rpc."):]
+
+    def handler(request, context):
+        md = {}
+        try:
+            md = {k: v for k, v in (context.invocation_metadata() or ())}
+        except Exception:
+            # metadata is observability-only; a context that can't supply
+            # it still serves the RPC: edl-lint: disable=EDL303
+            pass
+        trace_id = md.get(tracing.TRACE_ID_KEY)
+        if not trace_id or name not in _TRACED_SERVER_RPCS:
+            return method(request, context)
+        with tracing.adopt(trace_id, md.get(tracing.SPAN_ID_KEY)):
+            with tracing.span(span_name):
+                return method(request, context)
+
+    return handler
+
+
 def add_master_servicer(server: grpc.Server, servicer: Any) -> None:
     """Register a servicer object exposing methods named after the rpcs."""
     handlers = {}
     for name, (req_t, _resp_t) in _RPCS.items():
-        method = getattr(servicer, name)
+        method = _traced_handler(name, getattr(servicer, name))
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             method,
             request_deserializer=req_t.FromString,
@@ -277,17 +335,35 @@ class RetryingMasterStub:
                         f"{self.breaker.consecutive_failures} consecutive "
                         "failures"
                     )
+                t_call = time.perf_counter()
                 try:
+                    _RPC_CALLS.inc(method=name)
                     faults.fire(site)
-                    resp = method(request, timeout=deadline)
+                    # the active trace context (a rescale span, a reform
+                    # boot) rides the wire as gRPC metadata so the master's
+                    # handler joins the same timeline; no context, no kwarg
+                    # (injected test stubs only take (request, timeout))
+                    md = tracing.rpc_metadata()
+                    if md:
+                        resp = method(request, timeout=deadline, metadata=md)
+                    else:
+                        resp = method(request, timeout=deadline)
                     # lost-response injection: the server DID process the
                     # call; the caller never hears back
                     faults.fire(site + ".recv")
                 except self.RETRYABLE as e:
                     last = e
                     self.breaker.record_failure()
+                    _RPC_FAILURES.inc(method=name)
+                    if _is_deadline_exceeded(e):
+                        _RPC_DEADLINE.inc(method=name)
                     if attempt + 1 < attempts:
                         delay = self._backoff(attempt)
+                        _RPC_RETRIES.inc(method=name)
+                        tracing.event(
+                            "rpc.retry", method=name, attempt=attempt + 1,
+                            backoff_s=round(delay, 4),
+                        )
                         logger.warning(
                             "%s failed (%s); retry %d/%d in %.2fs",
                             name, _err_summary(e), attempt + 1,
@@ -302,8 +378,11 @@ class RetryingMasterStub:
                     # circuit would stay open forever against a healthy
                     # master — then surface it unchanged
                     self.breaker.record_failure()
+                    _RPC_FAILURES.inc(method=name)
                     raise
                 self.breaker.record_success()
+                _RPC_LATENCY.observe(
+                    time.perf_counter() - t_call, method=name)
                 if self._on_success is not None:
                     self._on_success()
                 return resp
@@ -311,6 +390,16 @@ class RetryingMasterStub:
 
         setattr(self, name, call)
         return call
+
+
+def _is_deadline_exceeded(e: BaseException) -> bool:
+    code = getattr(e, "code", None)
+    try:
+        return callable(code) and code() == grpc.StatusCode.DEADLINE_EXCEEDED
+    except Exception:
+        # classification-only (a metric label): an exotic error object
+        # counts as not-a-deadline: edl-lint: disable=EDL303
+        return False
 
 
 def _err_summary(e: BaseException) -> str:

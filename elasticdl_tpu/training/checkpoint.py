@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, List, Optional
 
 import jax
@@ -36,8 +37,24 @@ import orbax.checkpoint as ocp
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_CKPT_SAVES = _reg.counter(
+    "edl_ckpt_saves_total", "checkpoint saves initiated")
+_CKPT_RESTORES = _reg.counter(
+    "edl_ckpt_restores_total", "successful checkpoint restores")
+_CKPT_HANDOFFS = _reg.counter(
+    "edl_ckpt_handoffs_total",
+    "live state handoffs that skipped the restore round trip")
+_CKPT_WALKBACKS = _reg.counter(
+    "edl_ckpt_restore_walkbacks_total",
+    "corrupt/partial steps skipped during restore")
+_CKPT_SAVE_S = _reg.histogram(
+    "edl_ckpt_save_seconds", "save initiation wall time (async part excl.)")
 
 GEOMETRY_FILE = "embedding_geometry.json"
 
@@ -200,17 +217,23 @@ class CheckpointManager:
 
     def save(self, state: Any, step: Optional[int] = None, wait: bool = False) -> int:
         step = int(state.model_version if step is None else step)
-        # chaos hook: ckpt.save:crash kills the process before orbax's
-        # rename-commit — the step must never become visible; :drop raises
-        # into the caller's save-failure path
-        faults.fire("ckpt.save")
-        self._record_geometry()
-        self._mngr.save(step, args=ocp.args.StandardSave(state))
-        # chaos hook: ckpt.save.commit:crash dies with the async write in
-        # flight — orbax's rename-commit must leave no visible partial step
-        faults.fire("ckpt.save.commit")
-        if wait:
-            self._mngr.wait_until_finished()
+        with tracing.span("ckpt.save", step=step, wait=wait) as sp:
+            t0 = time.perf_counter()
+            # chaos hook: ckpt.save:crash kills the process before orbax's
+            # rename-commit — the step must never become visible; :drop
+            # raises into the caller's save-failure path
+            faults.fire("ckpt.save")
+            self._record_geometry()
+            self._mngr.save(step, args=ocp.args.StandardSave(state))
+            # chaos hook: ckpt.save.commit:crash dies with the async write
+            # in flight — orbax's rename-commit must leave no visible
+            # partial step
+            faults.fire("ckpt.save.commit")
+            if wait:
+                self._mngr.wait_until_finished()
+            _CKPT_SAVES.inc()
+            _CKPT_SAVE_S.observe(time.perf_counter() - t0)
+            sp.set(dir=self._dir)
         logger.info("checkpoint step %d -> %s", step, self._dir)
         return step
 
@@ -244,7 +267,9 @@ class CheckpointManager:
             latest = self.latest_step(refresh=True)
             if latest is None or (handoff.step or 0) >= latest:
                 try:
-                    state = handoff.apply(new_mesh)
+                    with tracing.span("ckpt.handoff", step=handoff.step):
+                        state = handoff.apply(new_mesh)
+                    _CKPT_HANDOFFS.inc()
                     logger.info(
                         "live state handoff applied at step %s "
                         "(checkpoint-restore skipped)", handoff.step,
@@ -289,12 +314,17 @@ class CheckpointManager:
         geometry, so older steps would fail identically): they raise a
         CheckpointGeometryError naming the alignment to rebuild with.
         """
+        with tracing.span("ckpt.restore", step=step) as restore_span:
+            return self._restore_traced(abstract_state, step, restore_span)
+
+    def _restore_traced(self, abstract_state, step, restore_span):
         faults.fire("ckpt.restore")
         if step is not None:
             candidates = [step]
         else:
             candidates = sorted(self.all_steps(), reverse=True)
         if not candidates:
+            restore_span.set(outcome="no_checkpoint")
             return None
         last_err: Optional[BaseException] = None
         for i, cand in enumerate(candidates):
@@ -319,6 +349,7 @@ class CheckpointManager:
                 # its error text (a checksum "mismatch" must walk back, not
                 # masquerade as a geometry diagnosis).
                 last_err = e
+                _CKPT_WALKBACKS.inc()
                 remaining = len(candidates) - i - 1
                 logger.warning(
                     "checkpoint step %d in %s failed to restore (%s: %s); "
@@ -338,6 +369,8 @@ class CheckpointManager:
                     "restored checkpoint step %d from %s", cand, self._dir
                 )
             self.last_restored_step = cand
+            _CKPT_RESTORES.inc()
+            restore_span.set(restored_step=cand, walked_back=i)
             return restored
         raise RuntimeError(
             f"every checkpoint step in {self._dir} failed to restore "

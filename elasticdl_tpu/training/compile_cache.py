@@ -43,6 +43,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from elasticdl_tpu.common import membership_signal
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
 
@@ -220,6 +222,22 @@ def global_cache() -> CompileCache:
     return _GLOBAL_CACHE
 
 
+# Scrape surface for the PROCESS-GLOBAL cache (the one job entrypoints and
+# the speculative compiler share); ad-hoc trainers' private caches are
+# deliberately not aggregated — their stats describe nothing cross-resize.
+_reg = default_registry()
+for _stat, _help in (
+    ("hits", "executable-cache hits (a resize that did NOT re-trace)"),
+    ("misses", "executable-cache misses (real re-traces)"),
+    ("speculative_compiles", "background neighbor-size precompiles"),
+    ("entries", "live cache entries"),
+    ("hit_rate", "hits / (hits + misses) — the bench's recompile_hit_rate"),
+):
+    _reg.gauge(
+        f"edl_compile_cache_{_stat}", _help
+    ).set_fn(lambda s=_stat: _GLOBAL_CACHE.stats()[s])
+
+
 # ---------------------------------------------------------------------- #
 # speculative neighbor-world compilation
 
@@ -306,7 +324,16 @@ class SpeculativeCompiler:
             if self._stop.is_set():
                 break
             try:
-                self._compile_for_size(size)
+                with tracing.span(
+                    "compile.speculative", size=size,
+                    current_size=self.current_size,
+                ) as sp:
+                    try:
+                        self._compile_for_size(size)
+                    except SpeculativeCompiler.SkipSize:
+                        sp.set(outcome="skipped")
+                        raise
+                    sp.set(outcome="compiled")
             except SpeculativeCompiler.SkipSize as e:
                 logger.info("speculative compile skipped size %d: %s", size, e)
                 with self._lock:

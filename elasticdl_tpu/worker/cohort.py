@@ -780,20 +780,48 @@ class CohortWorker:
             pass
 
     def run(self) -> int:
+        from elasticdl_tpu.common import membership_signal
+        from elasticdl_tpu.observability import tracing
+        from elasticdl_tpu.observability.http import start_server
+
+        # observability: role + world version on every span/log; when this
+        # boot IS a reform (the master's announcement carries a trace id),
+        # the boot spans join the master's resize timeline
+        role = f"cohort-{self.ctx.process_id}"
+        tracing.configure_from_config(
+            self.cfg, role=role, world_version=self.ctx.world_version
+        )
+        reform_tid = membership_signal.trace_id()
+        # a set EDL_METRICS_PORT overrides cfg.metrics_port either way
+        metrics_server = start_server(
+            role=role, port=self.cfg.metrics_port
+        )
         try:
-            self.ctx.initialize()
+            with tracing.span(
+                "cohort.world_form", trace_id=reform_tid,
+                num_processes=self.ctx.num_processes,
+                process_id=self.ctx.process_id,
+            ):
+                self.ctx.initialize()
         except Exception:
             logger.exception(
                 "world formation failed (coordinator %s, process %d/%d)",
                 self.ctx.coordinator_addr, self.ctx.process_id,
                 self.ctx.num_processes,
             )
+            if metrics_server is not None:
+                metrics_server.stop()
             return ExitCode.WORLD_FORM_FAILED
         self._install_sigterm_drain()
         try:
-            self._build()
+            with tracing.span("cohort.build", trace_id=reform_tid):
+                self._build()
             if self.ctx.is_leader:
-                self._connect()
+                # the register RPC carries the reform trace id (when this
+                # boot is one) to the master via gRPC metadata — the
+                # cross-role join point of the resize timeline
+                with tracing.span("cohort.register", trace_id=reform_tid):
+                    self._connect()
                 threading.Thread(
                     target=self._heartbeat_loop, daemon=True
                 ).start()
@@ -874,6 +902,9 @@ class CohortWorker:
             # cohort; a clean 0 would read as success and end all watching.
             return 0 if op == OP_DONE else ExitCode.COHORT_EVICTED
         finally:
+            if metrics_server is not None:
+                metrics_server.stop()
+            tracing.get_tracer().close()
             self.ctx.shutdown()
 
 

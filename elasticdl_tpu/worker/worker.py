@@ -19,17 +19,34 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import faults, membership_signal
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import RetryingMasterStub, make_channel
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_TRAIN_STEPS = _reg.counter(
+    "edl_train_steps_total", "train steps run by this worker")
+_TRAIN_RECORDS = _reg.counter(
+    "edl_train_records_total", "non-padding records applied")
+_TRAIN_THROUGHPUT = _reg.gauge(
+    "edl_train_samples_per_sec",
+    "per-task mean throughput (records / measured step wall time)")
+_TRAIN_STEP_S = _reg.histogram(
+    "edl_train_step_seconds", "per-step wall time (dispatch + compute)")
+_RESCALES = _reg.counter(
+    "edl_rescale_applied_total", "in-place rescales applied")
+_RESCALE_S = _reg.histogram(
+    "edl_rescale_seconds", "in-place rescale recovery wall time")
 
 
 class Worker:
@@ -97,6 +114,12 @@ class Worker:
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
         self._last_known_workers = resp.num_workers
+        # role known now: trace spans + JSON logs carry it; a reform trace
+        # id announced by the master (membership signal) makes this boot
+        # part of the resize's cross-role timeline
+        tracing.configure_from_config(
+            self.cfg, role=f"worker-{self.worker_id}"
+        )
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
@@ -392,22 +415,54 @@ class Worker:
             return
         axis_sizes, devices = target
         t0 = time.perf_counter()
-        # build everything fallible FIRST, swap worker state LAST: a failed
-        # construction must leave the old mesh/trainer/state fully intact
-        new_mesh = build_mesh(axis_sizes, devices)
-        new_trainer = self._make_trainer(new_mesh)
-        new_state = self._state
-        if new_state is not None:
-            handoff = elastic.LiveStateHandoff().capture(new_state)
-            new_state = handoff.apply(new_mesh)
-        self._state = new_state
-        self._mesh = new_mesh
-        self._trainer = new_trainer
-        if reset_services:
-            for svc in self._services.values():
-                svc.close()
-            self._services.clear()
-        self.last_recovery_s = time.perf_counter() - t0
+        # the rescale opens a NEW world generation: bump the tracer's world
+        # version first so every span of this recovery carries it — rolled
+        # back below if the build fails (the worker keeps running the OLD
+        # world then, and telemetry must agree)
+        prev_world_version = tracing.get_tracer().world_version
+        tracing.set_world_version(prev_world_version + 1)
+        # join the master's announced resize timeline when one exists (the
+        # membership signal file carries its trace id); otherwise this
+        # rescale starts its own trace
+        announced_tid = membership_signal.trace_id()
+        try:
+            with tracing.span(
+                "rescale", trace_id=announced_tid,
+                mid_task=not reset_services,
+            ) as root:
+                # build everything fallible FIRST, swap worker state LAST: a
+                # failed construction must leave the old mesh/trainer/state
+                # fully intact
+                with tracing.span("rescale.mesh"):
+                    new_mesh = build_mesh(axis_sizes, devices)
+                with tracing.span("rescale.compile"):
+                    # construction resolves the executable cache; an actual
+                    # re-trace (cache miss) is deferred to the first step
+                    new_trainer = self._make_trainer(new_mesh)
+                new_state = self._state
+                if new_state is not None:
+                    with tracing.span("rescale.handoff"):
+                        handoff = elastic.LiveStateHandoff().capture(
+                            new_state
+                        )
+                        new_state = handoff.apply(new_mesh)
+                self._state = new_state
+                self._mesh = new_mesh
+                self._trainer = new_trainer
+                if reset_services:
+                    for svc in self._services.values():
+                        svc.close()
+                    self._services.clear()
+                self.last_recovery_s = time.perf_counter() - t0
+                root.set(
+                    world_size=int(new_mesh.devices.size),
+                    recovery_s=round(self.last_recovery_s, 6),
+                )
+        except BaseException:
+            tracing.set_world_version(prev_world_version)
+            raise
+        _RESCALES.inc()
+        _RESCALE_S.observe(self.last_recovery_s)
         logger.info(
             "in-place rescale to %s in %.3fs (compile cache: %s)",
             dict(zip(new_mesh.axis_names, new_mesh.devices.shape)),
@@ -508,7 +563,9 @@ class Worker:
             # whole step (dispatch + device compute), not just dispatch —
             # the sync IS the measurement: edl-lint: disable=EDL201
             loss_sum += float(logs["loss"])
-            step_time_sum += time.perf_counter() - t0
+            step_s = time.perf_counter() - t0
+            step_time_sum += step_s
+            _TRAIN_STEP_S.observe(step_s)
             loss_count += 1
             self._global_step += 1
             self._model_version += 1
@@ -592,7 +649,9 @@ class Worker:
                     # trailing-partial fallback, same rationale as above:
                     # edl-lint: disable=EDL201
                     stats["loss_sum"] += float(logs["loss"])
-            stats["step_time_sum"] += time.perf_counter() - t0
+            group_s = time.perf_counter() - t0
+            stats["step_time_sum"] += group_s
+            _TRAIN_STEP_S.observe(group_s / max(1, len(buf)))
             stats["loss_count"] += len(buf)
             self._global_step += len(buf)
             self._model_version += len(buf)
@@ -766,6 +825,14 @@ class Worker:
 
     def run(self) -> int:
         self._connect()
+        # /metrics + /healthz for this worker (best-effort, off the hot
+        # path; a set EDL_METRICS_PORT overrides cfg.metrics_port either
+        # way, -1/off in either disables)
+        from elasticdl_tpu.observability.http import start_server
+
+        self._metrics_server = start_server(
+            role=f"worker-{self.worker_id}", port=self.cfg.metrics_port
+        )
         self._build_trainer()
         self._heartbeat_thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True
@@ -831,6 +898,12 @@ class Worker:
             try:
                 if task.type == pb.TRAINING:
                     stats = self._run_training_task(task)
+                    _TRAIN_STEPS.inc(int(stats["loss_count"]))
+                    _TRAIN_RECORDS.inc(int(stats["records_done"]))
+                    if stats["step_time_sum"] > 0:
+                        _TRAIN_THROUGHPUT.set(
+                            stats["records_done"] / stats["step_time_sum"]
+                        )
                     if stats["interrupted"]:
                         self._report_preempted_task(task, stats)
                         break
@@ -894,6 +967,13 @@ class Worker:
         # BEFORE interpreter exit — a grpc call in flight during shutdown
         # aborts the process from the C++ layer.
         self._shutdown.set()
+        if getattr(self, "_metrics_server", None) is not None:
+            try:
+                self._metrics_server.stop()
+            except Exception:
+                logger.debug("metrics endpoint stop failed", exc_info=True)
+        # flush trace.jsonl durably (the tracer reopens on reconfigure)
+        tracing.get_tracer().close()
         if self._heartbeat_thread is not None:
             self._heartbeat_thread.join(timeout=2 * self.cfg.worker_heartbeat_s)
         try:

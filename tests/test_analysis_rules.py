@@ -455,6 +455,58 @@ def test_constant_sleep_in_retry_loop_fires():
 
 
 # ------------------------------------------------------------------ #
+# EDL401 metric-name-pattern
+
+
+def test_metric_name_pattern_flags_bad_names():
+    bad = """
+        from elasticdl_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        reg.counter("rpc_retries_total", "no edl_ prefix")
+        reg.gauge("edl_depth", "missing subsystem segment")
+        reg.histogram(name="edlFoo_bar", help="camelCase")
+    """
+    found = findings_for(bad, select={"EDL401"})
+    assert len(found) == 3
+    assert rule_ids(found) == ["EDL401"]
+
+
+def test_metric_name_pattern_quiet_on_good_and_unrelated():
+    good = """
+        from elasticdl_tpu.observability.registry import default_registry
+
+        reg = default_registry()
+        reg.counter("edl_rpc_retries_total", "fine")
+        reg.gauge("edl_prefetch_depth", "fine", labels=("method",))
+        reg.histogram("edl_ckpt_save_seconds", "fine")
+
+        # not metric registrations: dynamic names, non-identifier strings,
+        # unrelated callables
+        reg.counter(some_name)
+        parser.counter("not a metric name, has spaces")
+        reg.gauge(f"edl_{sub}_x")
+    """
+    assert findings_for(good, select={"EDL401"}) == []
+
+
+def test_metric_name_regexes_pinned_together():
+    """The lint regex and the runtime validator must accept/reject the
+    same names (EDL401 is the static mirror of registry validation)."""
+    from elasticdl_tpu.analysis.observability_rules import METRIC_NAME_RE
+    from elasticdl_tpu.observability import registry as reg_mod
+
+    cases = [
+        "edl_rpc_retries_total", "edl_a_b", "edl_compile_cache_hit_rate",
+        "rpc_retries", "edl_x", "edl__x", "EDL_RPC_X", "edl_rpc_", "edl",
+    ]
+    for name in cases:
+        assert bool(METRIC_NAME_RE.match(name)) == bool(
+            reg_mod._NAME_RE.match(name)
+        ), name
+
+
+# ------------------------------------------------------------------ #
 # suppressions, baseline, CLI
 
 

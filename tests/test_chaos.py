@@ -62,7 +62,21 @@ def run_control_plane_scenario(seed: int):
     loop, no background threads, no wall-clock triggers), so the RPC call
     sequence — and with it every seeded fault decision — is a pure
     function of the seed.
+
+    With EDL_CHAOS_ARTIFACT_DIR set (CI), the scenario's trace.jsonl and
+    a /metrics snapshot are written there for workflow-artifact upload —
+    the chaos run's observability record, not just its assertions.
     """
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.registry import default_registry
+
+    art_dir = os.environ.get("EDL_CHAOS_ARTIFACT_DIR")
+    if art_dir:
+        os.makedirs(art_dir, exist_ok=True)
+        tracing.configure(
+            path=os.path.join(art_dir, f"chaos-smoke-seed{seed}.trace.jsonl"),
+            role="chaos-smoke",
+        )
     faults.install(SMOKE_SPEC, seed=seed)
     dispatcher = TaskDispatcher(
         training_shards=SHARDS, records_per_task=40, shuffle=True,
@@ -130,6 +144,13 @@ def run_control_plane_scenario(seed: int):
         channel.close()
         server.stop(None)
         faults.uninstall()
+        if art_dir:
+            tracing.get_tracer().close()
+            with open(
+                os.path.join(art_dir, f"chaos-smoke-seed{seed}.metrics.prom"),
+                "w",
+            ) as f:
+                f.write(default_registry().render_prometheus())
     return applied, counts, trace
 
 

@@ -1,0 +1,668 @@
+"""Observability subsystem: registry, tracing, scrape surface, log joins.
+
+Covers the ISSUE-4 test checklist: registry thread-safety under concurrent
+writers, histogram quantile correctness, span nesting + propagation across
+a REAL gRPC hop, the /metrics text-format golden, the metrics_scrape fault
+site (endpoint death must never touch training), the structured-log
+satellite, and the summary-service registry stream. The jax-heavy rescale
+e2e (trace spans in order with the new world version) lives at the end.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+import urllib.error
+
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common import log_utils
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.http import ObservabilityServer
+from elasticdl_tpu.observability.registry import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def tracer_memory():
+    """Point the process tracer at memory only and hand back a marker for
+    slicing: records appended during the test are records[start:]."""
+    t = tracing.get_tracer()
+    start = len(t.records)
+    yield t, start
+
+
+def new_records(t, start):
+    return list(t.records)[start:]
+
+
+# ---------------------------------------------------------------------- #
+# registry
+
+
+def test_counter_gauge_basic():
+    reg = MetricsRegistry()
+    c = reg.counter("edl_test_ops_total", "ops", labels=("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    c.inc(kind="b")
+    assert c.value(kind="a") == 3
+    assert c.value(kind="b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")
+    g = reg.gauge("edl_test_depth", "depth")
+    g.set(4)
+    g.add(-1)
+    assert g.value() == 3
+
+
+def test_registration_idempotent_and_kind_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("edl_test_x_total")
+    b = reg.counter("edl_test_x_total")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("edl_test_x_total")
+
+
+def test_metric_name_pattern_enforced_at_runtime():
+    reg = MetricsRegistry()
+    for bad in ("retries_total", "edl_x", "edl_Upper_case", "edl__x",
+                "edl_rpc_"):
+        with pytest.raises(ValueError):
+            reg.counter(bad)
+
+
+def test_registry_thread_safety_under_concurrent_writers():
+    reg = MetricsRegistry()
+    c = reg.counter("edl_test_hits_total", labels=("worker",))
+    g = reg.gauge("edl_test_level")
+    h = reg.histogram("edl_test_lat_seconds")
+    n_threads, n_iter = 8, 2000
+    barrier = threading.Barrier(n_threads)
+
+    def writer(i):
+        barrier.wait()
+        for k in range(n_iter):
+            c.inc(worker=str(i % 2))
+            g.set(k)
+            h.observe(k / n_iter)
+
+    threads = [
+        threading.Thread(target=writer, args=(i,)) for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    total = c.value(worker="0") + c.value(worker="1")
+    assert total == n_threads * n_iter        # no lost increments
+    assert h.count() == n_threads * n_iter    # exact count despite sampling
+    # render under load never corrupts (smoke)
+    text = reg.render_prometheus()
+    assert "edl_test_hits_total" in text
+
+
+def test_histogram_quantile_correctness():
+    reg = MetricsRegistry()
+    # reservoir >= population: quantiles are EXACT interpolations
+    h = reg.histogram("edl_test_exact_seconds", reservoir=2048)
+    for v in range(1000):
+        h.observe(float(v))
+    assert h.count() == 1000
+    assert abs(h.quantile(0.5) - 499.5) < 1e-6
+    assert abs(h.quantile(0.9) - 899.1) < 1e-6
+    assert h.quantile(0.99) == pytest.approx(989.01)
+    # bounded reservoir: count/sum exact, sample capped, quantiles sane
+    small = reg.histogram("edl_test_sampled_seconds", reservoir=128)
+    for v in range(100_000):
+        small.observe(float(v % 1000))
+    assert small.count() == 100_000
+    assert len(small._children[()].sample) == 128
+    assert 300 <= small.quantile(0.5) <= 700   # loose: it is a sample
+
+
+def test_prometheus_text_format_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("edl_test_things_total", "things counted",
+                    labels=("kind",))
+    c.inc(3, kind="a")
+    c.inc(1, kind='we"ird\n')
+    reg.gauge("edl_test_temp", "temperature").set(1.5)
+    h = reg.histogram("edl_test_wait_seconds", "wait")
+    h.observe(2.0)
+    text = reg.render_prometheus()
+    assert text == (
+        '# HELP edl_test_temp temperature\n'
+        '# TYPE edl_test_temp gauge\n'
+        'edl_test_temp 1.5\n'
+        '# HELP edl_test_things_total things counted\n'
+        '# TYPE edl_test_things_total counter\n'
+        'edl_test_things_total{kind="a"} 3\n'
+        'edl_test_things_total{kind="we\\"ird\\n"} 1\n'
+        '# HELP edl_test_wait_seconds wait\n'
+        '# TYPE edl_test_wait_seconds summary\n'
+        'edl_test_wait_seconds{quantile="0.5"} 2\n'
+        'edl_test_wait_seconds{quantile="0.9"} 2\n'
+        'edl_test_wait_seconds{quantile="0.99"} 2\n'
+        'edl_test_wait_seconds_sum 2\n'
+        'edl_test_wait_seconds_count 1\n'
+    )
+
+
+def test_snapshot_is_flat_and_numeric():
+    reg = MetricsRegistry()
+    reg.counter("edl_test_a_total").inc(2)
+    reg.gauge("edl_test_rate").set_fn(lambda: 0.25)
+    snap = reg.snapshot()
+    assert snap["edl_test_a_total"] == 2
+    assert snap["edl_test_rate"] == 0.25
+    assert all(isinstance(v, (int, float)) for v in snap.values())
+
+
+def test_callback_gauge_failure_reads_zero():
+    reg = MetricsRegistry()
+    reg.gauge("edl_test_broken_rate").set_fn(lambda: 1 / 0)
+    assert reg.snapshot()["edl_test_broken_rate"] == 0.0
+    assert "edl_test_broken_rate 0" in reg.render_prometheus()
+
+
+# ---------------------------------------------------------------------- #
+# tracing
+
+
+def test_span_nesting_parent_ids_and_world_version(tracer_memory):
+    t, start = tracer_memory
+    tracing.set_world_version(42)
+    with tracing.span("outer", a=1) as outer:
+        with tracing.span("inner"):
+            tracing.event("tick", n=7)
+        outer.set(b=2)
+    recs = new_records(t, start)
+    names = [r["name"] for r in recs]
+    assert names == ["tick", "inner", "outer"]   # children emit first
+    tick, inner, outer_rec = recs
+    assert inner["parent_id"] == outer_rec["span_id"]
+    assert tick["trace_id"] == inner["trace_id"] == outer_rec["trace_id"]
+    assert outer_rec["a"] == 1 and outer_rec["b"] == 2
+    assert all(r["world_version"] == 42 for r in recs)
+    assert outer_rec["dur_ms"] >= inner["dur_ms"]
+
+
+def test_span_error_recorded_and_reraised(tracer_memory):
+    t, start = tracer_memory
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("x")
+    rec = new_records(t, start)[-1]
+    assert rec["name"] == "boom" and "RuntimeError" in rec["error"]
+
+
+def test_adopt_joins_foreign_trace(tracer_memory):
+    t, start = tracer_memory
+    with tracing.adopt("feedfacecafebeef", "aabbccdd"):
+        with tracing.span("child"):
+            pass
+    rec = new_records(t, start)[-1]
+    assert rec["trace_id"] == "feedfacecafebeef"
+    assert rec["parent_id"] == "aabbccdd"
+
+
+def test_trace_file_written_and_fsynced(tmp_path):
+    path = str(tmp_path / "trace" / "trace.jsonl")
+    tracer = tracing.Tracer()
+    tracer.configure(path=path, role="t", world_version=3)
+    with tracer.span("s1", k="v"):
+        pass
+    tracer.event("e1")
+    tracer.close()
+    recs = tracing.read_trace_file(path)
+    assert [r["name"] for r in recs] == ["s1", "e1"]
+    assert recs[0]["role"] == "t" and recs[0]["world_version"] == 3
+    # truncated tail (writer killed mid-record) parses the intact lines
+    with open(path, "a") as f:
+        f.write('{"kind": "span", "nam')
+    assert len(tracing.read_trace_file(path)) == 2
+
+
+def test_phase_durations_helper():
+    records = [
+        {"kind": "span", "name": "phase.compile", "trace_id": "t1",
+         "dur_ms": 100.0},
+        {"kind": "span", "name": "phase.compile", "trace_id": "t1",
+         "dur_ms": 50.0},
+        {"kind": "span", "name": "phase.handoff", "trace_id": "t1",
+         "dur_ms": 25.0},
+        {"kind": "span", "name": "phase.settle", "trace_id": "OTHER",
+         "dur_ms": 999.0},
+        {"kind": "event", "name": "phase.settle", "trace_id": "t1"},
+    ]
+    assert tracing.phase_durations(records, "t1") == {
+        "compile": 0.15, "handoff": 0.025,
+    }
+
+
+def test_trace_path_for_derivation():
+    assert tracing.trace_path_for("", "", "master") is None
+    assert tracing.trace_path_for("off", "/s", "master") is None
+    assert tracing.trace_path_for("", "/s", "master") == os.path.join(
+        "/s", "trace", "master", "trace.jsonl")
+    assert tracing.trace_path_for("/t", "/s", "w-0") == os.path.join(
+        "/t", "w-0", "trace.jsonl")
+
+
+# ---------------------------------------------------------------------- #
+# trace propagation across a REAL gRPC hop
+
+
+def test_trace_context_propagates_across_rpc_hop(tracer_memory):
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import (
+        RetryingMasterStub,
+        add_master_servicer,
+        make_channel,
+        make_server,
+    )
+
+    t, start = tracer_memory
+    dispatcher = TaskDispatcher(
+        training_shards=[("s0", 0, 40)], records_per_task=40,
+        task_timeout_s=1e9,
+    )
+    membership = Membership(heartbeat_timeout_s=1e9)
+    servicer = MasterServicer(dispatcher, membership, None)
+    server = make_server()
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("localhost:0")
+    assert port
+    server.start()
+    channel = make_channel(f"localhost:{port}")
+    try:
+        stub = RetryingMasterStub(channel)
+        wid = stub.RegisterWorker(
+            pb.RegisterWorkerRequest(worker_name="hop")
+        ).worker_id
+        with tracing.span("client.op") as client_span:
+            resp = stub.GetTask(pb.GetTaskRequest(worker_id=wid))
+        assert resp.task.task_id
+        # wait for the server-side span record (handler thread)
+        deadline = time.monotonic() + 5
+        server_spans = []
+        while time.monotonic() < deadline and not server_spans:
+            server_spans = [
+                r for r in new_records(t, start)
+                if r["name"] == "rpc.server.get_task"
+            ]
+            time.sleep(0.01)
+        assert server_spans, [r["name"] for r in new_records(t, start)]
+        srv = server_spans[0]
+        # the hop: same trace id, client span is the parent
+        assert srv["trace_id"] == client_span.trace_id
+        assert srv["parent_id"] == client_span.span_id
+        # the dispatcher's lease event joined the same timeline
+        leases = [
+            r for r in new_records(t, start)
+            if r["name"] == "task.lease"
+        ]
+        assert leases and leases[0]["trace_id"] == client_span.trace_id
+    finally:
+        channel.close()
+        server.stop(None)
+
+
+def test_no_metadata_without_active_span():
+    """Injected fake stubs only accept (request, timeout=...) — the client
+    must not pass metadata when no span is open (and must when one is)."""
+    from elasticdl_tpu.proto.service import RetryingMasterStub
+
+    seen = {}
+
+    class Fake:
+        def __getattr__(self, name):
+            def call(request, timeout=None, **kw):
+                seen[name] = kw
+                return "ok"
+
+            return call
+
+    stub = RetryingMasterStub(None, stub=Fake())
+    stub.GetJobStatus("req")
+    assert seen["GetJobStatus"] == {}
+    with tracing.span("op"):
+        stub.Heartbeat("req")
+    md = dict(seen["Heartbeat"]["metadata"])
+    assert tracing.TRACE_ID_KEY in md and tracing.SPAN_ID_KEY in md
+
+
+# ---------------------------------------------------------------------- #
+# /metrics endpoint
+
+
+def _get(url, timeout=5):
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def test_metrics_endpoint_serves_prometheus_and_healthz():
+    reg = MetricsRegistry()
+    reg.counter("edl_test_served_total").inc(5)
+    server = ObservabilityServer(registry=reg, role="tester")
+    try:
+        port = server.start()
+        text = _get(f"http://127.0.0.1:{port}/metrics")
+        assert "# TYPE edl_test_served_total counter" in text
+        assert "edl_test_served_total 5" in text
+        health = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert health["status"] == "ok" and health["role"] == "tester"
+        with pytest.raises(urllib.error.HTTPError):
+            _get(f"http://127.0.0.1:{port}/nope")
+    finally:
+        server.stop()
+
+
+def test_metrics_scrape_fault_drop_aborts_one_request():
+    reg = MetricsRegistry()
+    reg.counter("edl_test_alive_total").inc()
+    server = ObservabilityServer(registry=reg, role="t")
+    try:
+        port = server.start()
+        faults.install("metrics_scrape:drop@at=1")
+        with pytest.raises(Exception):
+            _get(f"http://127.0.0.1:{port}/metrics", timeout=2)
+        # next scrape (hit 2) serves normally: the endpoint survived
+        assert "# " in _get(f"http://127.0.0.1:{port}/metrics")
+    finally:
+        server.stop()
+
+
+def test_metrics_scrape_fault_crash_kills_endpoint_not_training():
+    """The chaos contract: `metrics_scrape:crash` takes the ENDPOINT down;
+    a concurrently-running training loop never blocks or dies."""
+    reg = MetricsRegistry()
+    steps = reg.counter("edl_test_steps_total")
+    stop = threading.Event()
+
+    def train():
+        while not stop.is_set():
+            steps.inc()
+            time.sleep(0.001)
+
+    trainer = threading.Thread(target=train, daemon=True)
+    trainer.start()
+    server = ObservabilityServer(registry=reg, role="t")
+    try:
+        port = server.start()
+        faults.install("metrics_scrape:crash@at=1")
+        with pytest.raises(Exception):
+            _get(f"http://127.0.0.1:{port}/metrics", timeout=2)
+        # endpoint is dead...
+        deadline = time.monotonic() + 5
+        dead = False
+        while time.monotonic() < deadline and not dead:
+            try:
+                _get(f"http://127.0.0.1:{port}/metrics", timeout=1)
+                time.sleep(0.05)
+            except Exception:
+                dead = True
+        assert dead, "endpoint survived metrics_scrape:crash"
+        # ...and training never noticed
+        before = steps.value()
+        time.sleep(0.05)
+        assert steps.value() > before
+        assert trainer.is_alive()
+    finally:
+        stop.set()
+        trainer.join(timeout=2)
+        server.stop()
+
+
+def test_port_env_overrides_config_both_ways(monkeypatch):
+    from elasticdl_tpu.observability.http import start_server
+
+    # env disables — even an explicitly configured port
+    monkeypatch.setenv("EDL_METRICS_PORT", "-1")
+    assert start_server(role="t") is None
+    assert start_server(role="t", port=0) is None
+    monkeypatch.setenv("EDL_METRICS_PORT", "off")
+    assert start_server(role="t") is None
+    # env enables (ephemeral) — even a config-disabled endpoint
+    monkeypatch.setenv("EDL_METRICS_PORT", "0")
+    srv = start_server(role="t", port=-1)
+    assert srv is not None and srv.port
+    srv.stop()
+    # no env: the config port decides; -1 disables
+    monkeypatch.delenv("EDL_METRICS_PORT")
+    assert start_server(role="t", port=-1) is None
+
+
+# ---------------------------------------------------------------------- #
+# structured logs (EDL_LOG_JSON satellite)
+
+
+def _log_record(msg="hello"):
+    import logging
+
+    return logging.LogRecord(
+        name="elasticdl_tpu.test", level=logging.INFO, pathname=__file__,
+        lineno=12, msg=msg, args=(), exc_info=None,
+    )
+
+
+def test_json_formatter_carries_trace_context():
+    from elasticdl_tpu.common.log_utils import _JsonFormatter
+
+    tracing.configure(role="worker-3", world_version=9)
+    try:
+        with tracing.span("op"):
+            line = _JsonFormatter().format(_log_record())
+            ctx = tracing.current_context()
+            rec = json.loads(line)
+            assert rec["msg"] == "hello"
+            assert rec["role"] == "worker-3"
+            assert rec["world_version"] == 9
+            assert rec["trace_id"] == ctx[0]
+            assert rec["span_id"] == ctx[1]
+        rec = json.loads(_JsonFormatter().format(_log_record()))
+        assert "trace_id" not in rec   # no active span, no ids
+    finally:
+        tracing.configure(role="", world_version=0)
+
+
+def test_plain_formatter_gains_role_prefix():
+    from elasticdl_tpu.common.log_utils import _PlainFormatter, _FORMAT
+
+    tracing.configure(role="master")
+    try:
+        line = _PlainFormatter(_FORMAT).format(_log_record())
+        assert line.startswith("[master] ")
+        assert "hello" in line
+    finally:
+        tracing.configure(role="")
+
+
+def test_make_formatter_selects_json(monkeypatch):
+    from elasticdl_tpu.common.log_utils import (
+        _JsonFormatter,
+        _PlainFormatter,
+        make_formatter,
+    )
+
+    monkeypatch.delenv("EDL_LOG_JSON", raising=False)
+    assert isinstance(make_formatter(), _PlainFormatter)
+    monkeypatch.setenv("EDL_LOG_JSON", "1")
+    assert isinstance(make_formatter(), _JsonFormatter)
+
+
+def test_log_context_provider_registered():
+    """tracing registers itself as log_utils' context source at import."""
+    assert log_utils._context_provider is not None
+    with tracing.span("ctxcheck"):
+        ctx = log_utils._context()
+        assert ctx.get("trace_id") == tracing.current_trace_id()
+
+
+# ---------------------------------------------------------------------- #
+# summary service: fsync'd events.jsonl + registry snapshot stream
+
+
+def test_summary_writer_resolves_tf_once_and_survives_close(tmp_path):
+    from elasticdl_tpu.master.summary_service import SummaryWriter
+
+    w = SummaryWriter(str(tmp_path / "train"))
+    # the module handle is resolved at construction (None on TF-less
+    # images) — scalars() must not import inside the lock
+    assert hasattr(w, "_tf")
+    w.scalars(1, {"loss": 0.5})
+    w.scalars(2, {"loss": 0.25})
+    w.close()
+    lines = [
+        json.loads(ln) for ln in
+        (tmp_path / "train" / "events.jsonl").read_text().splitlines()
+    ]
+    assert [ln["step"] for ln in lines] == [1, 2]
+    # post-close writes are dropped, not crashed (late gRPC reports)
+    w.scalars(3, {"loss": 0.1})
+    w.close()   # idempotent
+
+
+def test_summary_service_registry_snapshot_stream(tmp_path):
+    from elasticdl_tpu.master.summary_service import SummaryService
+
+    reg = MetricsRegistry()
+    reg.counter("edl_test_reforms_total").inc(4)
+    svc = SummaryService(
+        str(tmp_path), registry=reg, snapshot_interval_s=0.0)
+    svc.maybe_snapshot_registry(step=17)
+    svc.close()
+    lines = [
+        json.loads(ln) for ln in
+        (tmp_path / "control" / "events.jsonl").read_text().splitlines()
+    ]
+    assert lines and lines[0]["step"] == 17
+    assert lines[0]["edl_test_reforms_total"] == 4
+
+
+def test_summary_service_snapshot_rate_limited(tmp_path):
+    from elasticdl_tpu.master.summary_service import SummaryService
+
+    reg = MetricsRegistry()
+    reg.counter("edl_test_ticks_total").inc()
+    svc = SummaryService(
+        str(tmp_path), registry=reg, snapshot_interval_s=3600.0)
+    for step in range(5):
+        svc.maybe_snapshot_registry(step=step)
+    svc.close()
+    control = tmp_path / "control" / "events.jsonl"
+    if control.exists():
+        assert len(control.read_text().splitlines()) <= 1
+
+
+# ---------------------------------------------------------------------- #
+# master side: the resize announcement carries the trace id
+
+
+def test_process_manager_announces_reform_trace_id(tmp_path, tracer_memory):
+    """add_worker on a cohort mints ONE trace id, stamps it into the
+    membership signal (where workers adopt it) and onto the announce
+    event — the master half of the one-resize-one-trace contract."""
+    from elasticdl_tpu.common import membership_signal
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.process_manager import ProcessManager
+
+    t, start = tracer_memory
+    cfg = JobConfig(model_def="m.f", num_processes=2)
+    mgr = ProcessManager(
+        cfg, membership_signal_path=str(tmp_path / "signal.json")
+    )
+    target = mgr.add_worker()
+    assert target == 3
+    tid = membership_signal.trace_id(str(tmp_path / "signal.json"))
+    assert tid
+    events = [
+        r for r in new_records(t, start) if r["name"] == "reform.announce"
+    ]
+    assert events and events[-1]["trace_id"] == tid
+    assert events[-1]["pending_size"] == 3
+    # a second request while one is pending keeps the SAME timeline
+    mgr.add_worker()
+    assert membership_signal.trace_id(str(tmp_path / "signal.json")) == tid
+
+
+# ---------------------------------------------------------------------- #
+# rescale e2e: the trace IS the recovery timeline
+
+
+def test_worker_rescale_emits_phase_spans_in_order(tmp_path, monkeypatch,
+                                                   tracer_memory):
+    """An in-place rescale announced through the membership signal file
+    must produce — under the ANNOUNCED trace id — the mesh/compile/handoff
+    spans in order, closed by the parent rescale span, all stamped with
+    the NEW world version (and the same id the master's reform spans would
+    carry on its side)."""
+    import jax
+
+    from elasticdl_tpu.common import membership_signal
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.worker.worker import Worker
+
+    t, start = tracer_memory
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = JobConfig(
+        model_zoo=os.path.join(repo, "model_zoo"),
+        model_def="census.wide_deep.custom_model",
+        minibatch_size=16,
+    )
+    worker = Worker(cfg)
+    worker._build_trainer()
+    import numpy as np
+
+    r = np.random.RandomState(0)
+    batch = {
+        "features": {
+            "dense": r.rand(16, 5).astype(np.float32),
+            "cat": r.randint(0, 400, (16, 9)).astype(np.int32),
+        },
+        "labels": r.randint(0, 2, (16,)).astype(np.int32),
+    }
+    worker._ensure_state(batch)
+    worker._state, _ = worker._trainer.train_step(worker._state, batch)
+
+    # the master's announcement: pending size + the resize's trace id
+    announced = tracing.new_trace_id()
+    signal_path = str(tmp_path / "membership_signal.json")
+    membership_signal.write_signal(
+        signal_path, world_size=8, pending_size=4, world_version=1,
+        trace_id=announced,
+    )
+    monkeypatch.setenv(membership_signal.ENV_VAR, signal_path)
+
+    tracing.set_world_version(0)
+    worker.request_rescale({"data": 4}, jax.devices()[:4])
+    worker._rescale_in_place()
+
+    spans = tracing.spans_for_trace(new_records(t, start), announced)
+    names = [s["name"] for s in spans]
+    assert names == [
+        "rescale.mesh", "rescale.compile", "rescale.handoff", "rescale",
+    ]
+    parent = spans[-1]
+    assert parent["world_size"] == 4
+    assert parent["recovery_s"] > 0
+    # children nest under the rescale span
+    assert all(s["parent_id"] == parent["span_id"] for s in spans[:-1])
+    # every span of the recovery carries the NEW world generation
+    assert all(s["world_version"] == 1 for s in spans)
+    assert tracing.get_tracer().world_version == 1
+    # training continues on the new mesh (the rescale was real)
+    worker._state, logs = worker._trainer.train_step(worker._state, batch)
+    assert float(logs["loss"]) == pytest.approx(float(logs["loss"]))
