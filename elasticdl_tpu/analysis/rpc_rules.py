@@ -27,6 +27,17 @@ EDL304 sleep-retry-no-jitter
     exceptions (the retry shape). Synchronized constant backoff is how a
     relaunched fleet produces a thundering herd against a recovering
     master; use the stub's jittered backoff or randomize the sleep.
+
+EDL305 non-atomic-state-file-write
+    `open(..., "w")` onto a `*.json`/`*.jsonl` state file in a scope that
+    never calls `os.replace`/`os.rename`. A crash mid-write leaves a torn
+    file the next reader chokes on; the required idiom is write-to-a-
+    `.tmp`-sibling + fsync + `os.replace` (the journal and
+    membership_signal writers are the reference implementations —
+    master/journal.py `_rotate_locked`, common/membership_signal.py
+    `write_signal`). Opening the `.tmp` sibling itself, append-mode
+    handles (a WAL's appends are torn-tail-tolerant by design), and
+    scopes that do replace/rename are all quiet.
 """
 
 from __future__ import annotations
@@ -172,6 +183,116 @@ class SilentExceptionSwallowRule(Rule):
                     "broad except silently swallows the error; narrow the "
                     "exception type, log it, or re-raise",
                 )
+
+
+def _module_str_constants(tree: ast.Module) -> dict:
+    """Top-level `NAME = "literal"` assignments (state-file names are
+    conventionally module constants, e.g. export.py's INFO_FILE)."""
+    out = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    """True for open(...) with an explicit write/truncate mode. Append
+    ("a") is deliberately quiet: an append-only log's durability story is
+    torn-tail tolerance, not whole-file atomicity."""
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (
+        isinstance(mode, ast.Constant)
+        and isinstance(mode.value, str)
+        and mode.value.startswith("w")
+    )
+
+
+def _json_state_path(expr: ast.AST, consts: dict) -> bool:
+    """True when the path expression names a .json/.jsonl file and is NOT
+    the .tmp sibling (writing the tmp file IS the atomic idiom's first
+    half)."""
+    json_like = tmp_like = False
+    for node in ast.walk(expr):
+        s = None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            s = node.value
+        elif isinstance(node, ast.Name):
+            s = consts.get(node.id)
+        if s is None:
+            continue
+        if ".json" in s:
+            json_like = True
+        if ".tmp" in s:
+            tmp_like = True
+    return json_like and not tmp_like
+
+
+@register
+class NonAtomicStateFileWriteRule(Rule):
+    id = "EDL305"
+    name = "non-atomic-state-file-write"
+    doc = (
+        "open(*.json, 'w') without the tmp-sibling + os.replace idiom — "
+        "a crash mid-write leaves a torn state file"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        consts = _module_str_constants(ctx.tree)
+        yield from self._scan_scope(ctx, ctx.tree, consts)
+
+    def _scan_scope(
+        self, ctx: ModuleContext, scope: ast.AST, consts: dict
+    ) -> Iterator[Finding]:
+        """One function body (or the module top level): flag candidate
+        writes only when the scope never replaces/renames — a scope that
+        does is taken to be implementing the atomic idiom."""
+        candidates: List[ast.Call] = []
+        replaces = False
+        inner: List[ast.AST] = []
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                inner.append(node)
+                continue
+            if isinstance(node, ast.Call):
+                if (
+                    isinstance(node.func, ast.Name)
+                    and node.func.id == "open"
+                    and node.args
+                    and _open_write_mode(node)
+                    and _json_state_path(node.args[0], consts)
+                ):
+                    candidates.append(node)
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in ("replace", "rename")
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "os"
+                ):
+                    replaces = True
+            stack.extend(ast.iter_child_nodes(node))
+        if not replaces:
+            for call in candidates:
+                yield self.finding(
+                    ctx, call,
+                    "non-atomic overwrite of a JSON state file: write a "
+                    ".tmp sibling and os.replace() it (crash mid-write "
+                    "otherwise leaves a torn file for the next reader)",
+                )
+        for fn in inner:
+            yield from self._scan_scope(ctx, fn, consts)
 
 
 @register

@@ -6,12 +6,23 @@ process mode existed only inside tests; here it is a first-class launcher —
 the same Master control plane and ProcessManager drive either subprocesses
 (this module) or pods (client/k8s.py), so a job debugged locally submits to a
 TPU slice unchanged.
+
+Master crash-restart chaos (`--master_restarts`, ISSUE 5): when the
+`master_crash` fault site fires its catchable `drop` flavor inside
+Master.wait, this launcher crashes the master ABRUPTLY (no shutdown
+handshake reaches the workers), rebuilds it on the same port, and rebinds
+the process manager to the successor. The new master replays the
+control-plane journal (master/journal.py), takes over under generation+1,
+and the still-running workers reconnect through the generation handshake —
+no worker process restarts, no lost task accounting.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.main import Master
@@ -20,7 +31,25 @@ from elasticdl_tpu.master.process_manager import ProcessManager
 logger = default_logger(__name__)
 
 
-from elasticdl_tpu.common.net import bind_with_retry, free_port  # noqa: F401  (re-export)
+from elasticdl_tpu.common.net import PortBindError, bind_with_retry, free_port  # noqa: F401  (re-export)
+
+
+def _rebuild_master(cfg: JobConfig, attempts: int = 20) -> Master:
+    """Construct the successor master on the SAME address the workers hold.
+    The crashed server's port can linger for a beat after grpc stop, so a
+    lost bind is retried briefly rather than failing the recovery."""
+    last: Optional[Exception] = None
+    for _ in range(attempts):
+        try:
+            return Master(cfg)
+        except PortBindError as e:
+            last = e
+            # ONE local launcher waiting for its own crashed server's port
+            # to free — no fleet to desynchronize: edl-lint: disable=EDL304
+            time.sleep(0.25)
+    raise RuntimeError(
+        f"master restart could not rebind {cfg.master_addr}: {last}"
+    )
 
 
 def run_local(
@@ -50,11 +79,35 @@ def run_local(
         job_finished_fn=master.dispatcher.finished,
         # planned resizes quiesce through the heartbeat should_checkpoint bit
         checkpoint_request_fn=lambda: master.servicer.request_checkpoint(0),
+        journal=master.journal,
     )
     master.start()
     manager.start_workers()
+    deadline = time.time() + timeout_s if timeout_s else None
+    restarts_left = cfg.master_restarts
     try:
-        ok = master.wait(timeout_s=timeout_s, abort_fn=manager.all_failed)
+        while True:
+            remaining = deadline - time.time() if deadline else None
+            try:
+                ok = master.wait(timeout_s=remaining, abort_fn=manager.all_failed)
+                break
+            except faults.FaultInjected as e:
+                if e.site != "master_crash" or restarts_left <= 0:
+                    raise
+                restarts_left -= 1
+                logger.warning(
+                    "master crash injected (%s); restarting in place "
+                    "(%d restart(s) left)", e, restarts_left,
+                )
+                master.crash()
+                master = _rebuild_master(cfg)
+                manager.rebind_master(
+                    master.membership,
+                    master.dispatcher.finished,
+                    lambda m=master: m.servicer.request_checkpoint(0),
+                    journal=master.journal,
+                )
+                master.start()
     finally:
         master.shutdown()
         manager.stop()

@@ -169,6 +169,23 @@ class JobConfig:
     # the master's pending-membership signal) in a background thread so a
     # resize lands on a warm executable cache (training/compile_cache.py).
     speculative_compile: bool = False
+    # Chaos (local launcher): survive up to this many in-process master
+    # crashes — the `master_crash` fault site's `drop` action raised out of
+    # Master.wait is caught by client/local.py, which crashes the master
+    # abruptly and rebuilds it on the same port; the successor replays the
+    # control-plane journal (requires checkpoint_dir) and workers reconnect
+    # under the bumped generation without restarting. 0 = a master crash
+    # fails the job (the pre-journal behavior).
+    master_restarts: int = 0
+    # fsync every control-journal commit (the crash-durability contract:
+    # a transition is on disk before its effect is observable). Task
+    # lease/report commits happen under the dispatcher lock, so on a
+    # high-latency checkpoint filesystem (NFS / GCS FUSE) per-commit
+    # fsync bounds master dispatch throughput to ~1/fsync-latency
+    # fleet-wide. false trades the last-commit durability window (a crash
+    # may lose transitions still in the page cache; workers then redo the
+    # affected tasks — at-least-once, never silent loss) for throughput.
+    journal_fsync: bool = True
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
@@ -228,6 +245,17 @@ class JobConfig:
             )
         if self.grad_accum_steps < 1:
             raise ValueError("grad_accum_steps must be >= 1")
+        if self.master_restarts < 0:
+            raise ValueError("master_restarts must be >= 0")
+        if self.master_restarts > 0 and not self.checkpoint_dir:
+            # a journal-less successor rebuilds the dispatcher from scratch
+            # — every already-finished task would be recreated and re-run,
+            # silently breaking exactly-once accounting; fail at submit time
+            raise ValueError(
+                "master_restarts requires checkpoint_dir: master recovery "
+                "replays the control-plane journal under "
+                "<checkpoint_dir>/control/"
+            )
         if self.grad_accum_steps > 1 and (
             self.minibatch_size % self.grad_accum_steps
         ):

@@ -34,6 +34,16 @@ Sites threaded through the stack (exact-match, or a `prefix.*` wildcard):
                         (master/process_manager.py); `drop` spawns a process
                         that exits 1 immediately instead of suppressing the
                         spawn (exercising the relaunch path)
+    master_crash        each Master.wait poll iteration (master/main.py) —
+                        the kill-the-master chaos site. `crash` os._exit's
+                        the master process (the true SIGKILL shape when the
+                        master runs in its own process); `drop` raises
+                        FaultInjected out of wait() — the catchable
+                        in-process flavor that client/local.py's
+                        --master_restarts recovery path consumes: the master
+                        is crashed abruptly and rebuilt on the same port,
+                        replaying the control-plane journal
+                        (master/journal.py) under a bumped generation
     metrics_scrape      each /metrics//healthz HTTP request
                         (observability/http.py). Scraping is strictly
                         best-effort, so the terminal actions are remapped

@@ -35,6 +35,7 @@ def write_signal(
     pending_size: Optional[int] = None,
     world_version: int = 0,
     trace_id: Optional[str] = None,
+    master_generation: int = 0,
 ) -> bool:
     """Atomically (re)write the membership signal. Best-effort: a failed
     write is logged and must never take the caller (the master's watch
@@ -43,12 +44,17 @@ def write_signal(
     `trace_id` stitches the resize's observability timeline across roles:
     the master stamps the reform trace id here, workers adopt it for their
     rescale/boot spans (observability/tracing.py) — one resize, one trace
-    id in both `trace.jsonl` files."""
+    id in both `trace.jsonl` files.
+
+    `master_generation` (master/journal.py; 0 = no journal) marks WHICH
+    master wrote the announcement, so a reader — and a successor master at
+    takeover — can tell a live plan from one a dead master left behind."""
     payload = {
         "world_size": int(world_size),
         "pending_size": None if pending_size is None else int(pending_size),
         "world_version": int(world_version),
         "trace_id": trace_id or None,
+        "master_generation": int(master_generation),
     }
     try:
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
@@ -96,3 +102,54 @@ def pending_size(path: Optional[str] = None) -> Optional[int]:
         return int(pending) if pending is not None else None
     except (TypeError, ValueError):
         return None
+
+
+def master_generation(path: Optional[str] = None) -> int:
+    """The generation of the master that wrote the signal (0 = unknown /
+    written by a journal-less master)."""
+    data = read_signal(path)
+    if not data:
+        return 0
+    try:
+        return int(data.get("master_generation") or 0)
+    except (TypeError, ValueError):
+        return 0
+
+
+def default_path(base_dir: str = "") -> str:
+    """Where the signal file lives for this process: the exported env path
+    when the process manager set one, else `<base_dir>/membership_signal.json`
+    (the manager's own default base is its log dir or the checkpoint dir).
+    "" when neither is known."""
+    env_path = os.environ.get(ENV_VAR, "")
+    if env_path:
+        return env_path
+    return os.path.join(base_dir, "membership_signal.json") if base_dir else ""
+
+
+def clear_stale_on_takeover(path: str, *, master_generation: int) -> bool:
+    """A restarted master takes over: drop the dead master's announced plan
+    (pending world size + reform trace id) so workers' speculative
+    compilers stop precompiling against it, and stamp the file with the new
+    master generation. The observed world_size/world_version survive — they
+    describe the workers, which did not restart. No file, nothing stale:
+    returns False without creating one (the next real announcement will).
+    """
+    data = read_signal(path)
+    if data is None:
+        return False
+    ok = write_signal(
+        path,
+        world_size=int(data.get("world_size") or 0),
+        pending_size=None,
+        world_version=int(data.get("world_version") or 0),
+        trace_id=None,
+        master_generation=master_generation,
+    )
+    if ok:
+        logger.warning(
+            "membership signal cleared at master takeover (generation %d): "
+            "pending plan %r dropped", master_generation,
+            data.get("pending_size"),
+        )
+    return ok

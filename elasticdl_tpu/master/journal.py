@@ -1,0 +1,488 @@
+"""Durable control-plane journal: the master's crash-recovery WAL.
+
+The master is the last single point of failure in the stack — the
+dispatcher's todo/doing queues, the membership registry, and the process
+manager's world version live only in memory, so a master crash used to
+lose exactly-once task accounting and strand every worker even though
+their model state and compile caches survived. This module makes the
+control plane durable the same way the data plane already is (orbax
+checkpoints): an append-only, fsync-on-commit journal of state
+*transitions*, replayed on the next master boot.
+
+Layout (under ``<checkpoint_dir>/control/``):
+
+    journal.jsonl       one JSON record per line:
+        line 1          {"t": "header", "v": 1, "generation": G}
+        line 2 (opt)    {"t": "snapshot", ...}   compacted prior state
+        line 3..        incremental transition records
+
+Records (appended by TaskDispatcher / Membership / ProcessManager inside
+their own ``_lock`` critical sections, so the journal order IS the
+mutation order):
+
+    task_create / task_lease / task_finish / task_requeue / task_drop /
+    task_fail / epoch_advance / epoch_end / training_done / job_end /
+    stop_training                      — dispatcher task lifecycle
+    member_join / member_death         — membership transitions
+    world_version                      — cohort world-version bumps
+
+Durability contract: ``append`` returns only after the record is flushed
+and fsynced, so any transition the master *acted on* (a lease granted, a
+report accepted) is on disk before the effect is observable — a crash can
+lose at most a transition that no one was told about yet.
+
+Recovery contract: opening an existing journal replays it to the final
+state, **bumps the master generation**, and atomically rotates the file
+(tmp + ``os.replace``) to a fresh header + compacted snapshot. In-flight
+leases are conservatively requeued at the FRONT of todo (the crashed
+master cannot know whether the worker finished; the report, if it ever
+arrives, carries a pre-crash generation and is fenced — proto/service.py).
+A torn tail line (crash mid-append) is dropped, not fatal.
+
+What is and isn't replayed: task accounting, membership, epoch/job flags,
+and the world version are; evaluation-service aggregation state, mean-loss
+accumulators and summary streams are NOT (they are derived/advisory —
+an eval job interrupted by a master crash re-reports or re-runs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability.registry import default_registry
+
+logger = default_logger(__name__)
+
+JOURNAL_VERSION = 1
+JOURNAL_DIRNAME = "control"
+JOURNAL_FILENAME = "journal.jsonl"
+
+_reg = default_registry()
+_APPENDS = _reg.counter(
+    "edl_journal_appends_total", "control-plane journal records committed")
+_REPLAYED = _reg.counter(
+    "edl_journal_replayed_records_total",
+    "journal records replayed at master boot")
+_ROTATIONS = _reg.counter(
+    "edl_journal_rotations_total",
+    "atomic journal rotations (every recovery compacts)")
+_DROPPED = _reg.counter(
+    "edl_journal_dropped_lines_total",
+    "unparseable journal lines skipped during replay (torn tail)")
+_RECOVERIES = _reg.counter(
+    "edl_master_recoveries_total", "master boots that replayed a journal")
+_GENERATION = _reg.gauge(
+    "edl_master_generation", "current master generation")
+
+
+@dataclass
+class DispatcherState:
+    """Replayed dispatcher state (what TaskDispatcher restores from)."""
+
+    todo: List[Dict[str, Any]] = field(default_factory=list)
+    next_task_id: int = 1
+    epoch: int = -1
+    num_epochs: Optional[int] = None
+    finished_training: int = 0
+    failed_permanently: int = 0
+    completed_versions: int = 0
+    epoch_end_fired: bool = False
+    job_end_fired: bool = False
+    stop_training: bool = False
+    training_done: bool = False
+    save_model_created: bool = False
+    requeued_leases: int = 0
+
+
+@dataclass
+class MembershipState:
+    """Replayed membership registry (liveness clocks restart at takeover)."""
+
+    workers: List[Dict[str, Any]] = field(default_factory=list)
+    next_id: int = 0
+    version: int = 0
+
+
+@dataclass
+class ReplayResult:
+    prior_generation: int = 0
+    records: int = 0
+    dropped_lines: int = 0
+    dispatcher: Optional[DispatcherState] = None
+    membership: Optional[MembershipState] = None
+    world_version: int = 0
+
+
+def _replay_dispatcher(
+    state: DispatcherState, doing: Dict[int, Dict[str, Any]],
+    rtype: str, rec: Dict[str, Any],
+) -> None:
+    """Apply one dispatcher transition record to the replay state."""
+
+    def take_todo(task_id: int) -> Optional[Dict[str, Any]]:
+        for i, t in enumerate(state.todo):
+            if t["task_id"] == task_id:
+                return state.todo.pop(i)
+        return None
+
+    if rtype == "task_create":
+        task = dict(rec["task"])
+        if rec.get("front"):
+            state.todo.insert(0, task)
+        else:
+            state.todo.append(task)
+        state.next_task_id = max(state.next_task_id, task["task_id"] + 1)
+        if task.get("type") == _SAVE_MODEL_TYPE:
+            state.save_model_created = True
+    elif rtype == "task_lease":
+        task = take_todo(rec["task_id"])
+        if task is not None:
+            doing[rec["task_id"]] = task
+    elif rtype == "task_finish":
+        doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
+        if rec.get("training"):
+            state.finished_training += 1
+            state.completed_versions += 1
+    elif rtype == "task_requeue":
+        task = doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
+        if task is not None:
+            task["start"] = rec.get("start", task["start"])
+            task["retries"] = rec.get("retries", task.get("retries", 0))
+            state.todo.insert(0, task)
+    elif rtype == "task_drop":
+        doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
+    elif rtype == "task_fail":
+        doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
+        state.failed_permanently += 1
+    elif rtype == "epoch_advance":
+        state.epoch = rec["epoch"]
+        state.epoch_end_fired = False
+    elif rtype == "epoch_end":
+        if rec.get("epoch", state.epoch) == state.epoch:
+            state.epoch_end_fired = True
+    elif rtype == "training_done":
+        state.training_done = True
+    elif rtype == "job_end":
+        state.job_end_fired = True
+    elif rtype == "stop_training":
+        state.stop_training = True
+        state.num_epochs = rec.get("num_epochs", state.num_epochs)
+        state.todo = [t for t in state.todo if t.get("type") != _TRAINING_TYPE]
+
+
+# pb.TRAINING / pb.EVALUATION / pb.SAVE_MODEL without importing protobuf
+# here (the journal must stay importable in protobuf-free tooling
+# contexts); a test pins these to the generated enum values.
+_TRAINING_TYPE = 0
+_EVALUATION_TYPE = 1
+_SAVE_MODEL_TYPE = 3
+
+_DISPATCHER_RECORDS = frozenset({
+    "task_create", "task_lease", "task_finish", "task_requeue", "task_drop",
+    "task_fail", "epoch_advance", "epoch_end", "training_done", "job_end",
+    "stop_training",
+})
+
+
+def replay_lines(lines: List[str]) -> ReplayResult:
+    """Replay journal lines to a final state (tolerant of a torn tail)."""
+    result = ReplayResult()
+    dispatcher: Optional[DispatcherState] = None
+    membership: Optional[MembershipState] = None
+    doing: Dict[int, Dict[str, Any]] = {}
+    lease_order: List[int] = []
+
+    def apply(rec: Dict[str, Any]) -> None:
+        nonlocal dispatcher, membership
+        rtype = rec["t"]
+        result.records += 1
+        if rtype == "header":
+            result.prior_generation = int(rec.get("generation", 0))
+        elif rtype == "snapshot":
+            if rec.get("dispatcher") is not None:
+                dispatcher = DispatcherState(**rec["dispatcher"])
+            if rec.get("membership") is not None:
+                membership = MembershipState(**rec["membership"])
+            result.world_version = int(rec.get("world_version", 0))
+        elif rtype in _DISPATCHER_RECORDS:
+            if dispatcher is None:
+                dispatcher = DispatcherState()
+            if rtype == "task_lease":
+                lease_order.append(rec.get("task_id"))
+            _replay_dispatcher(dispatcher, doing, rtype, rec)
+        elif rtype == "member_join":
+            if membership is None:
+                membership = MembershipState()
+            wid = int(rec["worker_id"])
+            for w in membership.workers:
+                if w["worker_id"] == wid:
+                    membership.workers.remove(w)
+                    break
+            membership.workers.append(
+                {"worker_id": wid, "name": rec.get("name", ""), "alive": True}
+            )
+            membership.next_id = max(membership.next_id, wid + 1)
+            membership.version = max(membership.version, int(rec.get("version", 0)))
+        elif rtype == "member_death":
+            if membership is None:
+                membership = MembershipState()
+            for w in membership.workers:
+                if w["worker_id"] == int(rec["worker_id"]):
+                    w["alive"] = False
+            membership.version = max(membership.version, int(rec.get("version", 0)))
+        elif rtype == "world_version":
+            result.world_version = max(result.world_version, int(rec["version"]))
+        else:
+            logger.warning("unknown journal record type %r ignored", rtype)
+
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+            if rec.get("t") == "batch":
+                # a multi-record commit rides ONE line (append_many): it is
+                # applied whole here or dropped whole below — validate
+                # before applying so a corrupt batch can't half-apply
+                subrecs = rec["records"]
+                if not isinstance(subrecs, list) or not all(
+                    isinstance(s, dict) and "t" in s for s in subrecs
+                ):
+                    raise ValueError("malformed batch record")
+            else:
+                rec["t"]                   # KeyError -> dropped below
+                subrecs = [rec]
+        except (ValueError, KeyError, TypeError):
+            # torn tail (crash mid-append) is expected; a garbled line in
+            # the middle is not, but dropping it beats refusing to recover
+            result.dropped_lines += 1
+            _DROPPED.inc()
+            if i < len(lines) - 1:
+                logger.warning(
+                    "journal line %d unparseable (not the tail); skipped", i + 1
+                )
+            continue
+        for sub in subrecs:
+            apply(sub)
+    if dispatcher is not None:
+        # conservative lease recovery: the crashed master cannot know
+        # whether leased work finished — requeue every in-flight lease at
+        # the FRONT (oldest first), exactly once; pre-crash reports are
+        # generation-fenced so nothing is double-counted. dict.fromkeys
+        # dedupes a task that was leased, requeued, and re-leased before
+        # the crash (lease_order carries it twice but it must come back
+        # exactly once, or its records train twice after recovery).
+        requeued = [doing[t] for t in dict.fromkeys(lease_order) if t in doing]
+        if dispatcher.stop_training:
+            # the live dispatcher drops in-flight TRAINING work after an
+            # early stop (its requeue path journals task_drop); replay must
+            # not resurrect a training lease the stop already condemned
+            requeued = [t for t in requeued if t.get("type") != _TRAINING_TYPE]
+        # EVALUATION tasks do NOT survive a crash: EvaluationService state
+        # (job ids, metric aggregation) is volatile by contract, so a
+        # replayed eval task would report into a dead eval job id — or
+        # worse, into a post-recovery job that REUSED the id, corrupting
+        # its metrics. The successor re-triggers evaluation fresh instead
+        # (the dispatcher restore re-fires the epoch-end callbacks).
+        requeued = [t for t in requeued if t.get("type") != _EVALUATION_TYPE]
+        dispatcher.todo = [
+            t for t in dispatcher.todo if t.get("type") != _EVALUATION_TYPE
+        ]
+        dispatcher.todo = requeued + dispatcher.todo
+        dispatcher.requeued_leases = len(requeued)
+    result.dispatcher = dispatcher
+    result.membership = membership
+    return result
+
+
+class ControlPlaneJournal:
+    """Append-only WAL with atomic rotation and a persisted generation.
+
+    Thread-safe; appends are called from inside the dispatcher's and
+    membership's ``_lock`` critical sections (lock order: owner lock ->
+    journal ``_lock``; the journal never calls back out, so no cycle).
+    """
+
+    def __init__(self, checkpoint_dir: str, fsync: bool = True):
+        self.dir = os.path.join(checkpoint_dir, JOURNAL_DIRNAME)
+        self.path = os.path.join(self.dir, JOURNAL_FILENAME)
+        self._fsync = fsync
+        self._lock = threading.Lock()
+        self._fh = None                      # guarded_by: _lock
+        self.generation = 1
+        self.recovered = False
+        self.replay: Optional[ReplayResult] = None
+        self._open()
+
+    # -------------------------------------------------------------- #
+    # open / rotate / replay
+
+    def _open(self) -> None:  # holds: _lock (construction)
+        os.makedirs(self.dir, exist_ok=True)
+        if os.path.exists(self.path):
+            with open(self.path, encoding="utf-8") as f:
+                lines = f.readlines()
+            self.replay = replay_lines(lines)
+            _REPLAYED.inc(self.replay.records)
+            self.generation = self.replay.prior_generation + 1
+            self.recovered = True
+            _RECOVERIES.inc()
+            logger.warning(
+                "control journal replayed: %d records (%d dropped), prior "
+                "generation %d -> %d, %d in-flight lease(s) requeued",
+                self.replay.records, self.replay.dropped_lines,
+                self.replay.prior_generation, self.generation,
+                (self.replay.dispatcher.requeued_leases
+                 if self.replay.dispatcher else 0),
+            )
+        self._rotate_locked()
+        self._fh = open(self.path, "a", encoding="utf-8")
+        _GENERATION.set(self.generation)
+
+    def _fsync_dir(self) -> None:
+        """Make the directory entry durable: file-level fsync alone does
+        not persist a newly created or os.replace'd NAME on POSIX — a host
+        crash could drop the whole journal despite every append having
+        been fsynced, and the successor would rebuild from scratch."""
+        try:
+            fd = os.open(self.dir, os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass
+        finally:
+            os.close(fd)
+
+    def _rotate_locked(self) -> None:
+        """Atomically (re)write the journal as header + compacted snapshot.
+        Runs before the append handle opens (single-threaded boot)."""
+        tmp = self.path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(json.dumps(
+                {"t": "header", "v": JOURNAL_VERSION,
+                 "generation": self.generation}
+            ) + "\n")
+            if self.replay is not None and (
+                self.replay.dispatcher is not None
+                or self.replay.membership is not None
+                or self.replay.world_version
+            ):
+                f.write(json.dumps({
+                    "t": "snapshot",
+                    "dispatcher": (
+                        asdict(self.replay.dispatcher)
+                        if self.replay.dispatcher is not None else None
+                    ),
+                    "membership": (
+                        asdict(self.replay.membership)
+                        if self.replay.membership is not None else None
+                    ),
+                    "world_version": self.replay.world_version,
+                }) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+        self._fsync_dir()
+        _ROTATIONS.inc()
+
+    # -------------------------------------------------------------- #
+    # replayed-state accessors (None = nothing to restore)
+
+    def dispatcher_snapshot(self) -> Optional[DispatcherState]:
+        if self.replay is None:
+            return None
+        return self.replay.dispatcher
+
+    def membership_snapshot(self) -> Optional[MembershipState]:
+        if self.replay is None:
+            return None
+        return self.replay.membership
+
+    @property
+    def world_version(self) -> int:
+        return self.replay.world_version if self.replay is not None else 0
+
+    # -------------------------------------------------------------- #
+    # append path
+
+    def append(self, rtype: str, **fields: Any) -> None:
+        """Commit one transition record: write + flush + fsync."""
+        self.append_many([(rtype, fields)])
+
+    def append_many(self, records: List[Tuple[str, Dict[str, Any]]]) -> None:
+        """Commit a batch of records under ONE fsync (bulk task creation).
+
+        A multi-record batch is serialized as ONE ``batch`` line: a large
+        batch can span several write(2) syscalls, and a crash between them
+        must not persist a parseable prefix (an ``epoch_advance`` with only
+        some of its ``task_create`` lines would replay a partial epoch).
+        One line is either whole at replay or a torn tail dropped whole —
+        the batch commits all-or-nothing."""
+        if not records:
+            return
+        if len(records) == 1:
+            rtype, fields = records[0]
+            data = json.dumps({"t": rtype, **fields}) + "\n"
+        else:
+            data = json.dumps({
+                "t": "batch",
+                "records": [
+                    {"t": rtype, **fields} for rtype, fields in records
+                ],
+            }) + "\n"
+        with self._lock:
+            if self._fh is None:
+                # post-close append (a component outliving its master after
+                # crash_stop): dropping is correct — a NEW master owns the
+                # file now, and interleaving two writers would corrupt it
+                logger.warning(
+                    "journal append after close dropped (%d record(s))",
+                    len(records),
+                )
+                return
+            self._fh.write(data)
+            self._fh.flush()
+            if self._fsync:
+                os.fsync(self._fh.fileno())
+        _APPENDS.inc(len(records))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    if self._fsync:
+                        os.fsync(self._fh.fileno())
+                finally:
+                    self._fh.close()
+                    self._fh = None
+
+    def discard(self) -> None:
+        """Clean-completion teardown: close the journal and retire it to
+        ``journal.jsonl.completed``. Only for a job that actually finished —
+        a live journal whose replay says training_done/job_end would make a
+        later re-submission with the same checkpoint_dir come up
+        born-finished and silently no-op. The rename (not a delete) keeps
+        the final generation + accounting on disk for forensics. Crash and
+        abort paths never call this; they keep the journal live so the
+        successor recovers from it."""
+        self.close()
+        try:
+            os.replace(self.path, self.path + ".completed")
+            self._fsync_dir()
+        except OSError:
+            # the journal survived with job_end on it: the next submission
+            # reusing this checkpoint_dir will replay it and no-op — that
+            # MUST be diagnosable from the logs
+            logger.exception(
+                "journal retirement failed; a re-submission against %s will "
+                "replay a finished job", self.path,
+            )

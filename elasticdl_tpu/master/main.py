@@ -13,11 +13,13 @@ import sys
 import time
 from typing import List, Optional
 
+from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import JobType
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
+from elasticdl_tpu.master.journal import ControlPlaneJournal
 from elasticdl_tpu.master.membership import Membership
 from elasticdl_tpu.master.servicer import MasterServicer
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
@@ -41,6 +43,31 @@ class Master:
         self._k8s_api = k8s_api
         self.instance_manager = None
 
+        # Bind the serving port BEFORE the journal opens: every journal
+        # open replays + rotates + bumps the generation, so a lost bind
+        # (the crashed predecessor's port lingering for a beat — exactly
+        # what _rebuild_master retries through) must fail before any
+        # generation is committed, or each retry inflates it past the real
+        # restart count. add_insecure_port is legal before handlers are
+        # registered; PortBindError (a RuntimeError) lets launchers that
+        # picked the port via free_port() retry with a fresh one
+        # (net.bind_with_retry). Depending on grpc version, a lost bind
+        # returns 0 or raises.
+        self.summary = None
+        self.journal: Optional[ControlPlaneJournal] = None
+        self.server = make_server()
+        port = int(cfg.master_addr.rsplit(":", 1)[1])
+        from elasticdl_tpu.common.net import PortBindError
+
+        try:
+            bound = self.server.add_insecure_port(f"[::]:{port}")
+        except RuntimeError as e:
+            self._release_on_bind_failure()
+            raise PortBindError(f"could not bind master port {port}: {e}") from e
+        if bound == 0:
+            self._release_on_bind_failure()
+            raise PortBindError(f"could not bind master port {port}")
+
         def shards_for(path: str):
             if not path:
                 return []
@@ -62,6 +89,30 @@ class Master:
             else []
         )
 
+        # Control-plane durability (master/journal.py): with a checkpoint
+        # dir, task/membership state transitions are journaled and a master
+        # restart replays them — a crash becomes a recoverable event instead
+        # of a job-killing one. Opening the journal FIRST (before dispatcher
+        # and membership) means their constructors see the replayed state.
+        self.journal = (
+            ControlPlaneJournal(cfg.checkpoint_dir, fsync=cfg.journal_fsync)
+            if cfg.checkpoint_dir else None
+        )
+        if self.journal is not None and self.journal.recovered:
+            tracing.event(
+                "master.recovered", generation=self.journal.generation,
+            )
+            # A dead master's announced resize plan must not outlive it:
+            # clear the membership signal's pending world size + reform
+            # trace id (workers' speculative compilers would otherwise keep
+            # precompiling against the dead plan) and stamp our generation.
+            from elasticdl_tpu.common import membership_signal
+
+            signal_path = membership_signal.default_path(cfg.checkpoint_dir)
+            if signal_path:
+                membership_signal.clear_stale_on_takeover(
+                    signal_path, master_generation=self.journal.generation
+                )
         self.dispatcher = TaskDispatcher(
             training_shards=train_shards,
             evaluation_shards=eval_shards,
@@ -75,8 +126,12 @@ class Master:
             # end-of-job durability: one exclusive SAVE_MODEL task before
             # job-end whenever training checkpoints somewhere (SURVEY §2.1)
             final_save_model=bool(cfg.checkpoint_dir) and bool(train_shards),
+            journal=self.journal,
         )
-        self.membership = Membership(heartbeat_timeout_s=3 * cfg.worker_heartbeat_s)
+        self.membership = Membership(
+            heartbeat_timeout_s=3 * cfg.worker_heartbeat_s,
+            journal=self.journal,
+        )
         self.membership.add_death_callback(self.dispatcher.recover_tasks)
 
         metrics = None
@@ -104,7 +159,6 @@ class Master:
             if eval_shards
             else None
         )
-        self.summary = None
         if cfg.summary_dir:
             from elasticdl_tpu.master.summary_service import SummaryService
 
@@ -114,6 +168,9 @@ class Master:
         self.servicer = MasterServicer(
             self.dispatcher, self.membership, self.evaluation,
             summary_service=self.summary,
+            # journaled masters fence RPCs from before their last restart
+            # (0 = fencing off for volatile masters; proto/service.py)
+            generation=self.journal.generation if self.journal else 0,
         )
         # Zoo callbacks observe job events and act via JobContext (round-3:
         # callbacks() was collected but never invoked — now wired).
@@ -135,22 +192,7 @@ class Master:
                 if hasattr(cb, "on_job_end"):
                     self.dispatcher.add_job_end_callback(cb.on_job_end)
             logger.info("wired %d zoo callback(s)", len(callbacks))
-        self.server = make_server()
         add_master_servicer(self.server, self.servicer)
-        port = int(cfg.master_addr.rsplit(":", 1)[1])
-        from elasticdl_tpu.common.net import PortBindError
-
-        # PortBindError (a RuntimeError) lets launchers that picked the
-        # port via free_port() retry with a fresh one (net.bind_with_retry).
-        # Depending on grpc version, a lost bind returns 0 or raises.
-        try:
-            bound = self.server.add_insecure_port(f"[::]:{port}")
-        except RuntimeError as e:
-            self._release_on_bind_failure()
-            raise PortBindError(f"could not bind master port {port}: {e}") from e
-        if bound == 0:
-            self._release_on_bind_failure()
-            raise PortBindError(f"could not bind master port {port}")
 
     def _release_on_bind_failure(self) -> None:
         """A lost bind abandons this instance (bind_with_retry constructs a
@@ -166,6 +208,13 @@ class Master:
                 self.summary.close()
             except Exception:
                 logger.exception("abandoned master: summary close failed")
+        if self.journal is not None:
+            # two live journal handles would interleave writers on the
+            # same file; the retry's next Master must be the sole owner
+            try:
+                self.journal.close()
+            except Exception:
+                logger.exception("abandoned master: journal close failed")
 
     def start(self) -> None:
         self.server.start()
@@ -205,6 +254,12 @@ class Master:
         permanently — without it a dead job would block forever)."""
         deadline = time.time() + timeout_s if timeout_s else None
         while not self.dispatcher.finished():
+            # chaos hook (common/faults.py): `crash` here is the real
+            # kill-the-master shape for separate-process masters (os._exit,
+            # nothing downstream runs); `drop` raises FaultInjected out of
+            # wait() — the catchable in-process flavor client/local.py's
+            # --master_restarts recovery path consumes
+            faults.fire("master_crash")
             self.membership.reap()
             self.dispatcher.poke()
             if self.summary is not None:
@@ -220,6 +275,32 @@ class Master:
                 return False
             time.sleep(poll_s)
         return True
+
+    def crash(self) -> None:
+        """Simulated hard master death (the `master_crash` fault site /
+        --master_restarts chaos path, for in-process masters that cannot
+        os._exit). Tears the serving surface down ABRUPTLY: in-flight RPCs
+        are cancelled, no shutdown flag reaches workers, no final summary or
+        trace flush happens. The journal is closed without ceremony — every
+        commit was already fsynced at append time, so this loses exactly
+        what a SIGKILL would: nothing that was acknowledged. The successor
+        master replays the journal and takes over under generation+1."""
+        try:
+            # wait for termination so the listener sockets are truly closed
+            # — the successor binds the SAME port and must not race a
+            # half-dead listener (see make_server's so_reuseport note)
+            self.server.stop(None).wait(timeout=5.0)
+        except Exception:
+            logger.exception("crashed master: server stop failed")
+        if self.metrics_server is not None:
+            try:
+                self.metrics_server.stop()
+            except Exception:
+                logger.debug("crashed master: metrics stop failed", exc_info=True)
+            self.metrics_server = None
+        if self.journal is not None:
+            self.journal.close()
+        logger.warning("master CRASHED (simulated): serving stopped abruptly")
 
     def shutdown(self, grace_s: float = 5.0) -> None:
         self.servicer.request_shutdown()
@@ -253,6 +334,16 @@ class Master:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        if self.journal is not None:
+            if self.dispatcher.finished():
+                # clean completion: a journal left behind would make the
+                # next submission reusing this checkpoint_dir replay
+                # job_end/training_done and come up born-finished
+                self.journal.discard()
+            else:
+                # aborted/timed-out shutdown: keep the journal — a resume
+                # against the same checkpoint_dir recovers from it
+                self.journal.close()
         from elasticdl_tpu.observability import tracing
 
         tracing.get_tracer().close()

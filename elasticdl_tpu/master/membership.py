@@ -47,8 +47,13 @@ class WorkerInfo:
 
 
 class Membership:
-    def __init__(self, heartbeat_timeout_s: float = 30.0):
+    def __init__(self, heartbeat_timeout_s: float = 30.0, journal=None):
         self._lock = threading.Lock()
+        # Crash durability (master/journal.py): join/death transitions are
+        # committed inside the _lock critical sections that apply them, so
+        # a restarted master replays the registry instead of telling every
+        # reconnecting worker to shut down as an unknown. None = volatile.
+        self._journal = journal
         self._workers: Dict[int, WorkerInfo] = {}    # guarded_by: _lock
         self._next_id = 0                            # guarded_by: _lock
         self._version = 0                            # guarded_by: _lock
@@ -57,6 +62,39 @@ class Membership:
         # single-threaded); mark_dead iterates OUTSIDE the lock on purpose —
         # callbacks re-enter the dispatcher
         self._death_callbacks: List[Callable[[int], None]] = []
+        snap = journal.membership_snapshot() if journal is not None else None
+        if snap is not None:
+            self._restore(snap)
+
+    def _restore(self, snap) -> None:  # holds: _lock (construction)
+        """Rebuild the registry from a replayed journal (master recovery).
+        Runs during __init__ (single-threaded). Liveness clocks restart at
+        takeover: every restored-alive worker gets a fresh heartbeat stamp,
+        so the reaper gives reconnecting workers a full timeout window
+        before declaring anyone dead under the new generation."""
+        now = time.time()
+        for w in snap.workers:
+            wid = int(w["worker_id"])
+            self._workers[wid] = WorkerInfo(
+                worker_id=wid,
+                name=w.get("name", ""),
+                last_heartbeat=now,
+                alive=bool(w.get("alive", True)),
+            )
+        self._next_id = snap.next_id
+        self._version = snap.version
+        _MB_ALIVE.set(self._alive_count_locked())
+        _MB_VERSION.set(self._version)
+        logger.warning(
+            "membership restored from control journal: v%d, %d worker(s) "
+            "(%d alive)", self._version, len(self._workers),
+            self._alive_count_locked(),
+        )
+
+    def _j(self, rtype: str, **fields) -> None:  # holds: _lock
+        """Commit one journal record (no-op without a journal)."""
+        if self._journal is not None:
+            self._journal.append(rtype, **fields)
 
     def add_death_callback(self, cb: Callable[[int], None]) -> None:
         """cb(worker_id) fires when a worker is declared dead — wire this to
@@ -77,6 +115,9 @@ class Membership:
             self._workers[wid] = info
             self._version += 1
             version = self._version     # the version THIS join created
+            self._j(
+                "member_join", worker_id=wid, name=name, version=version
+            )
             _MB_REGISTERED.inc()
             _MB_ALIVE.set(self._alive_count_locked())
             _MB_VERSION.set(self._version)
@@ -86,6 +127,43 @@ class Membership:
             )
         tracing.event(
             "membership.join", worker_id=info.worker_id, worker_name=name,
+            version=version,
+        )
+        return info
+
+    def reregister(self, worker_id: int, name: str) -> WorkerInfo:
+        """Idempotent re-register of a worker that was ALREADY a member —
+        the reconnect handshake after a master restart. A live worker's
+        entry is refreshed in place with NO version bump (the worker set
+        did not change, so the cohort must not re-form); a worker that was
+        reaped during the outage is revived (that IS a membership change —
+        version bumps and the join is journaled). Unknown ids fall through
+        to a fresh registration, so a journal-less master still converges.
+        """
+        with self._lock:
+            info = self._workers.get(worker_id)
+            if info is not None:
+                info.name = name or info.name
+                info.last_heartbeat = time.time()
+                revived = not info.alive
+                if revived:
+                    info.alive = True
+                    self._version += 1
+                    self._j(
+                        "member_join", worker_id=worker_id, name=info.name,
+                        version=self._version,
+                    )
+                    _MB_ALIVE.set(self._alive_count_locked())
+                    _MB_VERSION.set(self._version)
+                version = self._version
+                logger.info(
+                    "worker %d (%s) re-registered%s; membership v%d",
+                    worker_id, name, " (revived)" if revived else "", version,
+                )
+        if info is None:
+            return self.register(name, preferred_id=worker_id)
+        tracing.event(
+            "membership.reregister", worker_id=worker_id, worker_name=name,
             version=version,
         )
         return info
@@ -107,6 +185,7 @@ class Membership:
             info.alive = False
             self._version += 1
             version = self._version     # the version THIS death created
+            self._j("member_death", worker_id=worker_id, version=version)
             _MB_DEATHS.inc()
             _MB_ALIVE.set(self._alive_count_locked())
             _MB_VERSION.set(self._version)

@@ -81,9 +81,15 @@ class ProcessManager:
         checkpoint_request_fn=None,
         resize_checkpoint_timeout_s: float = 30.0,
         membership_signal_path: Optional[str] = None,
+        journal=None,
     ):
         self.cfg = cfg
         self._membership = membership
+        # Crash durability (master/journal.py): world-version bumps are
+        # journaled so a restarted master's manager continues the version
+        # sequence instead of rewinding it (workers compare versions to
+        # decide whether a rescale announcement is news). None = volatile.
+        self._journal = journal                      # guarded_by: _lock
         self._extra_env = dict(extra_env or {})
         self._log_dir = log_dir
         # when this returns True, worker exits are final — no relaunches
@@ -103,9 +109,13 @@ class ProcessManager:
         self._next_worker_id = 0                     # guarded_by: _lock
         self._cohort_relaunches = 0                  # guarded_by: _lock
         self._cohort_coordinator = ""                # guarded_by: _lock
-        # dynamic world resizing state (cohort mode)
+        # dynamic world resizing state (cohort mode); a replayed journal
+        # resumes the pre-crash world version so the next reform bumps
+        # PAST it (never backwards past what workers already saw)
         self._cohort_size = self.cfg.num_processes   # guarded_by: _lock
-        self._world_version = 0                      # guarded_by: _lock
+        self._world_version = (                      # guarded_by: _lock
+            journal.world_version if journal is not None else 0
+        )
         self._pending_resize: Optional[int] = None   # guarded_by: _lock
         self._infra_retries = 0                      # guarded_by: _lock
         # world-formation failures (coordinator-port TOCTOU etc.) retry
@@ -124,6 +134,15 @@ class ProcessManager:
                 os.path.join(base, "membership_signal.json") if base else ""
             )
         self._signal_path = membership_signal_path
+        if self._signal_path and journal is not None and journal.recovered:
+            # full master-process restart: the signal file at THIS path
+            # (log_dir-based — Master.__init__'s own takeover clear only
+            # knows checkpoint_dir, which differs whenever log_dir is set)
+            # may still carry the dead predecessor's announced resize plan;
+            # drop it before any worker's speculative compiler reads it
+            membership_signal.clear_stale_on_takeover(
+                self._signal_path, master_generation=journal.generation
+            )
         # one trace id per announced/active resize: stamped into the signal
         # file (workers adopt it) and onto every reform.* span this manager
         # opens, so master + workers share a timeline per resize
@@ -155,6 +174,32 @@ class ProcessManager:
             pending_size=self._pending_resize,
             world_version=self._world_version,
             trace_id=self._reform_trace_id,
+            # which master wrote this plan: a successor master at takeover
+            # clears announcements stamped by its dead predecessor
+            master_generation=(
+                self._journal.generation if self._journal is not None else 0
+            ),
+        )
+
+    def rebind_master(
+        self, membership, job_finished_fn, checkpoint_request_fn, journal=None
+    ) -> None:
+        """Adopt a RESTARTED in-process master (client/local.py's
+        --master_restarts recovery path): swap the control-plane hooks to
+        the successor's membership/dispatcher/servicer and its replayed
+        journal. The worker processes themselves are untouched — they
+        reconnect to the same address under the new generation; only this
+        manager's references move. The announcement is re-stamped so the
+        signal file carries the new master generation immediately."""
+        with self._lock:
+            self._membership = membership
+            self._job_finished_fn = job_finished_fn or (lambda: False)
+            self._checkpoint_request_fn = checkpoint_request_fn
+            self._journal = journal
+            self._announce_locked()
+        logger.warning(
+            "process manager rebound to restarted master (generation %d)",
+            journal.generation if journal is not None else 0,
         )
 
 
@@ -399,6 +444,12 @@ class ProcessManager:
                 self._procs.clear()
                 self._world_version += 1
                 world_version = self._world_version
+                if self._journal is not None:
+                    # committed inside the lock, like every other journaled
+                    # transition: replay restores the version monotonically
+                    self._journal.append(
+                        "world_version", version=world_version
+                    )
                 if new_size != old_size:
                     # a deliberate resize opens a fresh in-place relaunch
                     # budget

@@ -12,15 +12,27 @@ from __future__ import annotations
 import threading
 from typing import Optional
 
+import grpc
 import numpy as np
 
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.membership import Membership
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+from elasticdl_tpu.proto.service import GENERATION_KEY, REREGISTER_KEY
 
 logger = default_logger(__name__)
+
+_reg = default_registry()
+_STALE_GEN_REJECTS = _reg.counter(
+    "edl_master_stale_generation_rejects_total",
+    "RPCs fenced for claiming a pre-restart master generation",
+    labels=("method",))
+_REREGISTERS = _reg.counter(
+    "edl_master_reregistrations_total",
+    "idempotent worker re-registrations (reconnect handshakes)")
 
 
 class MasterServicer:
@@ -31,12 +43,19 @@ class MasterServicer:
         evaluation_service: Optional[EvaluationService] = None,
         wait_backoff_s: float = 2.0,
         summary_service=None,
+        generation: int = 0,
     ):
         self._dispatcher = dispatcher
         self._membership = membership
         self._evaluation = evaluation_service
         self._summary = summary_service
         self._wait_backoff_s = wait_backoff_s
+        # Master generation (master/journal.py header; 0 = fencing off).
+        # Workers claim the generation they registered under on every call;
+        # a claim from before the last master restart is fenced below so a
+        # pre-crash task report can never double-count against the replayed
+        # queue state. Stamped onto trailing metadata by proto/service.py.
+        self.generation = generation
         self._loss_lock = threading.Lock()
         self._loss_sum = 0.0                # guarded_by: _loss_lock
         self._loss_count = 0                # guarded_by: _loss_lock
@@ -50,12 +69,71 @@ class MasterServicer:
         self._shutdown = False
 
     # ------------------------------------------------------------------ #
+    # generation fencing (the server half of the handshake)
+
+    @staticmethod
+    def _request_metadata(context) -> dict:
+        """Invocation metadata as a dict; {} for contexts without it
+        (direct in-process servicer calls in tests pass context=None)."""
+        if context is None:
+            return {}
+        try:
+            return {k: v for k, v in (context.invocation_metadata() or ())}
+        except Exception:
+            # metadata is the handshake channel, not the RPC payload; a
+            # context that can't supply it is an unfenced caller:
+            # edl-lint: disable=EDL303
+            return {}
+
+    def _fence_generation(self, method: str, context) -> None:
+        """Abort with a retriable FAILED_PRECONDITION when the caller
+        claims a master generation other than this master's. The claim is
+        optional (no claim = unfenced legacy caller); the mismatch aborts
+        BEFORE any state mutation, so nothing leased or reported under the
+        dead master's generation ever reaches the replayed queues. Workers
+        react by re-registering (a generation-free RegisterWorker with
+        REREGISTER_KEY), not by dying — see proto/service.py
+        is_stale_generation."""
+        if not self.generation or context is None:
+            return
+        claimed = self._request_metadata(context).get(GENERATION_KEY)
+        if claimed is None:
+            return
+        try:
+            claimed = int(claimed)
+        except (TypeError, ValueError):
+            return
+        if claimed != self.generation:
+            _STALE_GEN_REJECTS.inc(method=method)
+            logger.warning(
+                "%s fenced: stale master generation %d (current %d)",
+                method, claimed, self.generation,
+            )
+            context.abort(
+                grpc.StatusCode.FAILED_PRECONDITION,
+                f"stale master generation {claimed} (current "
+                f"{self.generation}); re-register to continue",
+            )
+
+    # ------------------------------------------------------------------ #
     # rpc handlers (name-matched by proto/service.py)
 
     def RegisterWorker(self, request, context):
-        info = self._membership.register(
-            request.worker_name, request.preferred_id_plus_one - 1
-        )
+        # a register CLAIMING a stale generation is fenced like any other
+        # call — the reconnect handshake clears the claim first
+        self._fence_generation("RegisterWorker", context)
+        preferred = request.preferred_id_plus_one - 1
+        if (
+            self._request_metadata(context).get(REREGISTER_KEY) == "1"
+            and preferred >= 0
+        ):
+            # reconnect of an existing member (e.g. after a master
+            # restart): idempotent — a live worker keeps its id and bumps
+            # nothing, a reaped one is revived; never a duplicate join
+            info = self._membership.reregister(preferred, request.worker_name)
+            _REREGISTERS.inc()
+        else:
+            info = self._membership.register(request.worker_name, preferred)
         return pb.RegisterWorkerResponse(
             worker_id=info.worker_id,
             membership_version=self._membership.version,
@@ -63,6 +141,7 @@ class MasterServicer:
         )
 
     def GetTask(self, request, context):
+        self._fence_generation("GetTask", context)
         if self._dispatcher.finished():
             return pb.GetTaskResponse(job_done=True)
         task = self._dispatcher.get(request.worker_id)
@@ -75,6 +154,7 @@ class MasterServicer:
         return pb.GetTaskResponse(task=task.to_proto())
 
     def ReportTaskResult(self, request, context):
+        self._fence_generation("ReportTaskResult", context)
         accepted = self._dispatcher.report(
             request.task_id,
             request.worker_id,
@@ -102,6 +182,7 @@ class MasterServicer:
         return pb.ReportTaskResultResponse(accepted=accepted)
 
     def ReportEvaluationMetrics(self, request, context):
+        self._fence_generation("ReportEvaluationMetrics", context)
         if self._evaluation is not None:
             states = {
                 s.name: np.frombuffer(s.data, np.float32) for s in request.states
@@ -112,6 +193,7 @@ class MasterServicer:
         return pb.ReportEvaluationMetricsResponse()
 
     def Heartbeat(self, request, context):
+        self._fence_generation("Heartbeat", context)
         known = self._membership.heartbeat(request.worker_id, request.model_version)
         with self._ctrl_lock:
             # one atomic test-and-clear: the flag is one-shot, and two

@@ -12,12 +12,13 @@ reference relied purely on pod-death events, which misses hung workers.
 
 from __future__ import annotations
 
+import dataclasses
 import random
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.observability import tracing
@@ -100,8 +101,15 @@ class TaskDispatcher:
         shuffle_seed: int = 0,
         task_timeout_s: float = 600.0,
         final_save_model: bool = False,
+        journal=None,
     ):
         self._lock = threading.Lock()
+        # Crash durability (master/journal.py): every task lifecycle
+        # transition below is committed to the journal INSIDE the _lock
+        # critical section that applies it, so the on-disk order is the
+        # mutation order and a restarted master replays to exactly this
+        # state. None = volatile dispatcher (no checkpoint_dir).
+        self._journal = journal
         self._training_shards = list(training_shards)
         self._evaluation_shards = list(evaluation_shards or [])
         self._prediction_shards = list(prediction_shards or [])
@@ -138,7 +146,10 @@ class TaskDispatcher:
         self._final_save_model = final_save_model
         self._save_model_created = False             # guarded_by: _lock
 
-        if self._training_shards:
+        snap = journal.dispatcher_snapshot() if journal is not None else None
+        if snap is not None:
+            self._restore(snap)
+        elif self._training_shards:
             self._start_next_epoch()
         else:
             # evaluation-only / prediction-only jobs: no training epochs.
@@ -149,6 +160,56 @@ class TaskDispatcher:
             elif not self._evaluation_shards:
                 # nothing to do at all — the job is born finished
                 self._job_end_fired = True
+
+    def _restore(self, snap) -> None:  # holds: _lock (construction)
+        """Rebuild queue state from a replayed journal (master recovery).
+        Runs during __init__ (single-threaded). In-flight leases were
+        already conservatively requeued by the replay; the shard/config
+        arguments keep only their roles as defaults — the journal is the
+        source of truth for everything it recorded."""
+        self._todo = deque(TaskSpec(**t) for t in snap.todo)
+        self._next_task_id = snap.next_task_id
+        self._epoch = snap.epoch
+        if snap.num_epochs is not None:
+            self._num_epochs = min(self._num_epochs, snap.num_epochs)
+        self._finished_training = snap.finished_training
+        self._failed_permanently = snap.failed_permanently
+        self._completed_versions = snap.completed_versions
+        self._stop_training = snap.stop_training
+        self._save_model_created = snap.save_model_created
+        if self._training_shards:
+            # epoch_end / training_done / job_end CALLBACKS are volatile
+            # (they create eval jobs and run zoo hooks) and run OUTSIDE
+            # the lock that journals the flag — a crash in between would
+            # otherwise skip them forever. Restore the terminal flags as
+            # NOT fired: poke() re-derives them from the replayed queues
+            # and re-fires the callbacks at-least-once (replayed eval
+            # tasks were dropped, so a re-fired epoch-end trigger
+            # recreates its eval job fresh).
+            self._epoch_end_fired = False
+            self._job_end_fired = False
+            self._training_done = False
+        else:
+            # evaluation-/prediction-only: mirror the non-restore init —
+            # no training epochs; an interrupted eval job is re-triggered
+            # by the service, so job-end must be re-derivable
+            self._training_done = True
+            self._epoch_end_fired = snap.epoch_end_fired
+            self._job_end_fired = (
+                snap.job_end_fired if not self._evaluation_shards else False
+            )
+        self._set_queue_gauges_locked()
+        logger.warning(
+            "dispatcher restored from control journal: epoch %d, %d todo "
+            "(%d requeued from in-flight leases), %d finished, %d failed",
+            self._epoch, len(self._todo), snap.requeued_leases,
+            self._finished_training, self._failed_permanently,
+        )
+
+    def _j(self, rtype: str, **fields) -> None:  # holds: _lock
+        """Commit one journal record (no-op without a journal)."""
+        if self._journal is not None:
+            self._journal.append(rtype, **fields)
 
     # ------------------------------------------------------------------ #
     # task creation
@@ -167,6 +228,7 @@ class TaskDispatcher:
     def _create_tasks(  # holds: _lock
         self, shards: List[Shard], task_type: int, eval_job_id: int = -1,
         front: bool = False,
+        journal_prelude: Optional[List[Tuple[str, Dict[str, Any]]]] = None,
     ) -> int:
         spans = self._split(shards)
         if self._shuffle and task_type == pb.TRAINING:
@@ -189,12 +251,30 @@ class TaskDispatcher:
             self._todo.extendleft(reversed(tasks))
         else:
             self._todo.extend(tasks)
+        if self._journal is not None and (tasks or journal_prelude):
+            # one fsync for the whole batch (prelude included); front
+            # batches are journaled in reversed order so sequential
+            # front-insertion on replay reproduces this exact queue order
+            ordered = reversed(tasks) if front else tasks
+            records = list(journal_prelude or [])
+            records.extend(
+                ("task_create", {"task": dataclasses.asdict(t), "front": front})
+                for t in ordered
+            )
+            self._journal.append_many(records)
         return len(tasks)
 
     def _start_next_epoch(self) -> None:  # holds: _lock
         self._epoch += 1
         self._epoch_end_fired = False
-        n = self._create_tasks(self._training_shards, pb.TRAINING)
+        # epoch_advance commits in the SAME fsync as its task batch: a
+        # crash landing between a lone epoch_advance and the creations
+        # would replay an epoch with an empty todo — the successor would
+        # fire epoch_end over zero tasks and skip the epoch's data entirely
+        n = self._create_tasks(
+            self._training_shards, pb.TRAINING,
+            journal_prelude=[("epoch_advance", {"epoch": self._epoch})],
+        )
         logger.info("epoch %d: created %d training tasks", self._epoch, n)
 
     def num_evaluation_tasks(self) -> int:
@@ -229,6 +309,9 @@ class TaskDispatcher:
                 return None
             task = self._todo.popleft()
             self._doing[task.task_id] = _Lease(worker_id, task, time.time())
+            # journaled BEFORE the lease is observable (the RPC response):
+            # a crash after this point replays the lease and requeues it
+            self._j("task_lease", task_id=task.task_id, worker_id=worker_id)
             self._set_queue_gauges_locked()
         # lease-transition event OUTSIDE the lock (file I/O never runs
         # under the dispatcher lock)
@@ -289,6 +372,10 @@ class TaskDispatcher:
                 if task.type == pb.TRAINING:
                     self._finished_training += 1
                     self._completed_versions += 1
+                self._j(
+                    "task_finish", task_id=task_id,
+                    training=task.type == pb.TRAINING,
+                )
                 _TASKS_FINISHED.inc()
             elif preempted:
                 # Drain report: the first `records_processed` records were
@@ -299,6 +386,10 @@ class TaskDispatcher:
                     if task.type == pb.TRAINING:
                         self._finished_training += 1
                         self._completed_versions += 1
+                    self._j(
+                        "task_finish", task_id=task_id,
+                        training=task.type == pb.TRAINING,
+                    )
                 else:
                     task.start += done
                     self._requeue_locked(task, "preemption remainder")
@@ -335,12 +426,18 @@ class TaskDispatcher:
                 "dropping training task %d (%s) after stop request",
                 task.task_id, why,
             )
+            self._j("task_drop", task_id=task.task_id)
             return
         _TASKS_REQUEUED.inc()
         self._todo.appendleft(task)
+        self._j(
+            "task_requeue", task_id=task.task_id, start=task.start,
+            retries=task.retries,
+        )
 
     def _fail_permanently_locked(self, task: TaskSpec, err: str) -> None:
         self._failed_permanently += 1
+        self._j("task_fail", task_id=task.task_id)
         _TASKS_FAILED.inc()
         self._pending_failed.append(task)
         logger.error(
@@ -400,6 +497,7 @@ class TaskDispatcher:
             if not training_left:
                 if self._epoch >= 0 and not self._epoch_end_fired:
                     self._epoch_end_fired = True
+                    self._j("epoch_end", epoch=self._epoch)
                     epoch = self._epoch
                     callbacks.extend(
                         lambda cb=cb: cb(epoch) for cb in self._epoch_end_callbacks
@@ -408,6 +506,7 @@ class TaskDispatcher:
                     self._start_next_epoch()
                 else:
                     self._training_done = True
+                    self._j("training_done")
         if callbacks:
             return callbacks
         if (
@@ -426,20 +525,24 @@ class TaskDispatcher:
                 # interval checkpointing last touched (its report re-enters
                 # here and only then does job-end fire)
                 self._save_model_created = True
-                self._todo.append(
-                    TaskSpec(
-                        task_id=self._next_task_id,
-                        type=pb.SAVE_MODEL,
-                        shard_name="",
-                        start=0,
-                        end=0,
-                        epoch=max(self._epoch, 0),
-                    )
+                save_task = TaskSpec(
+                    task_id=self._next_task_id,
+                    type=pb.SAVE_MODEL,
+                    shard_name="",
+                    start=0,
+                    end=0,
+                    epoch=max(self._epoch, 0),
                 )
+                self._todo.append(save_task)
                 self._next_task_id += 1
+                self._j(
+                    "task_create", task=dataclasses.asdict(save_task),
+                    front=False,
+                )
                 logger.info("created final SAVE_MODEL task")
                 return callbacks
             self._job_end_fired = True
+            self._j("job_end")
             callbacks.extend(self._job_end_callbacks)
         return callbacks
 
@@ -454,6 +557,7 @@ class TaskDispatcher:
             self._todo = deque(t for t in self._todo if t.type != pb.TRAINING)
             dropped = before - len(self._todo)
             self._num_epochs = min(self._num_epochs, self._epoch + 1)
+            self._j("stop_training", num_epochs=self._num_epochs)
             logger.info(
                 "training stop requested (%s): dropped %d queued training "
                 "tasks, no further epochs", reason or "no reason", dropped,

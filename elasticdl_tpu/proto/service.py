@@ -34,6 +34,20 @@ logger = default_logger(__name__)
 
 SERVICE_NAME = "elasticdl_tpu.Master"
 
+#: metadata keys of the master-generation handshake (master/journal.py).
+#: The generation is a monotonic counter persisted in the control-plane
+#: journal header and bumped on every master restart. It rides gRPC
+#: metadata (this image cannot regenerate proto messages): the server
+#: stamps its generation onto every response's trailing metadata; clients
+#: claim the generation they believe current on every call, and the
+#: servicer fences mismatches with FAILED_PRECONDITION so a report leased
+#: under a pre-crash master can never be double-counted by its successor.
+GENERATION_KEY = "edl-master-generation"
+#: marks a RegisterWorker as a RECONNECT of an existing member (idempotent
+#: re-register; no membership-version bump for a live worker) rather than
+#: a fresh join
+REREGISTER_KEY = "edl-reregister"
+
 # control-plane wire metrics (scraped via /metrics; docs/observability.md)
 _reg = default_registry()
 _RPC_CALLS = _reg.counter(
@@ -52,6 +66,12 @@ _BREAKER_OPEN = _reg.gauge(
     "edl_rpc_breaker_open", "1 while the master circuit breaker is open")
 _BREAKER_TRIPS = _reg.counter(
     "edl_rpc_breaker_trips_total", "circuit-breaker open transitions")
+_BREAKER_RESETS = _reg.counter(
+    "edl_rpc_breaker_reset_total",
+    "breaker resets by a successful master-generation handshake")
+_CHANNEL_REFRESHES = _reg.counter(
+    "edl_rpc_channel_refreshes_total",
+    "client channels rebuilt after repeated transport failures")
 _RPC_LATENCY = _reg.histogram(
     "edl_rpc_client_latency_seconds",
     "successful-call wall latency", labels=("method",))
@@ -187,6 +207,29 @@ class CircuitBreaker:
             tracing.event("rpc.breaker_closed")
             logger.info("master circuit closed again (probe succeeded)")
 
+    def reset(self) -> bool:
+        """Clear ALL breaker state (close the circuit, zero the failure
+        count, release any probe slot). The generation-handshake hook: a
+        stale-generation rejection proves the master is back (the fence is
+        an application answer riding a healthy transport), so treating it
+        as one more transport failure would hold the circuit open forever
+        against a live master. Returns True when anything was cleared."""
+        with self._lock:
+            dirty = (
+                self._opened_at is not None
+                or self.consecutive_failures > 0
+                or self._probe_in_flight
+            )
+            self.consecutive_failures = 0
+            self._opened_at = None
+            self._probe_in_flight = False
+        if dirty:
+            _BREAKER_OPEN.set(0)
+            _BREAKER_RESETS.inc()
+            tracing.event("rpc.breaker_reset")
+            logger.info("master circuit reset (generation handshake)")
+        return dirty
+
     def record_failure(self) -> None:
         with self._lock:
             self.consecutive_failures += 1
@@ -209,12 +252,30 @@ class CircuitBreaker:
             )
 
 
-def _traced_handler(name: str, method: Callable) -> Callable:
+def _traced_handler(
+    name: str, method: Callable, generation_fn: Optional[Callable[[], int]] = None
+) -> Callable:
     """Wrap a servicer method so an incoming trace context (gRPC metadata
     set by RetryingMasterStub) re-opens on the handler thread: the worker's
     span becomes the parent of a server-side `rpc.server.<method>` span,
-    and one resize reads as one timeline across both roles."""
+    and one resize reads as one timeline across both roles.
+
+    When `generation_fn` yields a nonzero master generation, it is stamped
+    onto the response's trailing metadata — the server half of the
+    generation handshake (RetryingMasterStub adopts it client-side)."""
     span_name = "rpc.server." + rpc_site(name)[len("rpc."):]
+
+    def stamped(request, context):
+        gen = generation_fn() if generation_fn is not None else 0
+        if gen:
+            try:
+                context.set_trailing_metadata(((GENERATION_KEY, str(gen)),))
+            except Exception:
+                # the handshake is advisory on exotic contexts (in-process
+                # fakes without trailing-metadata support); the RPC itself
+                # must still be served: edl-lint: disable=EDL303
+                pass
+        return method(request, context)
 
     def handler(request, context):
         md = {}
@@ -226,10 +287,10 @@ def _traced_handler(name: str, method: Callable) -> Callable:
             pass
         trace_id = md.get(tracing.TRACE_ID_KEY)
         if not trace_id or name not in _TRACED_SERVER_RPCS:
-            return method(request, context)
+            return stamped(request, context)
         with tracing.adopt(trace_id, md.get(tracing.SPAN_ID_KEY)):
             with tracing.span(span_name):
-                return method(request, context)
+                return stamped(request, context)
 
     return handler
 
@@ -237,8 +298,14 @@ def _traced_handler(name: str, method: Callable) -> Callable:
 def add_master_servicer(server: grpc.Server, servicer: Any) -> None:
     """Register a servicer object exposing methods named after the rpcs."""
     handlers = {}
+    # the generation is read per call, not captured: a MasterServicer built
+    # before its journal replayed (tests) still stamps the final value
+    generation_fn = (
+        (lambda: int(getattr(servicer, "generation", 0) or 0))
+        if hasattr(servicer, "generation") else None
+    )
     for name, (req_t, _resp_t) in _RPCS.items():
-        method = _traced_handler(name, getattr(servicer, name))
+        method = _traced_handler(name, getattr(servicer, name), generation_fn)
         handlers[name] = grpc.unary_unary_rpc_method_handler(
             method,
             request_deserializer=req_t.FromString,
@@ -298,13 +365,36 @@ class RetryingMasterStub:
         rng: Optional[random.Random] = None,
         sleep: Callable[[float], None] = time.sleep,
         stub: Any = None,
+        channel_factory: Optional[Callable[[], grpc.Channel]] = None,
+        refresh_after: int = 3,
     ):
         self._stub = stub if stub is not None else MasterStub(channel)
+        # Bounded reconnect loop for UNAVAILABLE-during-restart: a gRPC
+        # channel whose subchannel wedged against a restarted master (stale
+        # backoff state, dead reuseport flow) can report connect failures
+        # long after the master is back. With a channel_factory, every
+        # `refresh_after` consecutive transport failures the stub REBUILDS
+        # the channel — fresh sockets, fresh resolver — instead of trusting
+        # the wedged one forever. The workers wire this; injected test
+        # stubs don't need it.
+        self._channel = channel
+        self._channel_factory = channel_factory
+        self._refresh_after = max(1, refresh_after)
+        self._transport_failures = 0          # guarded_by: _refresh_lock
+        self._last_refresh = 0.0              # guarded_by: _refresh_lock
+        self._refresh_lock = threading.Lock()
         self._policies = dict(DEFAULT_POLICIES)
         if policies:
             self._policies.update(policies)
         self._on_success = on_success
         self.breaker = breaker if breaker is not None else CircuitBreaker()
+        # The master generation this client believes current (None until
+        # the first handshake). Claimed on every call as gRPC metadata so
+        # the servicer can fence pre-restart stragglers; adopted from the
+        # server's trailing metadata. The OWNER (worker/cohort) clears it
+        # to None before a re-register — a generation-free RegisterWorker
+        # is the handshake that learns the new one.
+        self.generation: Optional[int] = None
         self._backoff_base_s = backoff_base_s
         self._backoff_max_s = backoff_max_s
         self._rng = rng if rng is not None else random.Random()
@@ -319,12 +409,11 @@ class RetryingMasterStub:
         if name not in _RPCS:
             raise AttributeError(name)
         policy = self._policies.get(name) or RpcPolicy(30.0, False)
-        method = getattr(self._stub, name)
         site = rpc_site(name)
         # the closure below is cached on the instance (end of this method):
         # __getattr__ runs once per RPC name, not once per call
 
-        def call(request, timeout: Optional[float] = None):
+        def call(request, timeout: Optional[float] = None, metadata=None):
             attempts = policy.max_attempts if policy.idempotent else 1
             deadline = timeout if timeout is not None else policy.timeout_s
             last: Optional[BaseException] = None
@@ -336,15 +425,33 @@ class RetryingMasterStub:
                         "failures"
                     )
                 t_call = time.perf_counter()
+                # resolved per attempt, not captured: a channel refresh
+                # swaps self._stub and the next attempt must use the NEW
+                # multicallables, not a closed channel's
+                method = getattr(self._stub, name)
                 try:
                     _RPC_CALLS.inc(method=name)
                     faults.fire(site)
                     # the active trace context (a rescale span, a reform
                     # boot) rides the wire as gRPC metadata so the master's
-                    # handler joins the same timeline; no context, no kwarg
-                    # (injected test stubs only take (request, timeout))
-                    md = tracing.rpc_metadata()
-                    if md:
+                    # handler joins the same timeline — alongside the
+                    # generation claim the servicer fences on; no metadata,
+                    # no kwarg (injected test stubs only take
+                    # (request, timeout))
+                    md = list(tracing.rpc_metadata() or ())
+                    if self.generation is not None:
+                        md.append((GENERATION_KEY, str(self.generation)))
+                    if metadata:
+                        md.extend(metadata)
+                    # with_call (real grpc multicallables only) exposes the
+                    # server's trailing metadata — the generation handshake
+                    with_call = getattr(method, "with_call", None)
+                    rpc_call = None
+                    if with_call is not None:
+                        resp, rpc_call = with_call(
+                            request, timeout=deadline, metadata=md or None
+                        )
+                    elif md:
                         resp = method(request, timeout=deadline, metadata=md)
                     else:
                         resp = method(request, timeout=deadline)
@@ -352,8 +459,18 @@ class RetryingMasterStub:
                     # call; the caller never hears back
                     faults.fire(site + ".recv")
                 except self.RETRYABLE as e:
+                    if is_stale_generation(e):
+                        # the master is BACK, under a new generation: this
+                        # is an application-level fence on a healthy
+                        # transport. Clear the breaker (it would otherwise
+                        # re-open on every fenced probe and never close)
+                        # and surface the rejection — the caller owns the
+                        # re-register handshake.
+                        self.breaker.reset()
+                        raise
                     last = e
                     self.breaker.record_failure()
+                    self._note_transport_failure()
                     _RPC_FAILURES.inc(method=name)
                     if _is_deadline_exceeded(e):
                         _RPC_DEADLINE.inc(method=name)
@@ -381,6 +498,10 @@ class RetryingMasterStub:
                     _RPC_FAILURES.inc(method=name)
                     raise
                 self.breaker.record_success()
+                with self._refresh_lock:
+                    self._transport_failures = 0
+                if rpc_call is not None:
+                    self._adopt_generation(rpc_call)
                 _RPC_LATENCY.observe(
                     time.perf_counter() - t_call, method=name)
                 if self._on_success is not None:
@@ -390,6 +511,173 @@ class RetryingMasterStub:
 
         setattr(self, name, call)
         return call
+
+    def _note_transport_failure(self) -> None:
+        """Count a real wire failure; every `refresh_after`-th in a row
+        rebuilds the channel (when a factory was wired). Rate-limited so
+        the worker's heartbeat and task threads don't thrash a rebuild."""
+        if self._channel_factory is None:
+            return
+        with self._refresh_lock:
+            self._transport_failures += 1
+            now = time.monotonic()
+            if (
+                self._transport_failures % self._refresh_after != 0
+                or now - self._last_refresh < 2.0
+            ):
+                return
+            self._last_refresh = now
+            old = self._channel
+            try:
+                self._channel = self._channel_factory()
+                # swap the stub LAST: concurrent calls resolve their
+                # multicallable per attempt off self._stub
+                self._stub = MasterStub(self._channel)
+            except Exception:
+                logger.exception("channel refresh failed; keeping old channel")
+                self._channel = old
+                return
+            failures = self._transport_failures
+        _CHANNEL_REFRESHES.inc()
+        tracing.event("rpc.channel_refresh", consecutive_failures=failures)
+        logger.warning(
+            "rebuilt master channel after %d consecutive transport "
+            "failures (stale subchannel state survives a master restart)",
+            failures,
+        )
+        # The old channel is NOT force-closed: the stub is shared between
+        # threads (heartbeat + task loop), and Channel.close() CANCELS every
+        # in-flight RPC on it — a healthy non-idempotent ReportTaskResult
+        # racing the refresh would be killed and never retried, expiring the
+        # lease and re-running the task. Dropping the reference lets grpc
+        # tear it down once the last in-flight call off it completes.
+
+    def _adopt_generation(self, rpc_call: Any) -> None:
+        """Read the master generation off a successful call's trailing
+        metadata. Adopting a CHANGED generation is the handshake landing:
+        the breaker is reset (edl_rpc_breaker_reset_total) so the restart's
+        accumulated failures stop penalizing the recovered master."""
+        try:
+            trailing = rpc_call.trailing_metadata() or ()
+        except Exception:
+            # trailing metadata is the advisory half of the handshake;
+            # a call object without it is not an error:
+            # edl-lint: disable=EDL303
+            return
+        gen = None
+        for k, v in trailing:
+            if k == GENERATION_KEY:
+                try:
+                    gen = int(v)
+                except (TypeError, ValueError):
+                    return
+                break
+        if not gen:
+            return
+        prev, self.generation = self.generation, gen
+        if prev is not None and prev != gen:
+            self.breaker.reset()
+            tracing.event(
+                "rpc.generation_handshake", prev_generation=prev,
+                generation=gen,
+            )
+            logger.warning(
+                "master generation handshake: %d -> %d (master restarted)",
+                prev, gen,
+            )
+
+
+def is_stale_generation(e: BaseException) -> bool:
+    """True for the servicer's stale-master-generation fence: a
+    FAILED_PRECONDITION whose details name the generation. Callers react by
+    re-registering (clear `stub.generation`, RegisterWorker with
+    REREGISTER_KEY), then re-leasing — never by treating the master as
+    dead."""
+    code = getattr(e, "code", None)
+    details = getattr(e, "details", None)
+    try:
+        return (
+            callable(code)
+            and code() == grpc.StatusCode.FAILED_PRECONDITION
+            and callable(details)
+            and "generation" in str(details())
+        )
+    except Exception:
+        # classification-only: an exotic error object is simply not a
+        # stale-generation fence: edl-lint: disable=EDL303
+        return False
+
+
+def register_with_retry(
+    stub: "RetryingMasterStub",
+    *,
+    name: str,
+    preferred_id: int,
+    window_s: float,
+    shutdown: threading.Event,
+    what: str = "worker",
+):
+    """Boot-time registration hardened against a master that is down or
+    RESTARTING right now (observed: a master crash with the registration
+    handler already run server-side cancels the response — the join is
+    journaled but this process never hears its id, and an unretried failure
+    kills the whole worker, recovering only via the relaunch budget and
+    leaving a ghost member). RegisterWorker is not blindly retriable (a
+    duplicate plain join allocates a second id), so retries with a known
+    ``preferred_id`` carry the REREGISTER marker: the successor master
+    treats them as an idempotent reconnect of the journaled member.
+
+    Bounded by the same clock that governs all master-unreachable
+    decisions; ``window_s <= 0`` means that clock is DISABLED (config.py:
+    "0 disables") — retry until ``shutdown`` fires, never give up on the
+    master. Shared by worker.py and cohort.py so the handshake cannot
+    diverge between the two worker flavors."""
+    deadline = (time.monotonic() + window_s) if window_s > 0 else None
+    attempt = 0
+    while True:
+        request = pb.RegisterWorkerRequest(
+            worker_name=name,
+            preferred_id_plus_one=preferred_id + 1 if preferred_id >= 0 else 0,
+        )
+        metadata = (
+            ((REREGISTER_KEY, "1"),) if attempt and preferred_id >= 0 else None
+        )
+        try:
+            return stub.RegisterWorker(request, timeout=30, metadata=metadata)
+        except Exception as e:
+            attempt += 1
+            if is_stale_generation(e):
+                # raced a restart mid-handshake: drop the adopted claim
+                # and register fresh against the successor
+                stub.generation = None
+            elif deadline is not None and time.monotonic() >= deadline:
+                raise
+            logger.warning(
+                "%s boot registration failed (attempt %d): %s; retrying",
+                what, attempt, e,
+            )
+            shutdown.wait(random.uniform(0.5, 1.5))
+            if shutdown.is_set():
+                raise
+
+
+def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int):
+    """The reconnect handshake after a master restart: clear the stale
+    generation claim (a generation-free RegisterWorker is what learns the
+    new one from the response's trailing metadata), then re-register under
+    the EXISTING worker id with the REREGISTER marker — the restarted
+    master treats it as an idempotent reconnect of a replayed member, not
+    a fresh join (no membership-version bump for a live worker, so the
+    cohort does not re-form). Callers apply the response to their own
+    state; shared by worker.py and cohort.py."""
+    stub.generation = None
+    return stub.RegisterWorker(
+        pb.RegisterWorkerRequest(
+            worker_name=name, preferred_id_plus_one=worker_id + 1,
+        ),
+        timeout=30,
+        metadata=((REREGISTER_KEY, "1"),),
+    )
 
 
 def _is_deadline_exceeded(e: BaseException) -> bool:
@@ -418,5 +706,14 @@ def make_server(max_workers: int = 32) -> grpc.Server:
     from concurrent import futures
 
     return grpc.server(
-        futures.ThreadPoolExecutor(max_workers=max_workers), options=GRPC.OPTIONS
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        # so_reuseport off: gRPC's default SO_REUSEPORT lets a successor
+        # master "successfully" bind a port whose previous (crashed, not
+        # yet fully closed) server still holds a listener in the reuseport
+        # group — the kernel then keeps hashing existing clients' reconnect
+        # flows onto the dead socket and they see connection-refused until
+        # it finally closes. An exclusive bind fails HONESTLY (0 /
+        # RuntimeError -> PortBindError -> retry) until the port is truly
+        # free, which is what the master-restart path needs.
+        options=GRPC.OPTIONS + [("grpc.so_reuseport", 0)],
     )

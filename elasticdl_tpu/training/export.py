@@ -86,8 +86,14 @@ def export_model(
         "framework_version": __version__,
         "jax_version": jax.__version__,
     }
-    with open(os.path.join(export_dir, INFO_FILE), "w") as f:
+    # the info sidecar is what read_info/load_for_serving trust to decode
+    # PARAMS_FILE — land it atomically so a crash mid-export can't leave a
+    # torn manifest next to a complete params blob (edl-lint EDL305)
+    info_path = os.path.join(export_dir, INFO_FILE)
+    tmp = info_path + ".tmp"
+    with open(tmp, "w") as f:
         json.dump(info, f, indent=2, default=str)
+    os.replace(tmp, info_path)
     logger.info(
         "exported model (%.3fM params, step %d) -> %s",
         n_params / 1e6, info["step"], export_dir,

@@ -53,7 +53,13 @@ from elasticdl_tpu.parallel.elastic import (
     make_global_batch_stack,
 )
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
-from elasticdl_tpu.proto.service import RetryingMasterStub, make_channel
+from elasticdl_tpu.proto.service import (
+    RetryingMasterStub,
+    is_stale_generation,
+    make_channel,
+    register_with_retry,
+    reregister,
+)
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -110,6 +116,7 @@ class CohortWorker:
         self._example_host_batch = None
         self._spec_compiler = None
         self.worker_id = -1
+        self._name = ""               # set at leader registration
 
     # ------------------------------------------------------------------ #
     # setup (identical on every process)
@@ -225,16 +232,28 @@ class CohortWorker:
 
         self._channel = make_channel(self.cfg.master_addr)
         # Hardened stub (deadlines, idempotent retries, circuit breaker);
-        # every successful RPC refreshes the master-unreachable clock.
+        # every successful RPC refreshes the master-unreachable clock. The
+        # channel_factory bounds master-restart recovery: repeated wire
+        # failures rebuild the channel rather than trusting a wedged one.
         self._stub = RetryingMasterStub(
-            self._channel, on_success=self._note_master_ok
+            self._channel, on_success=self._note_master_ok,
+            channel_factory=lambda: make_channel(self.cfg.master_addr),
         )
-        resp = self._stub.RegisterWorker(
-            pb.RegisterWorkerRequest(
-                worker_name=f"cohort-{socket.gethostname()}:{os.getpid()}",
-                preferred_id_plus_one=1,
-            ),
-            timeout=30,
+        # Boot registration rides out a master that is down or restarting
+        # (proto/service.py's register_with_retry, shared with worker.py):
+        # the leader is always worker 0, so retries carry the REREGISTER
+        # marker and a successor master treats them as an idempotent
+        # reconnect of the journaled member, not a ghost second join.
+        # registered once, reused by every reconnect handshake: a renamed
+        # re-register would silently overwrite the membership entry's name
+        self._name = f"cohort-{socket.gethostname()}:{os.getpid()}"
+        resp = register_with_retry(
+            self._stub,
+            name=self._name,
+            preferred_id=0,
+            window_s=self.cfg.master_unreachable_timeout_s,
+            shutdown=self._shutdown,
+            what="cohort leader",
         )
         self.worker_id = resp.worker_id
         logger.info(
@@ -245,6 +264,36 @@ class CohortWorker:
 
     def _note_master_ok(self) -> None:
         self._last_master_ok = time.monotonic()
+
+    def _reregister(self) -> None:
+        """Leader-only reconnect handshake after a master restart (shared
+        with worker.py — proto/service.py's reregister). The cohort itself
+        keeps running throughout — only the leader's control-plane session
+        is re-established; followers never notice."""
+        resp = reregister(
+            self._stub, name=self._name, worker_id=self.worker_id,
+        )
+        self.worker_id = resp.worker_id
+        logger.warning(
+            "cohort leader re-registered with restarted master as worker %d; "
+            "resuming leases under the new generation", self.worker_id,
+        )
+
+    def _maybe_reconnect(self, e: BaseException) -> bool:
+        """True when `e` was the stale-generation fence and the reconnect
+        handshake ran — the caller retries instead of aborting the cohort."""
+        if self.worker_id < 0 or not is_stale_generation(e):
+            return False
+        try:
+            self._reregister()
+            return True
+        except Exception as handshake_err:
+            logger.warning(
+                "cohort re-register after master restart failed: %s",
+                handshake_err,
+            )
+            self._master_unreachable()
+            return False
 
     def _master_unreachable(self) -> bool:
         """Leader-only, from RPC-failure paths: True (and flips the
@@ -292,7 +341,8 @@ class CohortWorker:
                     self._pushed_lr = resp.learning_rate
             except Exception as e:
                 logger.warning("cohort heartbeat failed: %s", e)
-                self._master_unreachable()
+                if not self._maybe_reconnect(e):
+                    self._master_unreachable()
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
     def request_preempt(self) -> bool:
@@ -332,6 +382,11 @@ class CohortWorker:
             )
         except Exception as e:
             logger.warning("cohort get_task failed: %s", e)
+            if self._maybe_reconnect(e):
+                # master restarted; handshake landed — the cohort stays up
+                # and the next control vector re-leases under the new
+                # generation
+                return [OP_NOOP] + [0] * (CTRL_LEN - 1)
             if self._master_unreachable():
                 # carry FLAG_CHECKPOINT: we sit at a clean task boundary and
                 # the collective save needs no master, so a partitioned-but-
@@ -544,6 +599,7 @@ class CohortWorker:
                     logger.warning(
                         "cohort report failed for save task %d: %s", task_id, e
                     )
+                    self._maybe_reconnect(e)
             return
         svc = self._data_service(task_type)
         shard = self._shard_name(task_type, shard_idx)
@@ -740,6 +796,9 @@ class CohortWorker:
                 self._stub.ReportEvaluationMetrics(msg, timeout=30)
         except Exception as e:
             logger.warning("cohort report failed for task %d: %s", task_id, e)
+            # fenced = the restarted master requeued this lease; re-register
+            # so the next lease lands, never resend the pre-crash report
+            self._maybe_reconnect(e)
 
     def _export_final_model(self) -> None:
         if not self.cfg.output or self._state is None:

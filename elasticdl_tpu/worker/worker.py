@@ -27,7 +27,13 @@ from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
-from elasticdl_tpu.proto.service import RetryingMasterStub, make_channel
+from elasticdl_tpu.proto.service import (
+    RetryingMasterStub,
+    is_stale_generation,
+    make_channel,
+    register_with_retry,
+    reregister,
+)
 from elasticdl_tpu.training.model_spec import ModelSpec
 from elasticdl_tpu.worker.task_data_service import TaskDataService
 
@@ -47,6 +53,9 @@ _RESCALES = _reg.counter(
     "edl_rescale_applied_total", "in-place rescales applied")
 _RESCALE_S = _reg.histogram(
     "edl_rescale_seconds", "in-place rescale recovery wall time")
+_RECONNECTS = _reg.counter(
+    "edl_worker_reconnects_total",
+    "reconnect handshakes after a master restart (re-register + re-lease)")
 
 
 class Worker:
@@ -98,19 +107,19 @@ class Worker:
         self._channel = make_channel(addr)
         # Hardened stub: per-call deadlines, idempotent-only retries with
         # backoff, circuit breaker. Every successful RPC (on any thread)
-        # refreshes the master-unreachable clock through on_success.
+        # refreshes the master-unreachable clock through on_success. The
+        # channel_factory makes master-restart recovery bounded: repeated
+        # transport failures rebuild the channel instead of trusting a
+        # subchannel that wedged when the old master's listener vanished.
         self._stub = RetryingMasterStub(
-            self._channel, on_success=self._note_master_ok
+            self._channel, on_success=self._note_master_ok,
+            channel_factory=lambda: make_channel(addr),
         )
-        name = f"{socket.gethostname()}:{os.getpid()}"
+        # registered once, reused by every reconnect handshake: a renamed
+        # re-register would silently overwrite the membership entry's name
+        self._name = f"{socket.gethostname()}:{os.getpid()}"
         preferred = int(os.environ.get(WorkerEnv.WORKER_ID, -1))
-        resp = self._stub.RegisterWorker(
-            pb.RegisterWorkerRequest(
-                worker_name=name,
-                preferred_id_plus_one=preferred + 1 if preferred >= 0 else 0,
-            ),
-            timeout=30,
-        )
+        resp = self._boot_register(self._name, preferred)
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
         self._last_known_workers = resp.num_workers
@@ -123,6 +132,18 @@ class Worker:
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
+        )
+
+    def _boot_register(self, name: str, preferred: int):
+        """Boot-time registration that rides out a master that is down or
+        restarting (see proto/service.py's register_with_retry — shared
+        with the cohort leader so the handshake cannot diverge)."""
+        return register_with_retry(
+            self._stub,
+            name=name,
+            preferred_id=preferred,
+            window_s=self.cfg.master_unreachable_timeout_s,
+            shutdown=self._shutdown,
         )
 
     def _note_master_ok(self) -> None:
@@ -150,6 +171,46 @@ class Worker:
             )
             self._shutdown.set()
         return True
+
+    def _reregister(self) -> None:
+        """The reconnect handshake after a master restart (shared with the
+        cohort leader — see proto/service.py's reregister): idempotent
+        re-register under our EXISTING worker id, then apply the response."""
+        resp = reregister(
+            self._stub, name=self._name, worker_id=self.worker_id,
+        )
+        self.worker_id = resp.worker_id
+        self._membership_version = resp.membership_version
+        self._last_known_workers = resp.num_workers or self._last_known_workers
+        _RECONNECTS.inc()
+        tracing.event(
+            "worker.reconnect", worker_id=self.worker_id,
+            membership_version=resp.membership_version,
+        )
+        logger.warning(
+            "re-registered with restarted master as worker %d "
+            "(membership v%d); resuming leases under the new generation",
+            self.worker_id, resp.membership_version,
+        )
+
+    def _maybe_reconnect(self, e: BaseException) -> bool:
+        """RPC-failure triage for the master-restart fence: True when `e`
+        was a stale-generation rejection AND the reconnect handshake ran —
+        the caller should retry its loop instead of backing off or dying.
+        Any other error (including a failed re-register: the master may
+        have crashed AGAIN mid-handshake) returns False and leaves the
+        normal unreachable accounting to the caller."""
+        if self.worker_id < 0 or not is_stale_generation(e):
+            return False
+        try:
+            self._reregister()
+            return True
+        except Exception as handshake_err:
+            logger.warning(
+                "re-register after master restart failed: %s", handshake_err
+            )
+            self._master_unreachable()
+            return False
 
     def _build_trainer(self) -> None:
         from elasticdl_tpu.common.runtime import configure_jax_runtime
@@ -353,7 +414,11 @@ class Worker:
                     self._pending_lr = resp.learning_rate
             except Exception as e:
                 logger.warning("heartbeat failed: %s", e)
-                self._master_unreachable()
+                # a stale-generation fence means the master is BACK (it
+                # restarted); re-register instead of counting it toward
+                # the unreachable exit
+                if not self._maybe_reconnect(e):
+                    self._master_unreachable()
             self._shutdown.wait(self.cfg.worker_heartbeat_s)
 
     def _on_membership_change(self, new_version: int, num_workers: int = 0) -> None:
@@ -717,6 +782,15 @@ class Worker:
         except Exception as e:
             logger.warning("preemption drain report failed to deliver: %s", e)
             accepted = False
+            if is_stale_generation(e):
+                # generation fence: a DEFINITIVE rejection (the fence aborts
+                # before any mutation) — the restarted master replayed our
+                # lease back into todo WHOLE, so the full task will re-run
+                # and the drain checkpoint (covering a partial span) would
+                # double-apply. Same semantics as an explicit rejection,
+                # independent of whether the reconnect handshake succeeds.
+                delivered = True
+                self._maybe_reconnect(e)
         if accepted:
             # Clear the mid-task flag only when the persisted state and the
             # task queue actually agree: either the drain checkpoint covers
@@ -847,6 +921,10 @@ class Worker:
                 )
             except Exception as e:
                 logger.warning("get_task failed: %s; retrying", e)
+                if self._maybe_reconnect(e):
+                    # master restarted: the handshake landed, re-lease
+                    # immediately under the new generation
+                    continue
                 if self._master_unreachable():
                     break
                 # jittered: a cohort of relaunched workers retrying a
@@ -935,6 +1013,15 @@ class Worker:
                     self._maybe_checkpoint()
             except Exception as e:
                 logger.warning("report failed for task %d: %s", task.task_id, e)
+                if self._maybe_reconnect(e):
+                    # fenced report from before the crash: the restarted
+                    # master requeued this lease, so the task re-runs and
+                    # retires exactly once there — never resend the report
+                    # under the new generation (that WOULD double-count)
+                    logger.warning(
+                        "task %d report was fenced by the restarted master; "
+                        "the requeued lease re-runs it", task.task_id,
+                    )
             tasks_done += 1
 
         # A trace window still open at exit (short job / preemption) must be

@@ -455,6 +455,79 @@ def test_constant_sleep_in_retry_loop_fires():
 
 
 # ------------------------------------------------------------------ #
+# EDL305 non-atomic-state-file-write
+
+
+def test_non_atomic_json_write_fires():
+    bad = """
+        import json
+
+        def save_state(state):
+            with open("membership_state.json", "w") as f:   # BAD: torn on crash
+                json.dump(state, f)
+    """
+    found = findings_for(bad, select={"EDL305"})
+    assert len(found) == 1 and found[0].rule == "EDL305"
+
+    # a module-level constant naming the state file is resolved too
+    # (export.py's INFO_FILE shape)
+    bad_const = """
+        import json
+        import os
+
+        STATE_FILE = "journal_meta.json"
+
+        def save(d, state):
+            with open(os.path.join(d, STATE_FILE), "w") as f:
+                json.dump(state, f)
+    """
+    assert len(findings_for(bad_const, select={"EDL305"})) == 1
+
+
+def test_atomic_idiom_and_non_state_writes_are_quiet():
+    good = """
+        import json
+        import os
+
+        def save_state(path, state):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:               # writes the .tmp sibling
+                json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)                   # the atomic landing
+
+        def append_wal(path, rec):
+            with open("journal.jsonl", "a") as f:   # append: torn-tail WAL
+                f.write(json.dumps(rec))
+
+        def save_text(path):
+            with open("notes.txt", "w") as f:       # not a JSON state file
+                f.write("hi")
+
+        def read_state():
+            with open("membership_state.json") as f:  # read, not write
+                return json.load(f)
+    """
+    assert findings_for(good, select={"EDL305"}) == []
+
+
+def test_state_file_writers_in_tree_are_the_reference_fixtures():
+    """The journal and membership_signal writers are EDL305's in-tree
+    reference implementations: the rule must stay quiet on both."""
+    import elasticdl_tpu.common.membership_signal as ms
+    import elasticdl_tpu.master.journal as jr
+    import inspect
+
+    for mod in (ms, jr):
+        src = inspect.getsource(mod)
+        ctx = ModuleContext(mod.__file__, src, mod.__file__)
+        from elasticdl_tpu.analysis.rpc_rules import NonAtomicStateFileWriteRule
+
+        assert list(NonAtomicStateFileWriteRule().check(ctx)) == []
+
+
+# ------------------------------------------------------------------ #
 # EDL401 metric-name-pattern
 
 
@@ -676,7 +749,8 @@ def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
-                "EDL301", "EDL302", "EDL303", "EDL304"):
+                "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
+                "EDL401"):
         assert rid in out
 
 

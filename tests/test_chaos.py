@@ -17,6 +17,7 @@ Two tiers:
   the job must complete with exactly-once task accounting.
 """
 
+import json
 import os
 import random
 import time
@@ -294,3 +295,295 @@ def test_spawn_fault_site_spawns_doomed_process(tmp_path):
     assert wp2.proc.poll() is None
     wp2.proc.kill()
     wp2.proc.wait(timeout=30)
+
+
+# ---------------------------------------------------------------------- #
+# kill-the-master (ISSUE 5): journal replay + generation-fenced reconnect
+
+
+def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
+                                tag: str = ""):
+    """One full job where the master is killed mid-epoch and restarted.
+
+    The worker is the SAME single-threaded loop throughout (no process
+    restart): it survives the crash through the generation handshake —
+    fenced RPCs trigger an idempotent re-register, then it re-leases. The
+    successor master replays the control-plane journal, so the in-flight
+    lease at crash time is conservatively requeued and retired exactly
+    once. `crash_at=0` runs the uncrashed baseline the accounting is
+    compared against.
+
+    With EDL_CHAOS_ARTIFACT_DIR set (CI), the replayed journal and the
+    recovery trace/metrics land there for workflow-artifact upload.
+    """
+    import shutil
+
+    from elasticdl_tpu.master.journal import ControlPlaneJournal
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.registry import default_registry
+    from elasticdl_tpu.proto.service import REREGISTER_KEY, is_stale_generation
+
+    art_dir = os.environ.get("EDL_CHAOS_ARTIFACT_DIR")
+    stem = f"master-kill-{tag or 'run'}-seed{seed}"
+    if art_dir:
+        os.makedirs(art_dir, exist_ok=True)
+        tracing.configure(
+            path=os.path.join(art_dir, f"{stem}.trace.jsonl"),
+            role="chaos-master-kill",
+        )
+    spec = f"master_crash:drop@at={crash_at}" if crash_at else ""
+    faults.install(spec, seed=seed)
+
+    def boot(port=0):
+        journal = ControlPlaneJournal(ckpt_dir)
+        dispatcher = TaskDispatcher(
+            training_shards=SHARDS, records_per_task=40, shuffle=True,
+            shuffle_seed=seed, task_timeout_s=1e9, journal=journal,
+        )
+        membership = Membership(heartbeat_timeout_s=1e9, journal=journal)
+        membership.add_death_callback(dispatcher.recover_tasks)
+        servicer = MasterServicer(
+            dispatcher, membership, None, generation=journal.generation,
+        )
+        server = make_server()
+        add_master_servicer(server, servicer)
+        if port:
+            # the successor must rebind the EXACT address the worker's
+            # channel holds; with so_reuseport off the bind fails honestly
+            # (0 or RuntimeError) until the crashed listener fully closes
+            for _ in range(50):
+                try:
+                    bound = server.add_insecure_port(f"localhost:{port}")
+                except RuntimeError:
+                    bound = 0
+                if bound:
+                    break
+                time.sleep(0.1)
+            else:
+                pytest.fail(f"successor master could not rebind :{port}")
+        else:
+            port = server.add_insecure_port("localhost:0")
+            assert port, "could not bind an ephemeral port"
+        server.start()
+        return journal, dispatcher, membership, servicer, server, port
+
+    journal, dispatcher, membership, servicer, server, port = boot()
+    channel = make_channel(f"localhost:{port}")
+    stub = RetryingMasterStub(
+        channel,
+        rng=random.Random(seed),
+        sleep=lambda s: None,
+        breaker=CircuitBreaker(cooldown_s=0.0),
+    )
+    applied = []        # (shard, start, end) spans the MASTER accepted
+    reconnects = 0
+    restarts = 0
+
+    def reregister(wid):
+        # the reconnect handshake, exactly as worker.py runs it: clear the
+        # stale claim, re-register under the existing id with the marker
+        stub.generation = None
+        return stub.RegisterWorker(
+            pb.RegisterWorkerRequest(
+                worker_name="chaos-master-kill",
+                preferred_id_plus_one=wid + 1,
+            ),
+            metadata=((REREGISTER_KEY, "1"),),
+        ).worker_id
+
+    try:
+        wid = stub.RegisterWorker(
+            pb.RegisterWorkerRequest(worker_name="chaos-master-kill")
+        ).worker_id
+        for _ in range(10_000):            # livelock guard
+            try:
+                stub.Heartbeat(pb.HeartbeatRequest(worker_id=wid))
+            except Exception as e:
+                if is_stale_generation(e):
+                    wid = reregister(wid)
+                    reconnects += 1
+            try:
+                resp = stub.GetTask(pb.GetTaskRequest(worker_id=wid))
+            except Exception as e:
+                if is_stale_generation(e):
+                    wid = reregister(wid)
+                    reconnects += 1
+                continue
+            if resp.job_done:
+                break
+            task = resp.task
+            if task.type == pb.WAIT:
+                continue
+            try:
+                # the kill site sits between lease and report, so the
+                # crash always strands an in-flight lease — the hard case
+                faults.fire("master_crash")
+            except faults.FaultInjected:
+                # the chaos driver's half: abrupt death (no shutdown
+                # handshake, no worker teardown), then a successor boots
+                # from the journal on the same address
+                server.stop(None).wait(5)
+                journal.close()
+                journal, dispatcher, membership, servicer, server, port = (
+                    boot(port)
+                )
+                restarts += 1
+            try:
+                r = stub.ReportTaskResult(
+                    pb.ReportTaskResultRequest(
+                        worker_id=wid, task_id=task.task_id, success=True,
+                    )
+                )
+            except Exception as e:
+                # fenced report from before the crash: the replayed queue
+                # requeued this lease whole — never resend, re-register
+                # and re-lease instead (exactly worker.py's triage)
+                if is_stale_generation(e):
+                    wid = reregister(wid)
+                    reconnects += 1
+                continue
+            if r.accepted:
+                applied.append((task.shard_name, task.start, task.end))
+        else:
+            pytest.fail("master-kill smoke livelocked")
+        counts = dispatcher.counts()
+        trace = list(faults.get_injector().trace)
+    finally:
+        channel.close()
+        server.stop(None)
+        journal.close()
+        faults.uninstall()
+        if art_dir:
+            tracing.get_tracer().close()
+            shutil.copyfile(
+                os.path.join(ckpt_dir, "control", "journal.jsonl"),
+                os.path.join(art_dir, f"{stem}.journal.jsonl"),
+            )
+            with open(
+                os.path.join(art_dir, f"{stem}.metrics.prom"), "w"
+            ) as f:
+                f.write(default_registry().render_prometheus())
+    return {
+        "applied": applied,
+        "counts": counts,
+        "trace": trace,
+        "generation": journal.generation,
+        "stub_generation": stub.generation,
+        "worker_id": wid,
+        "alive": membership.alive_count(),
+        "reconnects": reconnects,
+        "restarts": restarts,
+    }
+
+
+@pytest.mark.chaos
+def test_kill_master_smoke_exactly_once_and_deterministic(tmp_path):
+    base = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "base"), crash_at=0, tag="base"
+    )
+    run_a = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "a"), crash_at=5, tag="a"
+    )
+    run_b = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "b"), crash_at=5, tag="b"
+    )
+
+    # deterministic twice in a row: same fault schedule, same accepted-task
+    # trace, same final accounting
+    assert run_a["trace"] == run_b["trace"] == ["master_crash:drop#5"]
+    assert run_a["applied"] == run_b["applied"]
+    assert run_a["counts"] == run_b["counts"]
+
+    for run in (run_a, run_b):
+        # the master really died and came back under generation N+1, and
+        # the worker reconnected in place (same id, no duplicate member)
+        assert run["restarts"] == 1 and run["generation"] == 2
+        assert run["reconnects"] >= 1
+        assert run["stub_generation"] == 2     # handshake landed
+        assert run["worker_id"] == base["worker_id"]
+        assert run["alive"] == 1
+        # exactly-once accounting held ACROSS the crash…
+        assert run["counts"]["failed_permanently"] == 0
+        assert run["counts"]["todo"] == 0 and run["counts"]["doing"] == 0
+        # …and the completed-task trace equals the uncrashed run's (the
+        # requeue changes the order, never the set)
+        assert sorted(run["applied"]) == sorted(base["applied"])
+        assert run["counts"] == base["counts"]
+
+    assert base["restarts"] == 0 and base["generation"] == 1
+    assert base["counts"]["finished_training"] == 9      # 200/40 + 160/40
+    for shard, _, length in SHARDS:
+        marks = [0] * length
+        for s, a, b in run_a["applied"]:
+            if s == shard:
+                for i in range(a, b):
+                    marks[i] += 1
+        bad = [i for i, m in enumerate(marks) if m != 1]
+        assert not bad, (shard, bad[:10])
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_master_restart_e2e(tmp_path):
+    """Full-stack master kill: run_local with --master_restarts, a REAL
+    worker subprocess training through the crash. The master_crash drop
+    fires inside Master.wait; the launcher crashes the master abruptly,
+    rebuilds it on the same port, and the worker reconnects under
+    generation 2 without being restarted."""
+    from elasticdl_tpu.client.local import free_port, run_local
+    from elasticdl_tpu.common.config import JobConfig
+
+    faults.install("master_crash:drop@at=4")
+    env = {
+        "PALLAS_AXON_POOL_IPS": "",
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "EDL_LOG_LEVEL": "INFO",
+    }
+    cfg = JobConfig(
+        job_name="master-kill-e2e",
+        job_type="training_only",
+        model_zoo=os.path.abspath("model_zoo"),
+        model_def="mnist.mnist_cnn.custom_model",
+        model_params={"learning_rate": 0.01},
+        training_data="synthetic://mnist?n=400&shards=4",
+        records_per_task=100,
+        minibatch_size=32,
+        num_epochs=1,
+        num_workers=1,
+        master_addr=f"localhost:{free_port()}",
+        worker_heartbeat_s=0.5,
+        task_timeout_s=60.0,
+        shuffle=False,
+        checkpoint_dir=str(tmp_path / "ckpt"),
+        checkpoint_steps=3,
+        relaunch_max=3,
+        master_restarts=1,
+    )
+    rc = run_local(
+        cfg, extra_env=env, log_dir=str(tmp_path / "logs"), timeout_s=420
+    )
+    log = (tmp_path / "logs" / "worker-0.log").read_text()
+    assert rc == 0, "e2e did not finish; worker log:\n" + log[-6000:]
+    # the worker process rode through the crash WITHOUT a process restart.
+    # Which reconnect flavor it hit depends on boot timing vs the crash
+    # poll (1-core box: jax import can outlast the fault's wait-loop
+    # countdown): mid-job -> fenced RPC + idempotent re-register; still
+    # booting -> register_with_retry rides out the restart window. Both
+    # prove crash-survival without burning the relaunch budget (the
+    # deterministic mid-job re-register is covered by the kill-master
+    # smoke above, which drives the handshake at the RPC level).
+    assert (
+        "re-registered with restarted master" in log
+        or "boot registration failed" in log
+    )
+    assert "exiting EX_TEMPFAIL" not in log
+    # the successor really replayed the journal under generation 2; a
+    # cleanly finished job retires its journal (resubmission with this
+    # checkpoint_dir must not replay job_end and no-op) but keeps the
+    # final state on disk for forensics
+    journal_dir = tmp_path / "ckpt" / "control"
+    assert not (journal_dir / "journal.jsonl").exists()
+    completed = journal_dir / "journal.jsonl.completed"
+    header = json.loads(completed.read_text().splitlines()[0])
+    assert header["generation"] == 2

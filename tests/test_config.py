@@ -134,3 +134,16 @@ def test_remat_policy_names_in_sync_with_trainer():
     assert resolve_remat_policy("") is None
     with pytest.raises(ValueError):
         resolve_remat_policy("not-a-policy")
+
+
+def test_master_restarts_requires_checkpoint_dir():
+    import pytest
+
+    cfg = JobConfig(model_def="m.x.f", master_restarts=1)
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        cfg.validate()
+    JobConfig(
+        model_def="m.x.f", master_restarts=1, checkpoint_dir="/tmp/c"
+    ).validate()
+    with pytest.raises(ValueError, match="master_restarts"):
+        JobConfig(model_def="m.x.f", master_restarts=-1).validate()

@@ -1,0 +1,514 @@
+"""Control-plane journal (master/journal.py): append/replay round-trips,
+generation bumps, torn-tail tolerance, atomic rotation, and the dispatcher/
+membership restore paths a crashed master's successor runs through."""
+
+import json
+import os
+
+from elasticdl_tpu.common import membership_signal
+from elasticdl_tpu.master.journal import (
+    ControlPlaneJournal,
+    replay_lines,
+)
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+
+def read_journal(ckpt_dir):
+    path = os.path.join(ckpt_dir, "control", "journal.jsonl")
+    with open(path, encoding="utf-8") as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ---------------------------------------------------------------------- #
+# raw journal mechanics
+
+
+def test_fresh_journal_writes_header_generation_1(tmp_path):
+    j = ControlPlaneJournal(str(tmp_path))
+    assert j.generation == 1 and not j.recovered
+    recs = read_journal(str(tmp_path))
+    assert recs[0] == {"t": "header", "v": 1, "generation": 1}
+    j.close()
+
+
+def test_reopen_bumps_generation_and_compacts(tmp_path):
+    j1 = ControlPlaneJournal(str(tmp_path))
+    j1.append("epoch_advance", epoch=0)
+    j1.append(
+        "task_create",
+        task={"task_id": 1, "type": 0, "shard_name": "s", "start": 0,
+              "end": 10, "epoch": 0, "retries": 0},
+        front=False,
+    )
+    j1.close()
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    assert j2.recovered and j2.generation == 2
+    snap = j2.dispatcher_snapshot()
+    assert snap is not None
+    assert snap.epoch == 0 and [t["task_id"] for t in snap.todo] == [1]
+    # atomic rotation: the live file is now header + one compacted snapshot
+    recs = read_journal(str(tmp_path))
+    assert [r["t"] for r in recs] == ["header", "snapshot"]
+    assert recs[0]["generation"] == 2
+
+    # and a third boot replays the SNAPSHOT to the same state
+    j2.close()
+    j3 = ControlPlaneJournal(str(tmp_path))
+    assert j3.generation == 3
+    assert [t["task_id"] for t in j3.dispatcher_snapshot().todo] == [1]
+    j3.close()
+
+
+def test_inflight_leases_requeued_front_in_lease_order(tmp_path):
+    j = ControlPlaneJournal(str(tmp_path))
+    for tid in (1, 2, 3):
+        j.append(
+            "task_create",
+            task={"task_id": tid, "type": 0, "shard_name": "s",
+                  "start": tid * 10, "end": tid * 10 + 10, "epoch": 0,
+                  "retries": 0},
+            front=False,
+        )
+    j.append("task_lease", task_id=2, worker_id=0)
+    j.append("task_lease", task_id=1, worker_id=0)
+    j.close()
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    snap = j2.dispatcher_snapshot()
+    # both in-flight leases conservatively requeued at the FRONT, in lease
+    # order, ahead of the never-leased task 3
+    assert [t["task_id"] for t in snap.todo] == [2, 1, 3]
+    assert snap.requeued_leases == 2
+    j2.close()
+
+
+def test_replayed_lease_after_requeue_not_duplicated():
+    # a task leased, requeued (timeout/failure), and RE-leased before the
+    # crash appears twice in lease order but must come back exactly once —
+    # a duplicate would double-train its records after recovery
+    task = {"task_id": 5, "type": 0, "shard_name": "s", "start": 0,
+            "end": 10, "epoch": 0, "retries": 0}
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "task_create", "task": task, "front": False}),
+        json.dumps({"t": "task_lease", "task_id": 5, "worker_id": 0}),
+        json.dumps({"t": "task_requeue", "task_id": 5, "start": 0,
+                    "retries": 1}),
+        json.dumps({"t": "task_lease", "task_id": 5, "worker_id": 0}),
+    ]
+    snap = replay_lines(lines).dispatcher
+    assert [t["task_id"] for t in snap.todo] == [5]
+    assert snap.requeued_leases == 1
+
+
+def test_replay_stop_training_drops_inflight_training_lease():
+    # stop_training condemned all training work; replay must not resurrect
+    # a TRAINING lease that was in flight at the stop — but a non-training
+    # in-flight lease (prediction) still comes back
+    train = {"task_id": 1, "type": 0, "shard_name": "s", "start": 0,
+             "end": 10, "epoch": 0, "retries": 0}
+    pred = {"task_id": 2, "type": 2, "shard_name": "p", "start": 0,
+            "end": 10, "epoch": 0, "retries": 0}
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "task_create", "task": train, "front": False}),
+        json.dumps({"t": "task_create", "task": pred, "front": False}),
+        json.dumps({"t": "task_lease", "task_id": 1, "worker_id": 0}),
+        json.dumps({"t": "task_lease", "task_id": 2, "worker_id": 0}),
+        json.dumps({"t": "stop_training", "num_epochs": 1}),
+    ]
+    snap = replay_lines(lines).dispatcher
+    assert snap.stop_training
+    assert [t["task_id"] for t in snap.todo] == [2]
+
+
+def test_replay_drops_evaluation_tasks():
+    # EvaluationService state (job ids, metric aggregation) is volatile:
+    # a replayed eval task would report into a dead eval job id — or a
+    # post-recovery job that reused it. Queued AND in-flight eval tasks
+    # are dropped; the successor's re-fired epoch-end trigger recreates
+    # the eval job fresh.
+    train = {"task_id": 1, "type": 0, "shard_name": "s", "start": 0,
+             "end": 10, "epoch": 0, "retries": 0}
+    ev_q = {"task_id": 2, "type": 1, "shard_name": "e", "start": 0,
+            "end": 10, "epoch": 0, "retries": 0, "eval_job_id": 0}
+    ev_fly = {"task_id": 3, "type": 1, "shard_name": "e", "start": 10,
+              "end": 20, "epoch": 0, "retries": 0, "eval_job_id": 0}
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "task_create", "task": train, "front": False}),
+        json.dumps({"t": "task_create", "task": ev_q, "front": False}),
+        json.dumps({"t": "task_create", "task": ev_fly, "front": False}),
+        json.dumps({"t": "task_lease", "task_id": 3, "worker_id": 0}),
+    ]
+    snap = replay_lines(lines).dispatcher
+    assert [t["task_id"] for t in snap.todo] == [1]
+    assert snap.requeued_leases == 0
+
+
+def test_batch_commit_is_one_line_and_torn_batch_drops_whole(tmp_path):
+    """A multi-record commit rides ONE journal line (append_many): a crash
+    mid-write can tear the line, but then the WHOLE batch is dropped at
+    replay — never a parseable prefix (an epoch_advance with only some of
+    its task creations would replay a partial epoch as if complete)."""
+    j = ControlPlaneJournal(str(tmp_path))
+    task = {"task_id": 1, "type": 0, "shard_name": "s", "start": 0,
+            "end": 10, "epoch": 0, "retries": 0}
+    j.append_many([
+        ("epoch_advance", {"epoch": 0}),
+        ("task_create", {"task": task, "front": False}),
+        ("task_create", {"task": dict(task, task_id=2, start=10, end=20),
+                         "front": False}),
+    ])
+    j.close()
+    path = os.path.join(str(tmp_path), "control", "journal.jsonl")
+    lines = open(path, encoding="utf-8").read().splitlines()
+    assert len(lines) == 2                 # header + ONE batch line
+    # a torn batch (crash mid-write) loses the whole commit, not a prefix
+    torn = lines[0] + "\n" + lines[1][: len(lines[1]) // 2]
+    res = replay_lines(torn.splitlines())
+    assert res.dropped_lines == 1
+    assert res.dispatcher is None          # no partial epoch replayed
+    # and the intact batch replays whole
+    res = replay_lines(lines)
+    assert res.dispatcher.epoch == 0
+    assert [t["task_id"] for t in res.dispatcher.todo] == [1, 2]
+
+
+def test_torn_tail_dropped_not_fatal(tmp_path):
+    j = ControlPlaneJournal(str(tmp_path))
+    j.append("epoch_advance", epoch=4)
+    j.close()
+    path = os.path.join(str(tmp_path), "control", "journal.jsonl")
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"t": "task_crea')          # crash mid-append
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    assert j2.recovered and j2.generation == 2
+    assert j2.replay.dropped_lines == 1
+    assert j2.dispatcher_snapshot().epoch == 4
+    j2.close()
+
+
+def test_append_after_close_is_dropped(tmp_path):
+    j = ControlPlaneJournal(str(tmp_path))
+    j.close()
+    j.append("epoch_advance", epoch=1)       # must not raise or corrupt
+    j2 = ControlPlaneJournal(str(tmp_path))
+    assert j2.dispatcher_snapshot() is None
+    j2.close()
+
+
+def test_world_version_and_membership_replay():
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 3}),
+        json.dumps({"t": "member_join", "worker_id": 0, "name": "a",
+                    "version": 1}),
+        json.dumps({"t": "member_join", "worker_id": 1, "name": "b",
+                    "version": 2}),
+        json.dumps({"t": "member_death", "worker_id": 1, "version": 3}),
+        json.dumps({"t": "world_version", "version": 7}),
+    ]
+    res = replay_lines(lines)
+    assert res.prior_generation == 3
+    assert res.world_version == 7
+    ms = res.membership
+    by_id = {w["worker_id"]: w for w in ms.workers}
+    assert by_id[0]["alive"] and not by_id[1]["alive"]
+    assert ms.version == 3 and ms.next_id == 2
+
+
+def test_journal_type_constants_pinned_to_proto_enum():
+    # journal.py avoids importing protobuf; these must track the enum
+    from elasticdl_tpu.master import journal as jmod
+
+    assert jmod._TRAINING_TYPE == pb.TRAINING
+    assert jmod._EVALUATION_TYPE == pb.EVALUATION
+    assert jmod._SAVE_MODEL_TYPE == pb.SAVE_MODEL
+
+
+# ---------------------------------------------------------------------- #
+# component restore round-trips (the successor master's boot path)
+
+
+def make_dispatcher(journal, **kw):
+    kw.setdefault("training_shards", [("s0", 0, 40)])
+    kw.setdefault("records_per_task", 10)
+    kw.setdefault("shuffle", False)
+    kw.setdefault("task_timeout_s", 1e9)
+    return TaskDispatcher(journal=journal, **kw)
+
+
+def test_dispatcher_crash_restore_round_trip(tmp_path):
+    j1 = ControlPlaneJournal(str(tmp_path))
+    d1 = make_dispatcher(j1)
+    t_done = d1.get(0)
+    assert d1.report(t_done.task_id, 0, success=True)
+    t_inflight = d1.get(0)                    # leased, never reported
+    assert t_inflight is not None
+    counts_before = d1.counts()
+    assert counts_before["finished_training"] == 1
+    assert counts_before["doing"] == 1
+    j1.close()                                # the crash
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    d2 = make_dispatcher(j2)
+    counts = d2.counts()
+    assert counts["finished_training"] == 1
+    assert counts["doing"] == 0               # lease conservatively requeued
+    assert counts["todo"] == 3                # 4 tasks - 1 finished
+    # the requeued in-flight lease is re-leased FIRST and re-runs whole
+    t_again = d2.get(0)
+    assert (t_again.shard_name, t_again.start, t_again.end) == (
+        t_inflight.shard_name, t_inflight.start, t_inflight.end
+    )
+    # drive the job to completion under the new generation
+    while True:
+        t = d2.get(0)
+        if t is None and d2.finished():
+            break
+        if t is None:
+            break
+        assert d2.report(t.task_id, 0, success=True)
+    assert d2.report(t_again.task_id, 0, success=True)
+    assert d2.finished()
+    assert d2.counts()["finished_training"] == 4
+    j2.close()
+
+
+def test_dispatcher_restore_preserves_save_model_and_epoch_state(tmp_path):
+    j1 = ControlPlaneJournal(str(tmp_path))
+    d1 = make_dispatcher(j1, final_save_model=True, num_epochs=1)
+    while True:
+        t = d1.get(0)
+        if t is None or t.type == pb.SAVE_MODEL:
+            break
+        d1.report(t.task_id, 0, success=True)
+    # crashed with the final SAVE_MODEL task leased
+    assert t is not None and t.type == pb.SAVE_MODEL
+    j1.close()
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    d2 = make_dispatcher(j2, final_save_model=True, num_epochs=1)
+    t2 = d2.get(0)
+    # replay knew save_model was already created: the requeued one is
+    # re-leased, not duplicated
+    assert t2.type == pb.SAVE_MODEL
+    assert d2.counts()["todo"] == 0
+    d2.report(t2.task_id, 0, success=True)
+    assert d2.finished()
+    j2.close()
+
+
+def test_restore_refires_epoch_end_callbacks_at_least_once(tmp_path):
+    """epoch_end is journaled inside the lock but its callbacks (the eval
+    trigger) run AFTER it, outside — a crash in between must not skip the
+    final evaluation forever. Restore re-derives the terminal flags, so
+    the successor re-fires epoch-end at-least-once."""
+    j1 = ControlPlaneJournal(str(tmp_path))
+    d1 = make_dispatcher(j1, num_epochs=1)
+    while True:
+        t = d1.get(0)
+        if t is None:
+            break
+        assert d1.report(t.task_id, 0, success=True)
+    # epoch_end + training_done + job_end are all journaled by now; the
+    # crash window under test is "flag durable, callback not yet run"
+    j1.close()
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    fired = []
+    d2 = make_dispatcher(j2, num_epochs=1)
+    d2.add_epoch_end_callback(fired.append)
+    d2.poke()
+    assert fired == [0]                    # re-fired for the final epoch
+    d2.poke()                              # job-end defers one pass behind
+    assert d2.finished()
+    j2.close()
+
+
+def test_membership_crash_restore_and_revival(tmp_path):
+    j1 = ControlPlaneJournal(str(tmp_path))
+    m1 = Membership(heartbeat_timeout_s=1e9, journal=j1)
+    w0 = m1.register("alpha")
+    w1 = m1.register("beta")
+    m1.mark_dead(w1.worker_id, reason="test")
+    v_before = m1.version
+    j1.close()
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    m2 = Membership(heartbeat_timeout_s=1e9, journal=j2)
+    assert m2.version == v_before
+    assert m2.alive_count() == 1
+    # live worker's reconnect is idempotent: same id, NO version bump
+    info = m2.reregister(w0.worker_id, "alpha")
+    assert info.worker_id == w0.worker_id and m2.version == v_before
+    # a worker reaped during the outage is revived — that IS a change
+    revived = m2.reregister(w1.worker_id, "beta")
+    assert revived.worker_id == w1.worker_id and revived.alive
+    assert m2.version == v_before + 1
+    assert m2.alive_count() == 2
+    # fresh ids keep advancing past replayed ones (no id reuse)
+    w2 = m2.register("gamma")
+    assert w2.worker_id == 2
+    j2.close()
+
+
+def test_epoch_advance_commits_with_its_task_batch(tmp_path, monkeypatch):
+    """epoch_advance and its task creations land in ONE append_many commit
+    (one fsync): a crash between a lone epoch_advance and the batch would
+    replay an epoch with an empty todo, and the successor would fire
+    epoch_end over zero tasks and skip the epoch's data entirely."""
+    j = ControlPlaneJournal(str(tmp_path))
+    commits = []
+    orig = j.append_many
+
+    def recording(records):
+        commits.append([rtype for rtype, _ in records])
+        return orig(records)
+
+    monkeypatch.setattr(j, "append_many", recording)
+    make_dispatcher(j)                     # 40 records / 10 per task
+    assert commits == [["epoch_advance"] + ["task_create"] * 4]
+    j.close()
+
+
+def test_discard_retires_journal_so_resubmit_starts_fresh(tmp_path):
+    # Master.shutdown discards the journal after a FINISHED job: a live
+    # journal replaying job_end/training_done would make a re-submission
+    # with the same checkpoint_dir come up born-finished and no-op
+    j1 = ControlPlaneJournal(str(tmp_path))
+    d1 = make_dispatcher(j1)
+    while True:
+        t = d1.get(0)
+        if t is None:
+            break
+        assert d1.report(t.task_id, 0, success=True)
+    assert d1.finished()
+    j1.discard()
+    assert not os.path.exists(j1.path)
+    # ... but the final state survives for forensics
+    assert os.path.exists(j1.path + ".completed")
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    assert not j2.recovered and j2.generation == 1
+    d2 = make_dispatcher(j2)
+    assert not d2.finished()
+    assert d2.get(0) is not None
+    j2.close()
+
+
+# ---------------------------------------------------------------------- #
+# membership-signal takeover hygiene (satellite)
+
+
+def test_clear_stale_on_takeover(tmp_path):
+    path = str(tmp_path / "membership_signal.json")
+    membership_signal.write_signal(
+        path, world_size=4, pending_size=6, world_version=3,
+        trace_id="dead-master-reform", master_generation=1,
+    )
+    assert membership_signal.clear_stale_on_takeover(path, master_generation=2)
+    data = membership_signal.read_signal(path)
+    # the dead master's PLAN is gone; the observed world survives
+    assert data["pending_size"] is None
+    assert data["trace_id"] is None
+    assert data["world_size"] == 4 and data["world_version"] == 3
+    assert membership_signal.master_generation(path) == 2
+
+
+def test_clear_stale_on_takeover_without_file_is_noop(tmp_path):
+    path = str(tmp_path / "membership_signal.json")
+    assert not membership_signal.clear_stale_on_takeover(
+        path, master_generation=2
+    )
+    assert not os.path.exists(path)
+
+
+def test_lost_bind_does_not_bump_generation(tmp_path):
+    """Bind-before-journal: client/local.py's _rebuild_master retries a
+    lingering predecessor port by constructing a fresh Master per attempt.
+    A lost bind must abandon the instance BEFORE the journal commits a
+    generation bump, or every retry inflates the generation past the real
+    restart count (and the e2e's generation==2 contract flakes)."""
+    import socket
+
+    import pytest
+
+    from elasticdl_tpu.client.local import free_port
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.common.net import PortBindError
+    from elasticdl_tpu.master.main import Master
+
+    port = free_port()
+    try:
+        blocker = socket.socket(socket.AF_INET6, socket.SOCK_STREAM)
+        blocker.bind(("::", port))
+    except OSError:
+        blocker = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        blocker.bind(("0.0.0.0", port))
+    blocker.listen(1)
+    cfg = JobConfig(
+        job_name="bind-retry",
+        job_type="training_only",
+        model_zoo=os.path.abspath("model_zoo"),
+        model_def="mnist.mnist_cnn.custom_model",
+        model_params={"learning_rate": 0.01},
+        training_data="synthetic://mnist?n=100&shards=2",
+        records_per_task=50,
+        minibatch_size=32,
+        num_epochs=1,
+        num_workers=1,
+        master_addr=f"localhost:{port}",
+        checkpoint_dir=str(tmp_path / "ckpt"),
+    )
+    try:
+        with pytest.raises(PortBindError):
+            Master(cfg)
+        # the abandoned attempt committed NOTHING to the journal
+        assert not os.path.exists(
+            os.path.join(str(tmp_path / "ckpt"), "control", "journal.jsonl")
+        )
+    finally:
+        blocker.close()
+    # the attempt that wins the bind is generation 1, not 1 + retries
+    master = Master(cfg)
+    try:
+        assert master.journal.generation == 1 and not master.journal.recovered
+    finally:
+        master.server.stop(None)
+        master.journal.close()
+
+
+def test_process_manager_clears_stale_signal_at_its_own_path(tmp_path):
+    """The manager writes the signal at `log_dir or checkpoint_dir`, which
+    differs from Master.__init__'s checkpoint_dir-based takeover clear
+    whenever log_dir is set — a recovered journal handed to a fresh manager
+    must clear the dead predecessor's plan at the manager's OWN path."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.process_manager import ProcessManager
+
+    log_dir = tmp_path / "logs"
+    ckpt_dir = tmp_path / "ckpt"
+    sig = log_dir / "membership_signal.json"
+    membership_signal.write_signal(
+        str(sig), world_size=2, pending_size=4, world_version=3,
+        trace_id="dead-master-reform", master_generation=1,
+    )
+    # a journal with history replays at construction -> recovered=True
+    j1 = ControlPlaneJournal(str(ckpt_dir))
+    j1.append("epoch_advance", epoch=0)
+    j1.close()
+    j2 = ControlPlaneJournal(str(ckpt_dir))
+    assert j2.recovered and j2.generation == 2
+
+    cfg = JobConfig(num_workers=1, checkpoint_dir=str(ckpt_dir))
+    ProcessManager(cfg, log_dir=str(log_dir), journal=j2)
+    data = membership_signal.read_signal(str(sig))
+    assert data["pending_size"] is None and data["trace_id"] is None
+    assert data["world_size"] == 2 and data["world_version"] == 3
+    assert membership_signal.master_generation(str(sig)) == 2
+    j2.close()

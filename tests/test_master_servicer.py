@@ -139,3 +139,125 @@ def test_wait_when_drained(master_stack):
     # all tasks leased but unreported → WAIT, not job_done
     assert resp.task.type == pb.WAIT and not resp.job_done
     assert resp.backoff_seconds > 0
+
+
+# ---------------------------------------------------------------------- #
+# master-generation fencing + idempotent re-registration (ISSUE 5)
+
+
+@pytest.fixture()
+def fenced_stack():
+    """A generation-2 master (as if restarted once) over real gRPC."""
+    dispatcher = TaskDispatcher(
+        training_shards=[("t", 0, 40)], records_per_task=10, shuffle=False,
+    )
+    membership = Membership(heartbeat_timeout_s=30)
+    membership.add_death_callback(dispatcher.recover_tasks)
+    servicer = MasterServicer(dispatcher, membership, None, generation=2)
+    server = make_server()
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("[::]:0")
+    server.start()
+    stub = MasterStub(make_channel(f"localhost:{port}"))
+    yield stub, dispatcher, membership, servicer
+    server.stop(0)
+
+
+def test_stale_generation_rpcs_are_fenced_retriably(fenced_stack):
+    import grpc
+
+    stub, dispatcher, membership, _ = fenced_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    stale = (("edl-master-generation", "1"),)
+    for call, request in (
+        (stub.GetTask, pb.GetTaskRequest(worker_id=r.worker_id)),
+        (stub.ReportTaskResult,
+         pb.ReportTaskResultRequest(worker_id=r.worker_id, task_id=1,
+                                    success=True)),
+        (stub.Heartbeat, pb.HeartbeatRequest(worker_id=r.worker_id)),
+        (stub.RegisterWorker, pb.RegisterWorkerRequest(worker_name="w")),
+    ):
+        with pytest.raises(grpc.RpcError) as exc:
+            call(request, metadata=stale)
+        # FAILED_PRECONDITION naming the generation: the client-side
+        # classifier (is_stale_generation) keys on exactly this
+        assert exc.value.code() == grpc.StatusCode.FAILED_PRECONDITION
+        assert "generation" in exc.value.details()
+    # the fence sat in FRONT of every mutation: nothing leased, nothing
+    # reported, no double join
+    assert dispatcher.counts()["doing"] == 0
+    assert dispatcher.counts()["finished_training"] == 0
+    assert membership.alive_count() == 1
+
+
+def test_current_generation_claim_and_no_claim_pass(fenced_stack):
+    stub, dispatcher, *_ = fenced_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    # unfenced legacy caller (no claim) and a correct claim both serve
+    resp = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+    assert resp.task.type == pb.TRAINING
+    resp2 = stub.GetTask(
+        pb.GetTaskRequest(worker_id=r.worker_id),
+        metadata=(("edl-master-generation", "2"),),
+    )
+    assert resp2.task.type == pb.TRAINING
+
+
+def test_server_stamps_generation_on_trailing_metadata(fenced_stack):
+    stub, *_ = fenced_stack
+    _, call = stub.RegisterWorker.with_call(
+        pb.RegisterWorkerRequest(worker_name="w")
+    )
+    trailing = dict(call.trailing_metadata() or ())
+    assert trailing.get("edl-master-generation") == "2"
+
+
+def test_reregister_is_idempotent_for_live_worker(fenced_stack):
+    stub, dispatcher, membership, _ = fenced_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    v_before = membership.version
+    # the reconnect handshake: generation-free, REREGISTER marker, same id
+    r2 = stub.RegisterWorker(
+        pb.RegisterWorkerRequest(
+            worker_name="w", preferred_id_plus_one=r.worker_id + 1,
+        ),
+        metadata=(("edl-reregister", "1"),),
+    )
+    assert r2.worker_id == r.worker_id
+    # no double join, no membership-version bump (the cohort must not
+    # re-form for a control-plane-only reconnect)
+    assert membership.alive_count() == 1
+    assert membership.version == v_before
+    assert r2.num_workers == 1
+
+
+def test_reregister_revives_worker_reaped_during_outage(fenced_stack):
+    stub, dispatcher, membership, _ = fenced_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    membership.mark_dead(r.worker_id, reason="missed heartbeats in outage")
+    v_dead = membership.version
+    r2 = stub.RegisterWorker(
+        pb.RegisterWorkerRequest(
+            worker_name="w", preferred_id_plus_one=r.worker_id + 1,
+        ),
+        metadata=(("edl-reregister", "1"),),
+    )
+    # revival IS a membership change: same id, version bumps once
+    assert r2.worker_id == r.worker_id
+    assert membership.version == v_dead + 1
+    assert membership.alive_count() == 1
+    # and the worker's heartbeat is accepted again (no shutdown order)
+    h = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r.worker_id))
+    assert not h.shutdown
+
+
+def test_reregister_of_unknown_id_falls_through_to_fresh_join(fenced_stack):
+    stub, _, membership, _ = fenced_stack
+    r = stub.RegisterWorker(
+        pb.RegisterWorkerRequest(worker_name="w", preferred_id_plus_one=8),
+        metadata=(("edl-reregister", "1"),),
+    )
+    # a journal-less master (or a truncated journal) still converges: the
+    # unknown id becomes a fresh registration under that preferred id
+    assert r.worker_id == 7
+    assert membership.alive_count() == 1
