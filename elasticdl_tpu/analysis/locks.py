@@ -51,6 +51,29 @@ _HOLDS_RE = re.compile(r"#\s*holds:\s*(?P<locks>[\w, ]+)")
 _CONSTRUCTION_METHODS = {"__init__", "__new__", "__post_init__"}
 
 
+def guarded_attrs(ctx: ModuleContext, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr -> lock name, from `guarded_by:` annotation comments in the
+    class's construction methods (shared by EDL101 and EDL402)."""
+    out: Dict[str, str] = {}
+    end = cls.end_lineno or cls.lineno
+    for line in range(cls.lineno, end + 1):
+        # only annotations inside construction methods define guards
+        # (an annotation elsewhere would be ambiguous about intent)
+        qual = ctx.qualname_at(line)
+        if qual.split(".")[-1] not in _CONSTRUCTION_METHODS:
+            continue
+        m = _GUARDED_RE.search(ctx.line_text(line))
+        if m:
+            out[m.group("attr")] = m.group("lock")
+            continue
+        m = _GUARDED_ABOVE_RE.match(ctx.line_text(line))
+        if m:
+            nxt = _SELF_ASSIGN_RE.match(ctx.line_text(line + 1))
+            if nxt:
+                out[nxt.group("attr")] = m.group("lock")
+    return out
+
+
 def _with_held_locks(node: ast.With) -> Set[str]:
     """Lock attribute names this `with` statement acquires (self.X only)."""
     held: Set[str] = set()
@@ -65,10 +88,10 @@ def _with_held_locks(node: ast.With) -> Set[str]:
     return held
 
 
-def _method_held_locks(
+def method_held_locks(
     ctx: ModuleContext, node: ast.FunctionDef, class_locks: Set[str]
 ) -> Set[str]:
-    """Locks a method declares it is called under."""
+    """Locks a method declares it is called under (shared with EDL402)."""
     held: Set[str] = set()
     if node.name.endswith("_locked"):
         # the codebase idiom: `_foo_locked` is only called under the lock
@@ -160,7 +183,7 @@ class GuardedByRule(Rule):
         for cls in ast.walk(ctx.tree):
             if not isinstance(cls, ast.ClassDef):
                 continue
-            guarded = self._guarded_attrs(ctx, cls)
+            guarded = guarded_attrs(ctx, cls)
             if not guarded:
                 continue
             class_locks = set(guarded.values())
@@ -178,31 +201,8 @@ class GuardedByRule(Rule):
             return
         if node.name in _CONSTRUCTION_METHODS:
             return
-        held = _method_held_locks(ctx, node, class_locks)
+        held = method_held_locks(ctx, node, class_locks)
         visitor = _AccessVisitor(self, ctx, guarded, held)
         for stmt in node.body:
             visitor.visit(stmt)
         yield from visitor.findings
-
-    def _guarded_attrs(
-        self, ctx: ModuleContext, cls: ast.ClassDef
-    ) -> Dict[str, str]:
-        """attr -> lock name, from annotation comments in the class body."""
-        out: Dict[str, str] = {}
-        end = cls.end_lineno or cls.lineno
-        for line in range(cls.lineno, end + 1):
-            # only annotations inside construction methods define guards
-            # (an annotation elsewhere would be ambiguous about intent)
-            qual = ctx.qualname_at(line)
-            if qual.split(".")[-1] not in _CONSTRUCTION_METHODS:
-                continue
-            m = _GUARDED_RE.search(ctx.line_text(line))
-            if m:
-                out[m.group("attr")] = m.group("lock")
-                continue
-            m = _GUARDED_ABOVE_RE.match(ctx.line_text(line))
-            if m:
-                nxt = _SELF_ASSIGN_RE.match(ctx.line_text(line + 1))
-                if nxt:
-                    out[nxt.group("attr")] = m.group("lock")
-        return out

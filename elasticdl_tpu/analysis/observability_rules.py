@@ -11,15 +11,39 @@ EDL401 metric-name-pattern
 
     Only literal string names are checkable statically; dynamic names are
     the runtime validator's job.
+
+EDL402 span-emit-under-lock
+    A span opened or an event emitted (`tracing.span`/`tracing.event`,
+    `get_tracer().span/event`, or a directly-imported `span`/`event`)
+    lexically inside the critical section of a `guarded_by:`-annotated
+    lock — via `with self.<lock>:` or inside a method declared to hold it
+    (`# holds: <lock>` / `_locked` suffix). Trace emission writes (and
+    flushes) trace.jsonl under the tracer's own lock; doing that while
+    holding a control-plane lock puts file I/O inside a contended critical
+    section and couples the subsystem's lock to the tracer's. PR 4 fixed
+    exactly this by hand in the process manager (the reform.spawn span now
+    wraps the lock, not the reverse) and in the dispatcher (lease/report
+    events emit after release); this rule codifies the idiom. Metric
+    mutations (`.inc()`/`.set()`/`.observe()`) stay fine under locks —
+    metric locks are leaf locks and touch no files.
+
+    Emit after releasing: compute inside the lock, emit outside (the
+    membership/dispatcher pattern), or open the span around the `with
+    self._lock:` block (the process-manager pattern).
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator
+from typing import Iterator, List, Set
 
 from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+from elasticdl_tpu.analysis.locks import (
+    _CONSTRUCTION_METHODS,
+    guarded_attrs,
+    method_held_locks,
+)
 
 #: kept textually in sync with observability/registry._NAME_RE (a test
 #: pins the two together)
@@ -81,3 +105,157 @@ class MetricNamePatternRule(Rule):
                     "edl_<subsystem>_<name> (EDL401; see "
                     "docs/observability.md)",
                 )
+
+
+# ------------------------------------------------------------------ #
+# EDL402 span-emit-under-lock
+
+
+_EMIT_ATTRS = {"span", "event"}
+
+
+def _direct_emit_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to tracing.span/tracing.event by a
+    `from ...observability.tracing import span, event` (any alias)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module \
+                and node.module.endswith("tracing"):
+            for alias in node.names:
+                if alias.name in _EMIT_ATTRS:
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_emit_call(node: ast.Call, direct_names: Set[str]) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in direct_names
+    if not isinstance(func, ast.Attribute) or func.attr not in _EMIT_ATTRS:
+        return False
+    base = func.value
+    # tracing.span(...) / tracing.event(...) — the tree's idiom (lazy
+    # in-function imports make import-tracking unreliable, so the base
+    # NAME is the signal)
+    if isinstance(base, ast.Name) and base.id == "tracing":
+        return True
+    # get_tracer().span(...) / tracing.get_tracer().event(...)
+    if isinstance(base, ast.Call):
+        f = base.func
+        fname = (
+            f.id if isinstance(f, ast.Name)
+            else f.attr if isinstance(f, ast.Attribute) else ""
+        )
+        return fname == "get_tracer"
+    return False
+
+
+class _EmitUnderLockVisitor(ast.NodeVisitor):
+    """Walk one method body tracking which class locks are lexically held
+    (same `with self.<lock>` semantics as EDL101's visitor), flagging
+    span/event emission calls while any of them is."""
+
+    def __init__(self, rule: Rule, ctx: ModuleContext,
+                 class_locks: Set[str], held: Set[str],
+                 direct_names: Set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.class_locks = class_locks
+        self.held = set(held)
+        self.direct_names = direct_names
+        self.findings: List[Finding] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        # items are processed IN ORDER, growing the held set as each lock
+        # is acquired: `with tracing.span(...): with self._lock:` (the
+        # span wrapping the lock) is the idiomatic GOOD shape, while the
+        # combined `with self._lock, tracing.span(...):` acquires the
+        # lock FIRST and then opens the span under it — flagged
+        saved = set(self.held)
+        for item in node.items:
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+            expr = item.context_expr
+            if (
+                isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id == "self"
+                and expr.attr in self.class_locks
+            ):
+                self.held.add(expr.attr)
+        for stmt in node.body:
+            self.visit(stmt)
+        self.held = saved
+
+    def _visit_deferred(self, node: ast.AST) -> None:
+        # nested defs/lambdas run later, on whatever thread calls them
+        saved = set(self.held)
+        self.held = set()
+        self.generic_visit(node)
+        self.held = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_deferred(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_deferred(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._visit_deferred(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held and _is_emit_call(node, self.direct_names):
+            locks = ", ".join(sorted(self.held))
+            kind = (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id
+            )
+            self.findings.append(
+                self.rule.finding(
+                    ctx=self.ctx, node=node,
+                    message=(
+                        f"{kind} emission inside the critical section of "
+                        f"self.{locks} — trace emission is file I/O under "
+                        "the tracer lock; emit after releasing, or open "
+                        "the span around the lock (EDL402)"
+                    ),
+                )
+            )
+        self.generic_visit(node)
+
+
+@register
+class SpanEmitUnderLockRule(Rule):
+    id = "EDL402"
+    name = "span-emit-under-lock"
+    doc = (
+        "span/event emitted inside a guarded_by-annotated lock's critical "
+        "section — trace emission does file I/O; emit after releasing"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct_names = _direct_emit_imports(ctx.tree)
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = guarded_attrs(ctx, cls)
+            if not guarded:
+                continue
+            class_locks = set(guarded.values())
+            for node in cls.body:
+                if not isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                if node.name in _CONSTRUCTION_METHODS:
+                    # construction happens-before publication: the lock
+                    # cannot be contended yet (EDL101's exemption)
+                    continue
+                held = method_held_locks(ctx, node, class_locks) & class_locks
+                visitor = _EmitUnderLockVisitor(
+                    self, ctx, class_locks, held, direct_names
+                )
+                for stmt in node.body:
+                    visitor.visit(stmt)
+                yield from visitor.findings

@@ -109,6 +109,17 @@ def run_local(
                 )
                 master.start()
     finally:
+        # final fleet rollup before teardown (ClusterHealth.update never
+        # raises): a local run surfaces "was any worker dragging" without
+        # anyone having scraped /metrics during the job
+        rollup = master.health.update()
+        if rollup.get("workers_reporting"):
+            logger.info(
+                "final cluster health: %d/%d worker(s) reporting, "
+                "step-time skew %.2f, %d straggler(s)",
+                rollup["workers_reporting"], rollup.get("workers_alive", 0),
+                rollup.get("skew", 1.0), rollup["straggler_count"],
+            )
         master.shutdown()
         manager.stop()
     return 0 if ok else 1

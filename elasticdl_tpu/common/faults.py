@@ -23,6 +23,13 @@ Sites threaded through the stack (exact-match, or a `prefix.*` wildcard):
                         response reaches the caller (lost-response shape —
                         the hard case for non-idempotent RPCs)
     worker.heartbeat    each worker heartbeat-loop iteration (worker.py)
+    worker.train_step.<id>
+                        inside each train step's timed region (worker.py),
+                        suffixed with the worker id so a schedule can slow
+                        EXACTLY one worker — `worker.train_step.1:delay@
+                        ms=40` makes worker 1 a deterministic straggler
+                        (the cluster-health scorer's test harness); the
+                        `worker.train_step.*` wildcard hits every worker
     worker.report_task  before each task-result report (worker.py)
     ckpt.save           before each checkpoint save (training/checkpoint.py)
     ckpt.save.commit    after the (async) save is initiated, before the

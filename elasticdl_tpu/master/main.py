@@ -133,6 +133,14 @@ class Master:
             journal=self.journal,
         )
         self.membership.add_death_callback(self.dispatcher.recover_tasks)
+        # Cluster health intelligence (observability/health.py): scores the
+        # heartbeat-piggybacked worker telemetry for stragglers every wait
+        # poll, exports the edl_cluster_* rollup (served by this process's
+        # /metrics), and feeds the enriched /healthz. The hook is log-only
+        # — the seam where elasticity decisions will plug in.
+        from elasticdl_tpu.observability.health import ClusterHealth
+
+        self.health = ClusterHealth(self.membership)
 
         metrics = None
         callbacks = []
@@ -224,7 +232,8 @@ class Master:
         from elasticdl_tpu.observability.http import start_server
 
         self.metrics_server = start_server(
-            role="master", port=self.cfg.metrics_port
+            role="master", port=self.cfg.metrics_port,
+            health_fn=self._healthz_extra,
         )
         if self.cfg.instance_manager == "k8s":
             # the reference's k8s flavor: the master creates worker pods and
@@ -242,6 +251,18 @@ class Master:
             self.instance_manager.start_workers()
         if self.evaluation is not None and self.cfg.job_type == JobType.EVALUATION_ONLY:
             self.evaluation.trigger(0)
+
+    def _healthz_extra(self) -> dict:
+        """What the master's /healthz adds over the per-process base:
+        which master (generation), which worker set (membership version +
+        alive count), and the latest cluster-health rollup. Reads only
+        cached/cheap state — a scrape never triggers a recompute."""
+        return {
+            "generation": self.journal.generation if self.journal else 0,
+            "membership_version": self.membership.version,
+            "alive_workers": self.membership.alive_count(),
+            "cluster": self.health.snapshot(),
+        }
 
     def wait(
         self,
@@ -262,6 +283,9 @@ class Master:
             faults.fire("master_crash")
             self.membership.reap()
             self.dispatcher.poke()
+            # fleet rollup + straggler scoring (never raises; gauges and
+            # edge-triggered cluster.straggler events update here)
+            self.health.update()
             if self.summary is not None:
                 # control-plane metrics ride the summary stream (rate-
                 # limited inside; never raises)

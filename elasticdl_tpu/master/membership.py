@@ -8,6 +8,14 @@ bumps a world version so Horovod re-forms
 one registry: liveness from heartbeats (works with or without k8s; the pod
 watcher feeds in too), and a monotonically increasing `membership_version`
 workers watch to know when to re-form the `jax.distributed` mesh.
+
+Heartbeats optionally carry a compact stats payload (gRPC metadata,
+observability/health.py): the registry keeps a ROLLING per-worker health
+record — last step-time quantiles, records/s, prefetch depth, breaker
+state, rescale phase — which `ClusterHealth` scores for stragglers. The
+records deliberately survive re-register and even death/revival (they are
+history about a worker id, not liveness state), so a reconnect after a
+master hiccup does not blind the straggler detector for a full window.
 """
 
 from __future__ import annotations
@@ -55,6 +63,9 @@ class Membership:
         # reconnecting worker to shut down as an unknown. None = volatile.
         self._journal = journal
         self._workers: Dict[int, WorkerInfo] = {}    # guarded_by: _lock
+        # rolling per-worker heartbeat telemetry (health.py records);
+        # NEVER reset by reregister/mark_dead — see module docstring
+        self._health: Dict[int, Dict] = {}           # guarded_by: _lock
         self._next_id = 0                            # guarded_by: _lock
         self._version = 0                            # guarded_by: _lock
         self._timeout = heartbeat_timeout_s
@@ -168,13 +179,29 @@ class Membership:
         )
         return info
 
-    def heartbeat(self, worker_id: int, model_version: int = 0) -> bool:
+    def heartbeat(self, worker_id: int, model_version: int = 0,
+                  stats: "Dict | None" = None) -> bool:
+        """Liveness stamp + (optionally) a telemetry record update. `stats`
+        is the decoded heartbeat payload (observability/health.py) or None
+        for a liveness-only beat — old workers mid-rolling-restart send
+        none and lose nothing but the straggler detector's view of them."""
         with self._lock:
             info = self._workers.get(worker_id)
             if info is None or not info.alive:
                 return False
             info.last_heartbeat = time.time()
             info.model_version = max(info.model_version, model_version)
+            if stats:
+                prev = self._health.get(worker_id)
+                rec = dict(stats)
+                rec.update(
+                    worker_id=worker_id,
+                    name=info.name,
+                    model_version=info.model_version,
+                    updated_at=info.last_heartbeat,
+                    updates=(prev.get("updates", 0) + 1) if prev else 1,
+                )
+                self._health[worker_id] = rec
             return True
 
     def mark_dead(self, worker_id: int, reason: str = "") -> bool:
@@ -231,3 +258,14 @@ class Membership:
     def alive_workers(self) -> List[WorkerInfo]:
         with self._lock:
             return [w for w in self._workers.values() if w.alive]
+
+    def health_snapshot(self) -> List[Dict]:
+        """Telemetry records (copies) of currently-ALIVE workers — the
+        straggler scorer's input. Dead workers keep their records in the
+        store (revival resumes the history) but are not scored."""
+        with self._lock:
+            return [
+                dict(self._health[wid])
+                for wid, w in sorted(self._workers.items())
+                if w.alive and wid in self._health
+            ]

@@ -19,6 +19,7 @@ from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.membership import Membership
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.observability import health as health_lib
 from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import GENERATION_KEY, REREGISTER_KEY
@@ -194,7 +195,15 @@ class MasterServicer:
 
     def Heartbeat(self, request, context):
         self._fence_generation("Heartbeat", context)
-        known = self._membership.heartbeat(request.worker_id, request.model_version)
+        # optional piggybacked worker telemetry (observability/health.py):
+        # decode_stats never raises — an old worker (no payload), a newer
+        # one (unknown schema), or garbage all degrade to liveness-only
+        stats = health_lib.decode_stats(
+            self._request_metadata(context).get(health_lib.STATS_METADATA_KEY)
+        )
+        known = self._membership.heartbeat(
+            request.worker_id, request.model_version, stats=stats
+        )
         with self._ctrl_lock:
             # one atomic test-and-clear: the flag is one-shot, and two
             # concurrent heartbeats from a relaunching worker must not both

@@ -20,6 +20,12 @@ including the framework-free client submit path):
   `net.bind_with_retry`, strictly best-effort (fault site
   `metrics_scrape` lets chaos tests kill it and assert training never
   notices).
+- `health`: the interpretation layer — heartbeat-piggybacked worker
+  stats (gRPC metadata, optional/back-compatible) feeding per-worker
+  rolling records in Membership, scored by a median/MAD straggler
+  detector whose rollup rides the master's /metrics + /healthz.
+- `analyzer` (+ the `analyze` CLI): offline trace merge and per-resize
+  critical-path attribution over the `trace.jsonl` files.
 
 See docs/observability.md for the metric catalog and trace schema.
 """

@@ -18,7 +18,7 @@ import json
 import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
@@ -43,10 +43,18 @@ class ObservabilityServer:
     """One /metrics + /healthz endpoint over a registry."""
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
-                 role: str = "", host: str = "127.0.0.1"):
+                 role: str = "", host: str = "127.0.0.1",
+                 health_fn: Optional[Callable[[], Dict]] = None):
         self.registry = registry or default_registry()
         self.role = role
         self.host = host
+        # /healthz enrichment: a dict merged into the response (the master
+        # wires generation/alive-count/cluster-rollup here). Best-effort
+        # like everything else on this surface — a raising callback marks
+        # the response, never 500s it, and the underlying state (e.g. the
+        # ClusterHealth rollup) is computed elsewhere: a dead or dying
+        # endpoint never blocks health SCORING.
+        self.health_fn = health_fn
         self.port: Optional[int] = None
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -77,12 +85,23 @@ class ObservabilityServer:
                     body = outer.registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] == "/healthz":
-                    body = (json.dumps({
+                    payload = {
                         "status": "ok",
                         "role": outer.role,
                         "world_version": tracing.get_tracer().world_version,
                         "pid": os.getpid(),
-                    }) + "\n").encode()
+                    }
+                    if outer.health_fn is not None:
+                        try:
+                            extra = outer.health_fn()
+                            if isinstance(extra, dict):
+                                payload.update(extra)
+                        except Exception:
+                            # enrichment is advisory; the probe answer
+                            # ("the process serves") must still go out:
+                            # edl-lint: disable=EDL303
+                            payload["health_extra_error"] = True
+                    body = (json.dumps(payload) + "\n").encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -161,6 +180,7 @@ class ObservabilityServer:
 
 def start_server(role: str = "", port: Optional[int] = None,
                  registry: Optional[MetricsRegistry] = None,
+                 health_fn: Optional[Callable[[], Dict]] = None,
                  ) -> Optional[ObservabilityServer]:
     """Best-effort endpoint start for the master/worker entrypoints.
     A set (non-empty) EDL_METRICS_PORT env overrides `port` in BOTH
@@ -187,7 +207,9 @@ def start_server(role: str = "", port: Optional[int] = None,
         port = 0
     if port < 0:
         return None
-    server = ObservabilityServer(registry=registry, role=role)
+    server = ObservabilityServer(
+        registry=registry, role=role, health_fn=health_fn
+    )
     try:
         server.start(port)
     except Exception:

@@ -67,6 +67,19 @@ def _render_labels(names: Tuple[str, ...], values: Tuple[str, ...],
     return "{" + body + "}"
 
 
+def quantile_sorted(sorted_vals, q: float) -> float:
+    """Linear-interpolation quantile over an ASCENDING-sorted sequence
+    (0.0 when empty) — the one implementation `_Reservoir` and the worker
+    health stats share (observability/health.py)."""
+    if not sorted_vals:
+        return 0.0
+    idx = q * (len(sorted_vals) - 1)
+    lo = int(idx)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = idx - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
 def _fmt(value: float) -> str:
     # integers print as integers (Prometheus accepts both; humans diff this)
     if float(value).is_integer():
@@ -221,14 +234,7 @@ class _Reservoir:
                 self.sample[i] = v
 
     def quantile(self, q: float) -> float:
-        if not self.sample:
-            return 0.0
-        s = sorted(self.sample)
-        idx = q * (len(s) - 1)
-        lo = int(idx)
-        hi = min(lo + 1, len(s) - 1)
-        frac = idx - lo
-        return s[lo] * (1.0 - frac) + s[hi] * frac
+        return quantile_sorted(sorted(self.sample), q)
 
 
 class Histogram(Metric):

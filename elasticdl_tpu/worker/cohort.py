@@ -46,6 +46,11 @@ from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import ExitCode
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.observability.health import (
+    STATS_METADATA_KEY,
+    WorkerStepStats,
+    encode_stats,
+)
 from elasticdl_tpu.parallel.elastic import (
     CohortContext,
     context_from_env,
@@ -117,6 +122,11 @@ class CohortWorker:
         self._spec_compiler = None
         self.worker_id = -1
         self._name = ""               # set at leader registration
+        # leader-only heartbeat telemetry (observability/health.py): the
+        # cohort is ONE logical worker, so its health record is the
+        # leader's view of the collective step cadence
+        self._step_stats = WorkerStepStats()
+        self._phase = "boot"          # boot -> train/idle (leader payload)
 
     # ------------------------------------------------------------------ #
     # setup (identical on every process)
@@ -315,15 +325,37 @@ class CohortWorker:
             self._shutdown.set()
         return True
 
+    def _stats_payload(self):
+        """Leader heartbeat telemetry (the cohort's collective cadence as
+        seen from the leader's dispatch clock)."""
+        from elasticdl_tpu.observability import tracing
+
+        stats = self._step_stats.snapshot()
+        stats.update(
+            phase=self._phase,
+            breaker_open=int(bool(self._stub and self._stub.breaker.is_open)),
+            num_processes=self.ctx.num_processes,
+            world_version=tracing.get_tracer().world_version,
+        )
+        return stats
+
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
+                # optional telemetry metadata; a payload failure degrades
+                # this beat to liveness-only (same contract as worker.py)
+                try:
+                    md = ((STATS_METADATA_KEY,
+                           encode_stats(self._stats_payload())),)
+                except Exception:
+                    md = None
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
                         worker_id=self.worker_id,
                         model_version=self._model_version,
                     ),
                     timeout=10,
+                    metadata=md,
                 )
                 if resp.shutdown:
                     if resp.job_done:
@@ -557,6 +589,13 @@ class CohortWorker:
     def _run_task(self, ctrl: List[int]) -> None:
         import jax
 
+        self._phase = "train"
+        try:
+            self._run_task_inner(ctrl, jax)
+        finally:
+            self._phase = "idle"
+
+    def _run_task_inner(self, ctrl: List[int], jax) -> None:
         _, task_id, task_type, shard_idx, start, end, flags, eval_job, lr_bits = ctrl
         self._ctrl_pushed_lr = _bits_to_lr(lr_bits)
         self._maybe_apply_ctrl_lr()
@@ -647,8 +686,14 @@ class CohortWorker:
             if self.ctx.is_leader:
                 # the leader's float() forced the collective dispatch(es):
                 # wall time covers dispatch + device compute cohort-wide
-                step_time_sum += time.perf_counter() - t0
+                group_s = time.perf_counter() - t0
+                step_time_sum += group_s
                 loss_count += len(buf)
+                # per-step telemetry sample for the heartbeat payload (the
+                # whole cohort advances minibatch_size rows per step)
+                self._step_stats.observe_step(
+                    group_s / max(1, len(buf)), self.cfg.minibatch_size
+                )
             self._model_version += len(buf)
             buf.clear()
 

@@ -25,6 +25,11 @@ from elasticdl_tpu.common.constants import WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.health import (
+    STATS_METADATA_KEY,
+    WorkerStepStats,
+    encode_stats,
+)
 from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import (
@@ -98,6 +103,11 @@ class Worker:
         # state handoff + executable-cache reuse, no teardown/restore.
         self._pending_rescale = None
         self.last_recovery_s: Optional[float] = None
+        # heartbeat-piggybacked telemetry (observability/health.py): the
+        # train loop observes step timings, the heartbeat thread snapshots
+        # them into the stats payload the master's straggler scorer reads
+        self._step_stats = WorkerStepStats()
+        self._rescaling = False       # True while _rescale_in_place runs
 
     # ------------------------------------------------------------------ #
     # setup
@@ -372,6 +382,33 @@ class Worker:
     # ------------------------------------------------------------------ #
     # heartbeats
 
+    def _stats_payload(self) -> Dict[str, Any]:
+        """The heartbeat telemetry payload: recent step-time quantiles +
+        records/s from the rolling window, plus the control-plane state
+        the master's health layer wants to see (breaker, rescale phase,
+        prefetch lookahead, world generation)."""
+        stats = self._step_stats.snapshot()
+        if self._rescaling or self._pending_rescale is not None:
+            phase = "rescale"
+        elif self._mid_training_task:
+            phase = "train"
+        else:
+            phase = "idle"
+        try:
+            depth = int(
+                os.environ.get("EDL_PREFETCH_DEPTH", "")
+                or self.cfg.prefetch_batches
+            )
+        except ValueError:
+            depth = self.cfg.prefetch_batches
+        stats.update(
+            phase=phase,
+            breaker_open=int(bool(self._stub and self._stub.breaker.is_open)),
+            prefetch_depth=depth,
+            world_version=tracing.get_tracer().world_version,
+        )
+        return stats
+
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
@@ -379,12 +416,22 @@ class Worker:
                 # (a hard worker death between task boundaries); drop/delay
                 # fall through the same except path as a network failure
                 faults.fire("worker.heartbeat")
+                # telemetry rides as OPTIONAL metadata: a master that does
+                # not understand it ignores it, and a payload-building
+                # failure degrades this beat to liveness-only — stats must
+                # never cost a heartbeat
+                try:
+                    md = ((STATS_METADATA_KEY,
+                           encode_stats(self._stats_payload())),)
+                except Exception:
+                    md = None
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
                         worker_id=self.worker_id,
                         model_version=self._model_version,
                     ),
                     timeout=10,
+                    metadata=md,
                 )
                 if resp.shutdown:
                     logger.info("master requested shutdown")
@@ -479,6 +526,10 @@ class Worker:
         if target is None:
             return
         axis_sizes, devices = target
+        # heartbeat telemetry reports phase="rescale" for the duration
+        # (the pending target was just consumed, so the flag is what keeps
+        # the master's health view honest mid-recovery)
+        self._rescaling = True
         t0 = time.perf_counter()
         # the rescale opens a NEW world generation: bump the tracer's world
         # version first so every span of this recovery carries it — rolled
@@ -526,6 +577,8 @@ class Worker:
         except BaseException:
             tracing.set_world_version(prev_world_version)
             raise
+        finally:
+            self._rescaling = False
         _RESCALES.inc()
         _RESCALE_S.observe(self.last_recovery_s)
         logger.info(
@@ -623,6 +676,12 @@ class Worker:
             self._ensure_state(batch)
             self._maybe_profile()
             t0 = time.perf_counter()
+            # straggler-injection site (per-worker so a chaos schedule can
+            # slow EXACTLY one worker: worker.train_step.<id>, or all via
+            # the worker.train_step.* wildcard); inside the timed region,
+            # so an injected delay reads as a slow step — which is the
+            # point: the health layer must detect it
+            faults.fire(f"worker.train_step.{self.worker_id}")
             self._state, logs = self._trainer.train_step(self._state, batch)
             # float() forces the step's result, so this wall time covers the
             # whole step (dispatch + device compute), not just dispatch —
@@ -637,7 +696,9 @@ class Worker:
             # mask sums the real (non-padding) records this batch applied;
             # exactly-once accounting needs it per batch (the drain report
             # retires records mid-task): edl-lint: disable=EDL201
-            records_done += int(batch["mask"].sum())
+            batch_records = int(batch["mask"].sum())
+            records_done += batch_records
+            self._step_stats.observe_step(step_s, batch_records)
         return {
             "loss_sum": loss_sum,
             "loss_count": loss_count,
@@ -700,6 +761,9 @@ class Worker:
         ):
             self._maybe_profile()
             t0 = time.perf_counter()
+            # straggler-injection site (one per GROUP dispatch — see the
+            # single-step path for the per-worker addressing rationale)
+            faults.fire(f"worker.train_step.{self.worker_id}")
             if len(buf) == k:
                 stacked = shard_batch_stack(
                     self._mesh, buf, self._spec.batch_partition)
@@ -722,7 +786,13 @@ class Worker:
             self._model_version += len(buf)
             # per-group record accounting for the drain report:
             # edl-lint: disable=EDL201
-            stats["records_done"] += int(sum(b["mask"].sum() for b in buf))
+            group_records = int(sum(b["mask"].sum() for b in buf))
+            stats["records_done"] += group_records
+            # one telemetry sample per group, normalized to per-step values
+            # so grouped and single-step workers score comparably
+            self._step_stats.observe_step(
+                group_s / max(1, len(buf)), group_records / max(1, len(buf))
+            )
         stats["interrupted"] = bool(interrupted)
         return stats
 

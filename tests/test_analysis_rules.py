@@ -580,6 +580,192 @@ def test_metric_name_regexes_pinned_together():
 
 
 # ------------------------------------------------------------------ #
+# EDL402 span-emit-under-lock
+
+
+EDL402_BAD = """
+    import threading
+    from elasticdl_tpu.observability import tracing
+
+    class Membershipish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._version = 0   # guarded_by: _lock
+
+        def join(self):
+            with self._lock:
+                self._version += 1
+                tracing.event("membership.join", version=self._version)
+
+        def reform(self):
+            with self._lock:
+                with tracing.span("reform.spawn"):
+                    self._version += 1
+
+        def _bump_locked(self):
+            tracing.event("membership.bump")   # holds the lock by idiom
+"""
+
+EDL402_GOOD = """
+    import threading
+    from elasticdl_tpu.observability import tracing
+
+    class Membershipish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._version = 0   # guarded_by: _lock
+
+        def join(self):
+            with self._lock:
+                self._version += 1
+                version = self._version
+            # emission AFTER release: the membership/dispatcher idiom
+            tracing.event("membership.join", version=version)
+
+        def reform(self):
+            # the span wraps the lock, not the reverse (PR 4's
+            # process-manager fix): emission happens outside the section
+            with tracing.span("reform.spawn"):
+                with self._lock:
+                    self._version += 1
+
+        def counted(self):
+            with self._lock:
+                # metric mutations are fine under locks (leaf locks, no
+                # file I/O)
+                _VERSIONS.set(self._version)
+                self._version += 1
+
+        def unrelated_lock(self):
+            other = threading.Lock()
+            with other:
+                tracing.event("not.a.guarded.lock")
+"""
+
+
+def test_span_emit_under_lock_fires_on_all_three_shapes():
+    fs = findings_for(EDL402_BAD, select={"EDL402"})
+    assert rule_ids(fs) == ["EDL402"]
+    assert len(fs) == 3
+    contexts = sorted(f.context for f in fs)
+    assert contexts == [
+        "Membershipish._bump_locked",
+        "Membershipish.join",
+        "Membershipish.reform",
+    ]
+    assert all("critical section" in f.message for f in fs)
+
+
+def test_span_emit_under_lock_quiet_on_idiomatic_shapes():
+    assert findings_for(EDL402_GOOD, select={"EDL402"}) == []
+
+
+def test_span_emit_under_lock_only_in_guarded_classes():
+    # no guarded_by annotation -> the class declared no lock discipline,
+    # so EDL402 has nothing to anchor on (EDL101 shares this contract)
+    src = """
+        import threading
+        from elasticdl_tpu.observability import tracing
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    tracing.event("x.y")
+    """
+    assert findings_for(src, select={"EDL402"}) == []
+
+
+def test_span_emit_under_lock_combined_with_statement():
+    # `with self._lock, tracing.span(...):` acquires the lock FIRST, then
+    # opens the span under it — the items are evaluated in order, so this
+    # is the same hazard as nesting (review find: the rule must not be
+    # blind to the one-line spelling)
+    src = """
+        import threading
+        from elasticdl_tpu.observability import tracing
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0   # guarded_by: _lock
+
+            def bad(self):
+                with self._lock, tracing.span("combined"):
+                    self._n += 1
+
+            def good(self):
+                # span first, lock second: emission outside the section
+                with tracing.span("combined"), self._lock:
+                    self._n += 1
+    """
+    fs = findings_for(src, select={"EDL402"})
+    assert len(fs) == 1 and fs[0].context == "C.bad"
+
+
+def test_span_emit_under_lock_direct_import_and_get_tracer():
+    src = """
+        import threading
+        from elasticdl_tpu.observability.tracing import event, get_tracer
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0   # guarded_by: _lock
+
+            def f(self):
+                with self._lock:
+                    event("direct.import")
+                    get_tracer().span("via.tracer")
+    """
+    fs = findings_for(src, select={"EDL402"})
+    assert len(fs) == 2
+
+
+def test_span_emit_under_lock_nested_function_not_considered_locked():
+    # a closure defined under the lock runs later, on another thread's
+    # schedule — same deferred-execution rule as EDL101
+    src = """
+        import threading
+        from elasticdl_tpu.observability import tracing
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0   # guarded_by: _lock
+
+            def f(self):
+                with self._lock:
+                    def later():
+                        tracing.event("deferred")
+                    self._n += 1
+                return later
+    """
+    assert findings_for(src, select={"EDL402"}) == []
+
+
+def test_span_emit_under_lock_suppressible():
+    src = """
+        import threading
+        from elasticdl_tpu.observability import tracing
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0   # guarded_by: _lock
+
+            def f(self):
+                with self._lock:
+                    # reviewed: memory-only tracer here:
+                    # edl-lint: disable=EDL402
+                    tracing.event("x.y", n=self._n)
+    """
+    assert findings_for(src, select={"EDL402"}) == []
+
+
+# ------------------------------------------------------------------ #
 # suppressions, baseline, CLI
 
 

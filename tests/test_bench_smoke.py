@@ -92,6 +92,16 @@ def test_rescale_leg_reports_recovery_and_exactness(bench, mesh8, monkeypatch):
     assert res["cold_recovery_s"] > 0
     assert res["recovery_speedup"] >= 2.0, res
     assert res["speculative_sizes"], res
+    # ISSUE 7 acceptance: the analyzer-derived critical path's phase sum
+    # is consistent with the measured recovery wall clock — the segments
+    # partition the rescale root's interval (sub-tolerance gaps are the
+    # only loss), and that root IS the timed recovery window
+    cp = res["critical_path"]
+    assert set(cp["phases"]) >= {"settle", "handoff", "compile"}, cp
+    assert abs(cp["phase_sum_s"] - cp["wall_s"]) <= 0.005, cp
+    assert abs(cp["wall_s"] - res["time_to_recovery_s"]) <= max(
+        0.05, 0.25 * res["time_to_recovery_s"]
+    ), (cp, res["time_to_recovery_s"])
 
 
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):

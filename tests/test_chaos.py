@@ -64,11 +64,20 @@ def run_control_plane_scenario(seed: int):
     sequence — and with it every seeded fault decision — is a pure
     function of the seed.
 
-    With EDL_CHAOS_ARTIFACT_DIR set (CI), the scenario's trace.jsonl and
-    a /metrics snapshot are written there for workflow-artifact upload —
-    the chaos run's observability record, not just its assertions.
+    With EDL_CHAOS_ARTIFACT_DIR set (CI), the scenario's trace.jsonl, a
+    /metrics snapshot, and the cluster-health rollup snapshot are written
+    there for workflow-artifact upload — the chaos run's observability
+    record, not just its assertions.
+
+    The worker's heartbeats carry the REAL telemetry payload (ISSUE 7)
+    while the schedule is dropping heartbeats around them: the health
+    rollup must come up coherent from whatever beats survive.
     """
+    import json as _json
+
+    from elasticdl_tpu.observability import health as health_lib
     from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.health import ClusterHealth
     from elasticdl_tpu.observability.registry import default_registry
 
     art_dir = os.environ.get("EDL_CHAOS_ARTIFACT_DIR")
@@ -86,6 +95,8 @@ def run_control_plane_scenario(seed: int):
     membership = Membership(heartbeat_timeout_s=1e9)
     membership.add_death_callback(dispatcher.recover_tasks)
     servicer = MasterServicer(dispatcher, membership, None)
+    cluster_health = ClusterHealth(membership)
+    step_stats = health_lib.WorkerStepStats()
     # lock-order recording rides the whole scenario: any inversion
     # introduced into the control plane raises at its acquire site, and
     # the graph is certified acyclic before the scenario returns
@@ -113,9 +124,18 @@ def run_control_plane_scenario(seed: int):
         ).worker_id
         for _ in range(10_000):            # livelock guard
             try:
-                stub.Heartbeat(pb.HeartbeatRequest(worker_id=wid))
+                stub.Heartbeat(
+                    pb.HeartbeatRequest(worker_id=wid),
+                    metadata=((
+                        health_lib.STATS_METADATA_KEY,
+                        health_lib.encode_stats(
+                            dict(step_stats.snapshot(), phase="train")
+                        ),
+                    ),),
+                )
             except Exception:
                 pass                       # dropped heartbeats are survivable
+            cluster_health.update()
             try:
                 resp = stub.GetTask(pb.GetTaskRequest(worker_id=wid))
             except Exception:
@@ -125,7 +145,15 @@ def run_control_plane_scenario(seed: int):
             task = resp.task
             if task.type == pb.WAIT:
                 continue
+            # "train" the task: the telemetry window sees one step per
+            # span (values are wall-clock noise; the artifact's point is
+            # the PLUMBING surviving chaos, and the assertions below never
+            # read them — determinism holds)
+            t_step = time.perf_counter()
             applied.append((task.shard_name, task.start, task.end))
+            step_stats.observe_step(
+                time.perf_counter() - t_step, records=task.end - task.start
+            )
             try:
                 stub.ReportTaskResult(
                     pb.ReportTaskResultRequest(
@@ -152,6 +180,15 @@ def run_control_plane_scenario(seed: int):
                 "w",
             ) as f:
                 f.write(default_registry().render_prometheus())
+            # the cluster-health rollup the run ended with (ISSUE 7):
+            # uploaded next to trace + metrics so a chaos regression in
+            # the telemetry path ships its own fleet-health evidence
+            with open(
+                os.path.join(art_dir, f"chaos-smoke-seed{seed}.health.json"),
+                "w",
+            ) as f:
+                _json.dump(cluster_health.update(), f, indent=2,
+                           sort_keys=True)
     return applied, counts, trace
 
 

@@ -1,0 +1,553 @@
+"""Cluster health intelligence (ISSUE 7): heartbeat-piggybacked worker
+telemetry, the master's median/MAD straggler scorer, and the enriched
+/healthz surface.
+
+The acceptance-shaped test lives at the end: a deterministic EDL_FAULTS
+delay on ONE worker's step site (`worker.train_step.1:delay@ms=...`, the
+same site worker.py fires inside its timed region) makes that worker a
+straggler the scorer detects — gauge AND event — within a bounded number
+of heartbeats, while the uninjected twin run stays at zero stragglers the
+whole way."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.observability import health, tracing
+from elasticdl_tpu.observability.http import ObservabilityServer
+from elasticdl_tpu.observability.registry import (
+    MetricsRegistry,
+    default_registry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def tracer_memory():
+    t = tracing.get_tracer()
+    start = len(t.records)
+    yield t, start
+
+
+def new_records(t, start):
+    return list(t.records)[start:]
+
+
+# ---------------------------------------------------------------------- #
+# payload codec
+
+
+def test_stats_codec_round_trip():
+    payload = {"step_p50_ms": 12.5, "steps": 40, "phase": "train",
+               "breaker_open": 0}
+    raw = health.encode_stats(payload)
+    assert health.decode_stats(raw) == payload
+    # ASCII-safe for gRPC metadata values
+    raw.encode("ascii")
+
+
+def test_decode_stats_rejects_garbage_without_raising():
+    assert health.decode_stats(None) is None
+    assert health.decode_stats("") is None
+    assert health.decode_stats("not json {") is None
+    assert health.decode_stats("[1, 2, 3]") is None          # not an object
+    assert health.decode_stats('"a string"') is None
+    assert health.decode_stats("x" * (health.MAX_PAYLOAD_BYTES + 1)) is None
+    too_many = json.dumps({f"k{i}": i for i in range(100)})
+    assert health.decode_stats(too_many) is None
+
+
+def test_decode_stats_bounds_values_and_drops_nested():
+    raw = json.dumps({
+        "ok": 1.5,
+        "label": "x" * 500,             # clipped to 64
+        "nested": {"drop": "me"},       # non-scalar: dropped, not fatal
+        "listy": [1, 2],
+    })
+    out = health.decode_stats(raw)
+    assert out is not None
+    assert out["ok"] == 1.5
+    assert len(out["label"]) == 64
+    assert "nested" not in out and "listy" not in out
+
+
+# ---------------------------------------------------------------------- #
+# worker-side collector
+
+
+def test_worker_step_stats_quantiles_and_rate():
+    s = health.WorkerStepStats(window=64)
+    assert s.snapshot() == {"steps": 0}
+    for _ in range(9):
+        s.observe_step(0.010, records=32)
+    s.observe_step(0.100, records=32)    # one slow step
+    snap = s.snapshot()
+    assert snap["steps"] == 10
+    assert snap["step_p50_ms"] == pytest.approx(10.0)
+    assert snap["step_max_ms"] == pytest.approx(100.0)
+    assert snap["step_p90_ms"] >= snap["step_p50_ms"]
+    # 320 records over 0.19s of step wall
+    assert snap["records_per_s"] == pytest.approx(320 / 0.19, rel=1e-3)
+
+
+def test_worker_step_stats_window_is_bounded():
+    s = health.WorkerStepStats(window=8)
+    for _ in range(100):
+        s.observe_step(1.0)
+    assert s.snapshot()["steps"] == 8
+
+
+# ---------------------------------------------------------------------- #
+# membership health records
+
+
+def test_membership_keeps_rolling_health_records():
+    m = Membership(heartbeat_timeout_s=100)
+    m.register("w0")
+    assert m.health_snapshot() == []           # liveness-only so far
+    assert m.heartbeat(0, 5, stats={"step_p50_ms": 10.0})
+    assert m.heartbeat(0, 6, stats={"step_p50_ms": 12.0})
+    recs = m.health_snapshot()
+    assert len(recs) == 1
+    rec = recs[0]
+    assert rec["worker_id"] == 0 and rec["name"] == "w0"
+    assert rec["step_p50_ms"] == 12.0          # latest wins
+    assert rec["updates"] == 2                 # ...but history is counted
+    assert rec["model_version"] == 6
+    assert rec["updated_at"] > 0
+
+
+def test_membership_stats_none_is_liveness_only():
+    m = Membership(heartbeat_timeout_s=100)
+    m.register("w0")
+    assert m.heartbeat(0, 1)                   # old-worker shape: no stats
+    assert m.heartbeat(0, 2, stats=None)
+    assert m.health_snapshot() == []
+    assert m.alive_count() == 1
+
+
+def test_health_record_survives_reregister_and_revival():
+    m = Membership(heartbeat_timeout_s=100)
+    m.register("w0")
+    m.heartbeat(0, 1, stats={"step_p50_ms": 10.0})
+    # reconnect handshake (master restart): record survives, no reset
+    m.reregister(0, "w0")
+    assert m.health_snapshot()[0]["updates"] == 1
+    # death hides the record from the scorer; revival restores the history
+    m.mark_dead(0, "test")
+    assert m.health_snapshot() == []
+    m.reregister(0, "w0")
+    rec = m.health_snapshot()[0]
+    assert rec["updates"] == 1 and rec["step_p50_ms"] == 10.0
+
+
+# ---------------------------------------------------------------------- #
+# robust scorer
+
+
+def test_robust_scores_uniform_fleet_is_flat():
+    scores = health.robust_scores([0.01, 0.0101, 0.0099, 0.01])
+    assert all(abs(s) < 3.0 for s in scores)
+
+
+def test_robust_scores_outlier_does_not_hide_itself():
+    # the straggler is 10x the median; with mean/stddev it would drag the
+    # center toward itself — median/MAD keeps the others near zero
+    scores = health.robust_scores([0.01, 0.01, 0.011, 0.1])
+    assert scores[-1] > 10.0
+    assert all(abs(s) < 3.0 for s in scores[:-1])
+
+
+def _membership_with_stats(p50s_ms):
+    m = Membership(heartbeat_timeout_s=100)
+    for i, _ in enumerate(p50s_ms):
+        m.register(f"w{i}")
+    for i, p50 in enumerate(p50s_ms):
+        m.heartbeat(i, 1, stats={"step_p50_ms": p50, "steps": 10,
+                                 "phase": "train"})
+    return m
+
+
+def test_cluster_health_uniform_fleet_zero_stragglers():
+    ch = health.ClusterHealth(_membership_with_stats([10.0, 10.5, 9.8, 10.2]))
+    snap = ch.update()
+    assert snap["workers_reporting"] == 4
+    assert snap["straggler_count"] == 0 and snap["stragglers"] == []
+    assert snap["skew"] < 1.2
+    reg = default_registry()
+    assert reg.get("edl_cluster_straggler_count").value() == 0
+
+
+def test_cluster_health_detects_straggler_with_gauges_and_event(
+        tracer_memory):
+    t, start = tracer_memory
+    ch = health.ClusterHealth(_membership_with_stats(
+        [10.0, 10.5, 80.0, 10.2]))
+    hook_calls = []
+    ch.add_hook(hook_calls.append)
+    snap = ch.update()
+    assert snap["straggler_count"] == 1
+    info = snap["stragglers"][0]
+    assert info["worker_id"] == 2 and info["score"] > 3.0
+    assert snap["slowest_worker"] == 2
+    assert snap["fastest_worker"] == 0
+    assert snap["skew"] == pytest.approx(80.0 / 10.1, rel=0.05)
+    reg = default_registry()
+    assert reg.get("edl_cluster_straggler_count").value() == 1
+    assert reg.get("edl_cluster_slowest_worker").value() == 2
+    events = [r for r in new_records(t, start)
+              if r["name"] == "cluster.straggler"]
+    assert len(events) == 1 and events[0]["worker_id"] == 2
+    assert hook_calls and hook_calls[0]["worker_id"] == 2
+    # edge-triggered: a second poll neither re-fires the event nor the hook
+    ch.update()
+    assert len([r for r in new_records(t, start)
+                if r["name"] == "cluster.straggler"]) == 1
+    assert len(hook_calls) == 1
+
+
+def test_cluster_health_straggler_clears_on_recovery(tracer_memory):
+    t, start = tracer_memory
+    m = _membership_with_stats([10.0, 10.5, 80.0, 10.2])
+    ch = health.ClusterHealth(m)
+    assert ch.update()["straggler_count"] == 1
+    m.heartbeat(2, 2, stats={"step_p50_ms": 10.1, "steps": 10})
+    snap = ch.update()
+    assert snap["straggler_count"] == 0
+    cleared = [r for r in new_records(t, start)
+               if r["name"] == "cluster.straggler_cleared"]
+    assert len(cleared) == 1 and cleared[0]["worker_id"] == 2
+
+
+def test_cluster_health_needs_quorum():
+    # 2 reporters: the median IS one of them — undecidable, never scored
+    ch = health.ClusterHealth(_membership_with_stats([10.0, 80.0]))
+    snap = ch.update()
+    assert snap["straggler_count"] == 0
+    assert snap["scorable"] is False
+
+
+def test_losing_quorum_mid_incident_does_not_clear_or_double_count(
+        tracer_memory):
+    """Review find: 'cleared' must mean SCORED HEALTHY, not 'we lost the
+    ability to score'. A flagged straggler rides out a quorum dip (and its
+    own telemetry going stale) without a spurious cleared event, and
+    scoring resuming does not re-fire the onset."""
+    t, start = tracer_memory
+    m = _membership_with_stats([10.0, 10.5, 80.0, 10.2])
+    ch = health.ClusterHealth(m, stale_after_s=30.0)
+    assert ch.update()["straggler_count"] == 1
+
+    def events(name):
+        return [r for r in new_records(t, start) if r["name"] == name]
+
+    # quorum dips: two healthy workers' telemetry goes stale
+    with m._lock:
+        m._health[0]["updated_at"] = time.time() - 3600
+        m._health[1]["updated_at"] = time.time() - 3600
+    snap = ch.update()
+    assert snap["scorable"] is False
+    # the incident stays open: flag carried, nothing cleared
+    assert snap["straggler_count"] == 1
+    assert not events("cluster.straggler_cleared")
+    # the straggler's OWN record going stale also carries the flag
+    with m._lock:
+        m._health[0]["updated_at"] = time.time()
+        m._health[1]["updated_at"] = time.time()
+        m._health[2]["updated_at"] = time.time() - 3600
+    snap = ch.update()
+    assert snap["straggler_count"] == 1
+    assert not events("cluster.straggler_cleared")
+    # scoring resumes with the worker still slow: ONE onset total
+    m.heartbeat(2, 3, stats={"step_p50_ms": 80.0, "steps": 10})
+    snap = ch.update()
+    assert snap["straggler_count"] == 1
+    assert len(events("cluster.straggler")) == 1
+    # and a real recovery clears exactly once
+    m.heartbeat(2, 4, stats={"step_p50_ms": 10.1, "steps": 10})
+    snap = ch.update()
+    assert snap["straggler_count"] == 0
+    assert len(events("cluster.straggler_cleared")) == 1
+    # a flagged worker DYING also closes the incident (membership owns
+    # the death story; the flag must not survive the worker)
+    m.heartbeat(3, 2, stats={"step_p50_ms": 80.0, "steps": 10})
+    assert ch.update()["straggler_count"] == 1
+    m.mark_dead(3, "test")
+    assert ch.update()["straggler_count"] == 0
+
+
+def test_cluster_health_ignores_stale_telemetry():
+    m = _membership_with_stats([10.0, 10.5, 80.0, 10.2])
+    ch = health.ClusterHealth(m, stale_after_s=30.0)
+    # pretend the slow worker's record is from a past epoch of its life
+    with m._lock:
+        m._health[2]["updated_at"] = time.time() - 3600
+    snap = ch.update()
+    assert snap["workers_reporting"] == 3
+    assert snap["straggler_count"] == 0
+
+
+def test_cluster_health_failing_hook_does_not_break_scoring():
+    ch = health.ClusterHealth(_membership_with_stats(
+        [10.0, 10.5, 80.0, 10.2]))
+    ch.add_hook(lambda info: 1 / 0)
+    snap = ch.update()                        # must not raise
+    assert snap["straggler_count"] == 1
+
+
+def test_cluster_health_update_never_raises():
+    class Broken:
+        def health_snapshot(self):
+            raise RuntimeError("membership exploded")
+
+    ch = health.ClusterHealth(Broken())
+    snap = ch.update()                        # logs, returns last snapshot
+    assert snap["straggler_count"] == 0
+
+
+# ---------------------------------------------------------------------- #
+# the telemetry path over a real gRPC hop (back-compat included)
+
+
+@pytest.fixture()
+def grpc_stack():
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto.service import (
+        MasterStub,
+        add_master_servicer,
+        make_channel,
+        make_server,
+    )
+
+    dispatcher = TaskDispatcher(
+        training_shards=[("t", 0, 40)], records_per_task=10, shuffle=False,
+    )
+    membership = Membership(heartbeat_timeout_s=100)
+    servicer = MasterServicer(dispatcher, membership, None)
+    server = make_server()
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("[::]:0")
+    server.start()
+    channel = make_channel(f"localhost:{port}")
+    stub = MasterStub(channel)
+    yield stub, membership
+    channel.close()
+    server.stop(0)
+
+
+def test_heartbeat_metadata_feeds_membership_health(grpc_stack):
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    stub, membership = grpc_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    payload = health.encode_stats(
+        {"step_p50_ms": 15.0, "steps": 12, "phase": "train",
+         "breaker_open": 0, "prefetch_depth": 2})
+    resp = stub.Heartbeat(
+        pb.HeartbeatRequest(worker_id=r.worker_id, model_version=7),
+        metadata=((health.STATS_METADATA_KEY, payload),),
+    )
+    assert not resp.shutdown
+    rec = membership.health_snapshot()[0]
+    assert rec["step_p50_ms"] == 15.0
+    assert rec["phase"] == "train" and rec["prefetch_depth"] == 2
+    assert rec["model_version"] == 7
+
+
+def test_heartbeat_without_stats_is_backward_compatible(grpc_stack):
+    """The mid-rolling-restart shape: an OLD worker (no payload) against a
+    NEW master degrades to liveness-only — never an error."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    stub, membership = grpc_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="old"))
+    resp = stub.Heartbeat(pb.HeartbeatRequest(worker_id=r.worker_id))
+    assert not resp.shutdown
+    assert membership.alive_count() == 1
+    assert membership.health_snapshot() == []
+
+
+def test_heartbeat_with_garbage_stats_is_liveness_only(grpc_stack):
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    stub, membership = grpc_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    resp = stub.Heartbeat(
+        pb.HeartbeatRequest(worker_id=r.worker_id),
+        metadata=((health.STATS_METADATA_KEY, "{'not': json"),),
+    )
+    assert not resp.shutdown
+    assert membership.health_snapshot() == []
+
+
+# ---------------------------------------------------------------------- #
+# /healthz enrichment + scrape independence
+
+
+def _get(url, timeout=5):
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def test_healthz_enriched_with_cluster_rollup():
+    m = _membership_with_stats([10.0, 10.5, 80.0, 10.2])
+    ch = health.ClusterHealth(m)
+    ch.update()
+
+    def extra():
+        return {
+            "generation": 3,
+            "membership_version": m.version,
+            "alive_workers": m.alive_count(),
+            "cluster": ch.snapshot(),
+        }
+
+    server = ObservabilityServer(
+        registry=MetricsRegistry(), role="master", health_fn=extra)
+    try:
+        port = server.start()
+        got = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert got["status"] == "ok" and got["role"] == "master"
+        assert got["generation"] == 3
+        assert got["alive_workers"] == 4
+        assert got["membership_version"] == m.version
+        assert got["cluster"]["straggler_count"] == 1
+        assert got["cluster"]["stragglers"][0]["worker_id"] == 2
+    finally:
+        server.stop()
+
+
+def test_healthz_survives_raising_health_fn():
+    server = ObservabilityServer(
+        registry=MetricsRegistry(), role="m",
+        health_fn=lambda: 1 / 0)
+    try:
+        port = server.start()
+        got = json.loads(_get(f"http://127.0.0.1:{port}/healthz"))
+        assert got["status"] == "ok"
+        assert got["health_extra_error"] is True
+    finally:
+        server.stop()
+
+
+def test_scrape_death_never_blocks_health_scoring():
+    """The metrics_scrape fault site covers the rollup path: `crash` kills
+    the ENDPOINT serving /healthz; the scorer — which never depends on the
+    scrape surface — keeps updating gauges and snapshots."""
+    m = _membership_with_stats([10.0, 10.5, 10.2, 80.0])
+    ch = health.ClusterHealth(m)
+    server = ObservabilityServer(
+        registry=default_registry(), role="master",
+        health_fn=lambda: {"cluster": ch.snapshot()})
+    try:
+        port = server.start()
+        ch.update()
+        assert json.loads(
+            _get(f"http://127.0.0.1:{port}/healthz")
+        )["cluster"]["straggler_count"] == 1
+        faults.install("metrics_scrape:crash@at=1")
+        with pytest.raises(Exception):
+            _get(f"http://127.0.0.1:{port}/healthz", timeout=2)
+        # endpoint is dying/dead; scoring continues regardless
+        m.heartbeat(3, 2, stats={"step_p50_ms": 10.1, "steps": 10})
+        snap = ch.update()
+        assert snap["straggler_count"] == 0
+        assert default_registry().get(
+            "edl_cluster_straggler_count").value() == 0
+        deadline = time.monotonic() + 5
+        dead = False
+        while time.monotonic() < deadline and not dead:
+            try:
+                _get(f"http://127.0.0.1:{port}/healthz", timeout=1)
+                time.sleep(0.05)
+            except Exception:
+                dead = True
+        assert dead, "endpoint survived metrics_scrape:crash"
+        # and the scorer STILL works after the endpoint is gone
+        m.heartbeat(3, 3, stats={"step_p50_ms": 90.0, "steps": 10})
+        assert ch.update()["straggler_count"] == 1
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------- #
+# acceptance: deterministic injected-delay straggler, end to end
+
+
+def _drive_round(stub, membership, ch, workers, steps=4):
+    """One heartbeat round: every simulated worker runs `steps` steps
+    through the REAL per-worker fault site inside the REAL timed-region
+    shape worker.py uses, then heartbeats its payload through the real
+    gRPC servicer; the master scores after the round."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    for wid, stats in workers:
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            faults.fire(f"worker.train_step.{wid}")
+            stats.observe_step(time.perf_counter() - t0, records=32)
+        payload = stats.snapshot()
+        payload.update(phase="train", breaker_open=0)
+        stub.Heartbeat(
+            pb.HeartbeatRequest(worker_id=wid, model_version=1),
+            metadata=((health.STATS_METADATA_KEY,
+                       health.encode_stats(payload)),),
+        )
+    return ch.update()
+
+
+@pytest.mark.parametrize("inject", [True, False],
+                         ids=["injected-delay", "uninjected"])
+def test_injected_delay_straggler_detected_within_bounded_heartbeats(
+        grpc_stack, tracer_memory, inject):
+    """worker.train_step.1:delay@ms=25 makes worker 1 a deterministic
+    straggler: detected (gauge + cluster.straggler event) within 3
+    heartbeat rounds. The uninjected twin stays at zero stragglers for
+    the same number of rounds."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    t, start = tracer_memory
+    stub, membership = grpc_stack
+    if inject:
+        faults.install("worker.train_step.1:delay@ms=25")
+    workers = []
+    for i in range(4):
+        r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name=f"w{i}"))
+        workers.append((r.worker_id, health.WorkerStepStats()))
+    ch = health.ClusterHealth(membership)
+
+    detected_at = None
+    for round_no in range(1, 4):              # bounded: <= 3 heartbeats
+        snap = _drive_round(stub, membership, ch, workers)
+        if inject and snap["straggler_count"]:
+            detected_at = round_no
+            break
+        if not inject:
+            assert snap["straggler_count"] == 0, snap
+
+    if inject:
+        assert detected_at is not None and detected_at <= 3
+        assert snap["stragglers"][0]["worker_id"] == 1
+        assert default_registry().get(
+            "edl_cluster_straggler_count").value() == 1
+        events = [r for r in new_records(t, start)
+                  if r["name"] == "cluster.straggler"]
+        assert events and events[0]["worker_id"] == 1
+        # the injected delay is what the payload measured
+        assert snap["stragglers"][0]["step_time_p50_s"] >= 0.02
+    else:
+        assert default_registry().get(
+            "edl_cluster_straggler_count").value() == 0
+        assert not [r for r in new_records(t, start)
+                    if r["name"] == "cluster.straggler"]

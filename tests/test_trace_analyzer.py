@@ -1,0 +1,197 @@
+"""Trace critical-path analyzer (ISSUE 7): multi-role merge over the
+checked-in golden fixtures (tests/fixtures/traces/), torn/interleaved
+lines tolerated (and distinguished from mid-file garbage in --strict),
+deterministic critical path with per-phase/per-role attribution, and the
+CLI surface CI drives over chaos/bench artifacts."""
+
+import json
+import os
+
+from elasticdl_tpu.observability import analyzer
+from elasticdl_tpu.observability.analyze import main as analyze_main
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "traces"
+)
+RESIZE_TID = "feedface00000001"
+
+
+# ---------------------------------------------------------------------- #
+# loading: torn tails and garbage lines
+
+
+def test_load_traces_counts_bad_lines_and_classifies_torn_tail():
+    loaded = analyzer.load_traces([FIXTURES])
+    assert len(loaded.files) == 2
+    # master has one mid-file garbage line, worker-0 one torn tail
+    assert len(loaded.bad_lines) == 2
+    # only the MID-FILE garbage is a strict violation; the torn tail is
+    # the documented crash shape (a writer killed mid-record)
+    assert len(loaded.strict_violations) == 1
+    path, line, _ = loaded.strict_violations[0]
+    assert path.endswith(os.path.join("master", "trace.jsonl"))
+    assert line == 2
+    # every parseable record made it through the garbage
+    assert len(loaded.records) == 10
+
+
+def test_load_traces_missing_file_is_usage_not_writer_bug(tmp_path):
+    loaded = analyzer.load_traces([str(tmp_path / "nope.jsonl")])
+    assert loaded.records == []
+    # a file that never existed is NOT a strict "writer bug" violation
+    # (review find: a skipped best-effort trace write must not read as
+    # trace corruption) — it surfaces as unreadable, CLI exit 2
+    assert loaded.strict_violations == []
+    assert loaded.unreadable_files == [str(tmp_path / "nope.jsonl")]
+
+
+# ---------------------------------------------------------------------- #
+# the golden resize timeline: master reform -> worker rescale
+
+
+def test_multi_role_merge_produces_one_resize_timeline():
+    report = analyzer.analyze_paths([FIXTURES])
+    assert report["resize_traces"] == 1
+    t = analyzer.resize_timeline(report, RESIZE_TID)
+    assert t is not None
+    assert t["is_resize"]
+    assert t["roles"] == ["master", "worker-0"]
+    assert t["spans"] == 8 and t["events"] == 1
+    # two per-process roots, chained under the synthetic timeline root
+    assert [r["name"] for r in t["roots"]] == ["reform", "rescale"]
+
+
+def test_critical_path_deterministic_and_fully_attributed():
+    report = analyzer.analyze_paths([FIXTURES])
+    tl = analyzer.resize_timeline(report, RESIZE_TID)["timeline"]
+    assert tl["wall_s"] == 8.5
+    names = [s["name"] for s in tl["critical_path"]]
+    # the exact chain: master's quiesce/teardown/spawn, the settle gap
+    # between spawn-done and the worker's rescale start, then the worker's
+    # mesh/compile/handoff — children emit in start order
+    assert names == [
+        "reform.quiesce", "reform.teardown", "reform.spawn",
+        "timeline (self)", "rescale.mesh", "rescale.compile",
+        "rescale.handoff",
+    ]
+    durs = [s["dur_s"] for s in tl["critical_path"]]
+    assert durs == [2.0, 1.0, 2.0, 0.5, 0.5, 2.0, 0.5]
+    # every instant attributed exactly once: segment sum == wall clock
+    assert sum(durs) == tl["wall_s"]
+    # phase attribution: quiesce+teardown+spawn+mesh -> settle,
+    # the cross-process gap -> other
+    assert tl["phases"] == {
+        "compile": 2.0, "handoff": 0.5, "other": 0.5, "settle": 5.5,
+    }
+    assert tl["by_role"] == {"": 0.5, "master": 5.0, "worker-0": 3.0}
+    # deterministic: a second run renders byte-identical JSON
+    again = analyzer.analyze_paths([FIXTURES])
+    assert json.dumps(report, sort_keys=True) == json.dumps(
+        again, sort_keys=True
+    )
+
+
+def test_single_root_trace_uses_real_root_not_synthetic():
+    recs = [
+        {"kind": "span", "name": "rescale", "trace_id": "t", "span_id": "a",
+         "parent_id": None, "role": "w", "ts": 10.0, "dur_ms": 1000.0},
+        {"kind": "span", "name": "phase.compile", "trace_id": "t",
+         "span_id": "b", "parent_id": "a", "role": "w", "ts": 10.2,
+         "dur_ms": 800.0},
+    ]
+    t = analyzer.analyze_records(recs)["traces"][0]
+    assert t["timeline"]["name"] == "rescale"
+    assert t["timeline"]["phases"] == {"compile": 0.8, "other": 0.2}
+
+
+def test_parallel_children_stay_off_the_critical_path():
+    # two children overlap; only the latest-ending chain is attributed —
+    # shortening the off-path child would not move the end time
+    recs = [
+        {"kind": "span", "name": "root", "trace_id": "t", "span_id": "r",
+         "parent_id": None, "role": "m", "ts": 0.0, "dur_ms": 1000.0},
+        {"kind": "span", "name": "slow.compile", "trace_id": "t",
+         "span_id": "s", "parent_id": "r", "role": "m", "ts": 0.0,
+         "dur_ms": 1000.0},
+        {"kind": "span", "name": "parallel.handoff", "trace_id": "t",
+         "span_id": "p", "parent_id": "r", "role": "m", "ts": 0.0,
+         "dur_ms": 400.0},
+    ]
+    tl = analyzer.analyze_records(recs)["traces"][0]["timeline"]
+    assert [s["name"] for s in tl["critical_path"]] == ["slow.compile"]
+    assert tl["phases"] == {"compile": 1.0}
+
+
+def test_straggler_events_surface_in_trace_summary():
+    report = analyzer.analyze_paths([FIXTURES])
+    t = analyzer.resize_timeline(report, "feedface00000002")
+    assert t is not None and not t["is_resize"]
+    assert t["straggler_events"] == [
+        {"worker_id": 3, "score": 6.2, "step_time_p50_s": 0.09,
+         "ts": 120.0}
+    ]
+
+
+def test_phase_classification():
+    for name, phase in (
+        ("phase.settle", "settle"), ("rescale.mesh", "settle"),
+        ("reform.quiesce", "settle"), ("cohort.world_form", "settle"),
+        ("phase.handoff", "handoff"), ("ckpt.save", "handoff"),
+        ("prefetch.drain", "handoff"), ("handoff.stage_to_host", "handoff"),
+        ("phase.compile", "compile"), ("compile.speculative", "compile"),
+        ("rescale.compile", "compile"),
+        ("rescale", "other"), ("task.lease", "other"),
+    ):
+        assert analyzer.classify_phase(name) == phase, name
+
+
+# ---------------------------------------------------------------------- #
+# CLI (python -m elasticdl_tpu.observability.analyze)
+
+
+def test_cli_json_report_parses(capsys):
+    rc = analyze_main([FIXTURES, "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["resize_traces"] == 1
+    assert any(
+        t["trace_id"] == RESIZE_TID for t in report["traces"]
+    )
+
+
+def test_cli_text_report_shows_critical_path(capsys):
+    rc = analyze_main([FIXTURES])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "RESIZE" in out
+    assert "reform.quiesce" in out and "rescale.compile" in out
+    assert "phases:" in out and "by role:" in out
+
+
+def test_cli_strict_fails_on_midfile_garbage(capsys):
+    rc = analyze_main([FIXTURES, "--strict", "--json"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "strict: unparseable line" in err
+
+
+def test_cli_strict_tolerates_torn_tail_alone(capsys):
+    # the worker file alone: its only bad line IS the torn tail
+    worker = os.path.join(FIXTURES, "worker-0", "trace.jsonl")
+    rc = analyze_main([worker, "--strict", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    assert len(report["unparseable_lines"]) == 1
+    assert report["strict_violations"] == []
+
+
+def test_cli_no_input_is_exit_2(tmp_path, capsys):
+    rc = analyze_main([str(tmp_path)])
+    assert rc == 2
+    capsys.readouterr()
+
+
+def test_cli_missing_named_file_is_exit_2_even_with_strict(tmp_path, capsys):
+    rc = analyze_main([str(tmp_path / "never-written.jsonl"), "--strict"])
+    assert rc == 2
+    assert "unreadable input file" in capsys.readouterr().err
