@@ -807,6 +807,390 @@ def bench_rescale(mesh, np):
     return out
 
 
+# ---------------------------------------------------------------------- #
+# control-plane throughput (ISSUE 8): a simulated in-process worker swarm
+# (threads, no devices) driving register/lease/report/heartbeat against a
+# REAL master — journal + dispatcher + membership + servicer behind gRPC.
+
+CP_WORKERS = int(os.environ.get("EDL_BENCH_CP_WORKERS", "64"))
+CP_TASKS = int(os.environ.get("EDL_BENCH_CP_TASKS", str(CP_WORKERS * 24)))
+CP_BATCH = int(os.environ.get("EDL_BENCH_CP_BATCH", "16"))
+CP_GROUP_MS = float(os.environ.get("EDL_BENCH_CP_GROUP_MS", "5"))
+CP_HEARTBEATS = int(os.environ.get("EDL_BENCH_CP_HEARTBEATS", "40"))
+CP_COHORT = int(os.environ.get("EDL_BENCH_CP_COHORT", "32"))
+
+
+def _cp_master(tmp, group_ms, n_tasks, journal=True):
+    """A real master control plane on an ephemeral port: journal (in
+    `tmp`), dispatcher over `n_tasks` single-record tasks, membership,
+    servicer, gRPC server. Returns (handles dict) — caller stops/closes."""
+    from elasticdl_tpu.master.journal import ControlPlaneJournal
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto.service import add_master_servicer, make_server
+
+    j = (ControlPlaneJournal(tmp, group_commit_ms=group_ms)
+         if journal else None)
+    dispatcher = TaskDispatcher(
+        training_shards=[("swarm", 0, n_tasks)], records_per_task=1,
+        shuffle=False, task_timeout_s=1e9, journal=j,
+    )
+    membership = Membership(heartbeat_timeout_s=1e9, journal=j)
+    membership.add_death_callback(dispatcher.recover_tasks)
+    servicer = MasterServicer(
+        dispatcher, membership, None, wait_backoff_s=0.02,
+        generation=j.generation if j else 0,
+    )
+    server = make_server(max_workers=max(32, CP_WORKERS + 4))
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("localhost:0")
+    assert port, "could not bind an ephemeral port for the swarm master"
+    server.start()
+    return {"journal": j, "dispatcher": dispatcher, "membership": membership,
+            "servicer": servicer, "server": server, "port": port}
+
+
+def _cp_channels(port, n_workers):
+    """A small shared channel pool (gRPC channels are thread-safe; one
+    per simulated worker would burn fds for no fidelity gain)."""
+    from elasticdl_tpu.proto.service import make_channel
+
+    return [make_channel(f"localhost:{port}")
+            for _ in range(min(8, max(1, n_workers)))]
+
+
+def _cp_drain(label, group_ms, batch, workers, n_tasks):
+    """One swarm cycle in one {commit mode} x {lease batch} cell, split
+    into two measured phases so each number isolates one hot path:
+
+    - **dispatch**: `workers` threads lease (max_tasks=batch) until the
+      queue is dry — leases/s is THE dispatch-throughput headline (how
+      fast the master can hand out work: lock passes, journal commits,
+      round-trips all inclusive);
+    - **retire**: the same threads report every leased task — reports/s
+      measures the ack path (each report is one journaled commit whose
+      accepted=True is released only after its fsync).
+
+    Returns throughput + lease latency + a post-drain journal
+    commit-latency probe."""
+    import tempfile
+    import threading
+
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import MasterStub
+
+    with tempfile.TemporaryDirectory() as tmp:
+        m = _cp_master(tmp, group_ms, n_tasks)
+        channels = _cp_channels(m["port"], workers)
+        lease_lat = [[] for _ in range(workers)]
+        held = [[] for _ in range(workers)]   # (wid, task_id) to report
+        errors = []
+
+        def dispatch_worker(idx):
+            try:
+                stub = MasterStub(channels[idx % len(channels)])
+                wid = stub.RegisterWorker(
+                    pb.RegisterWorkerRequest(worker_name=f"swarm-{idx}"),
+                    timeout=30,
+                ).worker_id
+                while True:
+                    t0 = time.perf_counter()
+                    resp = stub.GetTask(
+                        pb.GetTaskRequest(worker_id=wid, max_tasks=batch),
+                        timeout=30,
+                    )
+                    dt = time.perf_counter() - t0
+                    if resp.job_done:
+                        return
+                    tasks = list(resp.tasks) or [resp.task]
+                    if tasks[0].type == pb.WAIT:
+                        # queue dry: everything is leased out — this
+                        # worker's dispatch phase is over
+                        return
+                    lease_lat[idx].append(dt)
+                    held[idx].extend((wid, t.task_id) for t in tasks)
+            except Exception as e:   # a failed worker voids the cell
+                errors.append(f"dispatch {type(e).__name__}: {e}")
+
+        def retire_worker(idx):
+            try:
+                stub = MasterStub(channels[idx % len(channels)])
+                for wid, task_id in held[idx]:
+                    stub.ReportTaskResult(
+                        pb.ReportTaskResultRequest(
+                            worker_id=wid, task_id=task_id, success=True,
+                        ),
+                        timeout=30,
+                    )
+            except Exception as e:
+                errors.append(f"retire {type(e).__name__}: {e}")
+
+        def run_phase(target):
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=target, args=(i,), daemon=True)
+                for i in range(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=600)
+            return time.perf_counter() - t0
+
+        with tracing.span("control_plane.dispatch", mode=label,
+                          workers=workers, lease_batch=batch,
+                          group_commit_ms=group_ms):
+            dispatch_wall = run_phase(dispatch_worker)
+        n_leased = sum(len(h) for h in held)
+        with tracing.span("control_plane.retire", mode=label):
+            retire_wall = run_phase(retire_worker)
+
+        counts = m["dispatcher"].counts()
+        # post-drain probe: K direct commits measure the journal's
+        # enqueue-to-durable latency in this mode, uncontended
+        probe = []
+        for _ in range(50):
+            t0 = time.perf_counter()
+            m["journal"].append("world_version", version=0).wait()
+            probe.append(time.perf_counter() - t0)
+        m["server"].stop(None)
+        m["journal"].close()
+        for ch in channels:
+            ch.close()
+
+        lats = sorted(x for per in lease_lat for x in per)
+        out = {
+            "dispatch_wall_s": round(dispatch_wall, 3),
+            "leases_per_sec": round(n_leased / dispatch_wall, 1)
+            if dispatch_wall else 0.0,
+            "retire_wall_s": round(retire_wall, 3),
+            "reports_per_sec": round(n_leased / retire_wall, 1)
+            if retire_wall else 0.0,
+            "lease_round_trips": len(lats),
+            "lease_p50_ms": round(1e3 * _q(lats, 0.5), 3),
+            "lease_p99_ms": round(1e3 * _q(lats, 0.99), 3),
+            "journal_commit_p50_ms": round(1e3 * _q(sorted(probe), 0.5), 3),
+            "journal_commit_p99_ms": round(1e3 * _q(sorted(probe), 0.99), 3),
+            "finished_training": counts["finished_training"],
+        }
+        if errors:
+            out["errors"] = errors[:3]
+        if counts["finished_training"] != n_tasks or counts["todo"] \
+                or counts["doing"]:
+            out["accounting_error"] = counts
+        return out
+
+
+def _q(sorted_vals, q):
+    from elasticdl_tpu.observability.registry import quantile_sorted
+
+    return quantile_sorted(sorted_vals, q) if sorted_vals else 0.0
+
+
+def _cp_heartbeats(workers, beats, cohort_size):
+    """Heartbeat fan-in: point-to-point (every worker beats for itself,
+    stats payload attached — the PR 6 shape) vs cohort-coalesced (ONE
+    leader beat carries `cohort_size` MemberBeats). Reports beats/s and
+    covered member-beats/s so the O(workers) -> O(cohorts) claim carries
+    its own number."""
+    import threading
+
+    from elasticdl_tpu.observability import health as health_lib
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import MasterStub
+
+    stats = {"step_p50_ms": 12.0, "records_per_sec": 1000.0,
+             "phase": "train"}
+    payload = health_lib.encode_stats(stats)
+    m = _cp_master("", 0.0, 1, journal=False)
+    channels = _cp_channels(m["port"], workers)
+    try:
+        stub0 = MasterStub(channels[0])
+        wids = []
+        for i in range(workers):
+            wids.append(stub0.RegisterWorker(
+                pb.RegisterWorkerRequest(worker_name=f"hb-{i}"),
+                timeout=30,
+            ).worker_id)
+
+        def beat(idx):
+            stub = MasterStub(channels[idx % len(channels)])
+            md = ((health_lib.STATS_METADATA_KEY, payload),)
+            for _ in range(beats):
+                stub.Heartbeat(
+                    pb.HeartbeatRequest(worker_id=wids[idx]),
+                    timeout=30, metadata=md,
+                )
+
+        with tracing.span("control_plane.heartbeats_p2p",
+                          workers=workers, beats=beats):
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=beat, args=(i,), daemon=True)
+                for i in range(workers)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            p2p_wall = time.perf_counter() - t0
+
+        # cohort-coalesced: a leader + cohort_size members, ONE beat
+        # carrying every member's stats
+        resp = stub0.RegisterWorker(
+            pb.RegisterWorkerRequest(
+                worker_name="hb-leader",
+                member_names=[f"hb-leader#p{i}"
+                              for i in range(1, cohort_size + 1)],
+            ),
+            timeout=30,
+        )
+        members = [
+            pb.MemberBeat(worker_id=mid, stats_json=payload)
+            for mid in resp.member_ids
+        ]
+        with tracing.span("control_plane.heartbeats_coalesced",
+                          cohort_size=cohort_size, beats=beats):
+            t0 = time.perf_counter()
+            for _ in range(beats):
+                stub0.Heartbeat(
+                    pb.HeartbeatRequest(
+                        worker_id=resp.worker_id, members=members,
+                    ),
+                    timeout=30,
+                )
+            co_wall = time.perf_counter() - t0
+        return {
+            "point_to_point_beats_per_sec": round(
+                workers * beats / p2p_wall, 1),
+            "coalesced_rpcs_per_sec": round(beats / co_wall, 1),
+            "coalesced_member_beats_per_sec": round(
+                beats * cohort_size / co_wall, 1),
+            "cohort_size": cohort_size,
+            "health_records": len(m["membership"].health_snapshot()),
+        }
+    finally:
+        m["server"].stop(None)
+        for ch in channels:
+            ch.close()
+
+
+def _cp_replay_check(group_ms, crash_after):
+    """Kill-master replay accounting for one commit mode: a deterministic
+    single-threaded client leases+reports against a journaled dispatcher,
+    the master dies abruptly (journal.abort — queued group commits drop,
+    exactly as SIGKILL) mid-run, a successor replays, and the job drains.
+    Returns the applied-span multiset + final counts; the caller asserts
+    they are identical across commit modes."""
+    import tempfile
+
+    from elasticdl_tpu.master.journal import ControlPlaneJournal
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+
+    n_tasks = 40
+    applied = []
+
+    def boot(tmp):
+        j = ControlPlaneJournal(tmp, group_commit_ms=group_ms)
+        d = TaskDispatcher(
+            training_shards=[("replay", 0, n_tasks)], records_per_task=1,
+            shuffle=False, task_timeout_s=1e9, journal=j,
+        )
+        Membership(heartbeat_timeout_s=1e9, journal=j)
+        return j, d
+
+    with tempfile.TemporaryDirectory() as tmp:
+        j, d = boot(tmp)
+        for _ in range(crash_after):
+            task = d.get(0)
+            applied.append((task.shard_name, task.start, task.end))
+            d.report(task.task_id, 0, success=True)
+        stranded = d.get(0)            # leased, never reported — the
+        j.abort()                      # crash strands it in flight
+        j2, d2 = boot(tmp)
+        while not d2.finished():
+            task = d2.get(0)
+            if task is None:
+                d2.poke()
+                continue
+            applied.append((task.shard_name, task.start, task.end))
+            d2.report(task.task_id, 0, success=True)
+        counts = d2.counts()
+        j2.close()
+    spans = sorted(applied)
+    return {
+        "generation": j2.generation,
+        "stranded_lease_requeued": stranded is not None,
+        "exactly_once": spans == sorted(set(spans)) and len(spans) == n_tasks,
+        "counts": {k: counts[k] for k in
+                   ("finished_training", "todo", "doing",
+                    "failed_permanently")},
+        "spans": spans,
+    }
+
+
+def bench_control_plane(mesh=None, np=None):
+    """Control-plane throughput (ISSUE 8; ROADMAP 3): the 2x2 matrix
+    {per-commit, group-commit} x {lease batch 1, N} over a simulated
+    worker swarm, heartbeat fan-in point-to-point vs cohort-coalesced,
+    and a kill-master replay-accounting identity check across commit
+    modes. `mesh`/`np` are ignored (no devices touched — the leg runs on
+    any box); kept for the uniform leg signature."""
+    from elasticdl_tpu.observability import tracing
+
+    tracing.configure(role="bench-control-plane")
+    trace_id = tracing.new_trace_id()
+    out = {
+        "workers": CP_WORKERS, "tasks_per_mode": CP_TASKS,
+        "lease_batch": CP_BATCH, "group_commit_ms": CP_GROUP_MS,
+    }
+    modes = {
+        "per_commit_b1": (0.0, 1),
+        f"per_commit_b{CP_BATCH}": (0.0, CP_BATCH),
+        "group_commit_b1": (CP_GROUP_MS, 1),
+        f"group_commit_b{CP_BATCH}": (CP_GROUP_MS, CP_BATCH),
+    }
+    with tracing.adopt(trace_id):
+        with tracing.span("control_plane", workers=CP_WORKERS):
+            results = {}
+            for label, (gms, batch) in modes.items():
+                results[label] = _cp_drain(
+                    label, gms, batch, CP_WORKERS, CP_TASKS)
+            out["modes"] = results
+            out["heartbeats"] = _cp_heartbeats(
+                CP_WORKERS, CP_HEARTBEATS, CP_COHORT)
+            with tracing.span("control_plane.replay_check"):
+                per = _cp_replay_check(0.0, crash_after=7)
+                grp = _cp_replay_check(CP_GROUP_MS, crash_after=7)
+            out["replay_check"] = {
+                "per_commit": {k: v for k, v in per.items() if k != "spans"},
+                "group_commit": {k: v for k, v in grp.items() if k != "spans"},
+                # THE acceptance identity: crash-replay accounting must not
+                # depend on the commit mode
+                "identical": per["spans"] == grp["spans"]
+                and per["counts"] == grp["counts"],
+            }
+    base = results["per_commit_b1"]["leases_per_sec"]
+    best = results[f"group_commit_b{CP_BATCH}"]["leases_per_sec"]
+    out["speedup_group_batched_vs_per_commit_b1"] = (
+        round(best / base, 2) if base else 0.0
+    )
+    out["trace_id"] = trace_id
+
+    art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+    if art_dir:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "bench-control-plane-trace.jsonl"),
+                  "w") as f:
+            for rec in tracing.get_tracer().records:
+                f.write(json.dumps(rec) + "\n")
+    return out
+
+
 def bench_host_pipeline(np):
     """Host half of the input path ONLY — disk → contiguous span read →
     binary decode — with no JAX backend touched anywhere (verified: the
@@ -965,6 +1349,8 @@ def _run_leg(leg, mesh, np):
         return bench_time_to_auc(mesh, np)
     if leg == "rescale":
         return bench_rescale(mesh, np)
+    if leg == "control_plane":
+        return bench_control_plane(mesh, np)
     if leg == "transformer_lm":
         # the Pallas flash-attention kernel vs the XLA materialized-scores
         # path, same model/batch (ops/pallas_attention.py; TPU only — on CPU
@@ -1004,8 +1390,9 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "rescale", "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
-    "census_wide_deep", "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
+    "rescale", "control_plane", "embedding", "transformer_lm", "time_to_auc",
+    "mnist_cnn", "census_wide_deep", "xdeepfm", "cifar10_resnet20",
+    "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
@@ -1069,6 +1456,13 @@ def _probe_tunnel():
 
 
 def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "control_plane":
+        # `python bench.py control_plane`: the swarm scenario alone, one
+        # JSON line — deliberately BEFORE any jax import (no devices are
+        # touched; the leg must run on a box with no backend at all)
+        print(json.dumps({"control_plane": bench_control_plane()}))
+        return
+
     import subprocess
 
     import jax

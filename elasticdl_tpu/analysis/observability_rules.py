@@ -30,6 +30,19 @@ EDL402 span-emit-under-lock
     Emit after releasing: compute inside the lock, emit outside (the
     membership/dispatcher pattern), or open the span around the `with
     self._lock:` block (the process-manager pattern).
+
+EDL403 fsync-under-lock
+    An ``os.fsync`` call lexically inside a `guarded_by:`-annotated
+    lock's critical section. An fsync is milliseconds on local disk and
+    tens of milliseconds on NFS/GCS-FUSE; under a control-plane lock it
+    serializes every mutator behind the disk and bounds master dispatch
+    throughput to ~1/fsync-latency fleet-wide — the exact wall the
+    journal's group-commit pipeline (master/journal.py) exists to remove.
+    The idiom this codifies: mutators ENQUEUE onto the journal's commit
+    queue under their lock and wait for durability after releasing; only
+    the journal's committer (and reviewed leaf-I/O teardown paths, via
+    explicit `# edl-lint: disable=EDL403` with justification) fsyncs
+    while holding a lock.
 """
 
 from __future__ import annotations
@@ -150,19 +163,21 @@ def _is_emit_call(node: ast.Call, direct_names: Set[str]) -> bool:
     return False
 
 
-class _EmitUnderLockVisitor(ast.NodeVisitor):
+class _CallUnderLockVisitor(ast.NodeVisitor):
     """Walk one method body tracking which class locks are lexically held
     (same `with self.<lock>` semantics as EDL101's visitor), flagging
-    span/event emission calls while any of them is."""
+    calls matching `predicate` while any of them is. Shared by EDL402
+    (span/event emission) and EDL403 (os.fsync)."""
 
     def __init__(self, rule: Rule, ctx: ModuleContext,
                  class_locks: Set[str], held: Set[str],
-                 direct_names: Set[str]):
+                 predicate, message_fn):
         self.rule = rule
         self.ctx = ctx
         self.class_locks = class_locks
         self.held = set(held)
-        self.direct_names = direct_names
+        self.predicate = predicate
+        self.message_fn = message_fn
         self.findings: List[Finding] = []
 
     def visit_With(self, node: ast.With) -> None:
@@ -205,21 +220,12 @@ class _EmitUnderLockVisitor(ast.NodeVisitor):
         self._visit_deferred(node)
 
     def visit_Call(self, node: ast.Call) -> None:
-        if self.held and _is_emit_call(node, self.direct_names):
+        if self.held and self.predicate(node):
             locks = ", ".join(sorted(self.held))
-            kind = (
-                node.func.attr if isinstance(node.func, ast.Attribute)
-                else node.func.id
-            )
             self.findings.append(
                 self.rule.finding(
                     ctx=self.ctx, node=node,
-                    message=(
-                        f"{kind} emission inside the critical section of "
-                        f"self.{locks} — trace emission is file I/O under "
-                        "the tracer lock; emit after releasing, or open "
-                        "the span around the lock (EDL402)"
-                    ),
+                    message=self.message_fn(node, locks),
                 )
             )
         self.generic_visit(node)
@@ -236,26 +242,105 @@ class SpanEmitUnderLockRule(Rule):
 
     def check(self, ctx: ModuleContext) -> Iterator[Finding]:
         direct_names = _direct_emit_imports(ctx.tree)
-        for cls in ast.walk(ctx.tree):
-            if not isinstance(cls, ast.ClassDef):
+
+        def message(node: ast.Call, locks: str) -> str:
+            kind = (
+                node.func.attr if isinstance(node.func, ast.Attribute)
+                else node.func.id
+            )
+            return (
+                f"{kind} emission inside the critical section of "
+                f"self.{locks} — trace emission is file I/O under "
+                "the tracer lock; emit after releasing, or open "
+                "the span around the lock (EDL402)"
+            )
+
+        yield from _scan_calls_under_locks(
+            self, ctx, lambda node: _is_emit_call(node, direct_names),
+            message,
+        )
+
+
+def _scan_calls_under_locks(rule, ctx, predicate, message_fn):
+    """Run the held-lock call scan over every guarded class (the shared
+    chassis of EDL402/EDL403)."""
+    for cls in ast.walk(ctx.tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        guarded = guarded_attrs(ctx, cls)
+        if not guarded:
+            continue
+        class_locks = set(guarded.values())
+        for node in cls.body:
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
                 continue
-            guarded = guarded_attrs(ctx, cls)
-            if not guarded:
+            if node.name in _CONSTRUCTION_METHODS:
+                # construction happens-before publication: the lock
+                # cannot be contended yet (EDL101's exemption)
                 continue
-            class_locks = set(guarded.values())
-            for node in cls.body:
-                if not isinstance(
-                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
-                ):
-                    continue
-                if node.name in _CONSTRUCTION_METHODS:
-                    # construction happens-before publication: the lock
-                    # cannot be contended yet (EDL101's exemption)
-                    continue
-                held = method_held_locks(ctx, node, class_locks) & class_locks
-                visitor = _EmitUnderLockVisitor(
-                    self, ctx, class_locks, held, direct_names
-                )
-                for stmt in node.body:
-                    visitor.visit(stmt)
-                yield from visitor.findings
+            held = method_held_locks(ctx, node, class_locks) & class_locks
+            visitor = _CallUnderLockVisitor(
+                rule, ctx, class_locks, held, predicate, message_fn
+            )
+            for stmt in node.body:
+                visitor.visit(stmt)
+            yield from visitor.findings
+
+
+# ------------------------------------------------------------------ #
+# EDL403 fsync-under-lock
+
+
+def _direct_fsync_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to os.fsync by `from os import fsync` (aliases)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "os":
+            for alias in node.names:
+                if alias.name == "fsync":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_fsync_call(node: ast.Call, direct_names: Set[str]) -> bool:
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in direct_names
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "fsync"
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "os"
+    )
+
+
+@register
+class FsyncUnderLockRule(Rule):
+    id = "EDL403"
+    name = "fsync-under-lock"
+    doc = (
+        "os.fsync inside a guarded_by-annotated lock's critical section — "
+        "per-commit fsync under a control-plane lock serializes every "
+        "mutator behind the disk; enqueue on the journal's group-commit "
+        "queue and wait after releasing instead"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct_names = _direct_fsync_imports(ctx.tree)
+
+        def message(node: ast.Call, locks: str) -> str:
+            return (
+                f"os.fsync inside the critical section of self.{locks} — "
+                "this bounds fleet-wide throughput to ~1/fsync-latency; "
+                "route the record through the journal's group-commit "
+                "queue and wait for durability AFTER releasing the lock "
+                "(EDL403; the journal committer and reviewed leaf-I/O "
+                "teardowns carry explicit disables)"
+            )
+
+        yield from _scan_calls_under_locks(
+            self, ctx, lambda node: _is_fsync_call(node, direct_names),
+            message,
+        )

@@ -186,6 +186,22 @@ class JobConfig:
     # may lose transitions still in the page cache; workers then redo the
     # affected tasks — at-least-once, never silent loss) for throughput.
     journal_fsync: bool = True
+    # Journal group-commit window (ms). 0 = per-commit mode (the
+    # journal_fsync tradeoff above in full). >0 = mutators enqueue onto an
+    # ordered commit queue and a committer thread flushes the whole window
+    # under ONE write+fsync; RPC responses that acknowledge a journaled
+    # transition are released only after their commit's fsync lands
+    # (ack-after-fsync), so durability is NOT weakened — per-request fsync
+    # cost is amortized across every commit in the window instead. See
+    # docs/performance.md "Control-plane throughput".
+    journal_group_commit_ms: float = 0.0
+    # Batched task leases: workers ask for up to this many tasks per
+    # GetTask round-trip (one group-committed journal batch) and drain the
+    # local lease queue before re-polling. 1 = classic one-lease-per-poll.
+    # Sizing caveat: the master's task_timeout_s clock starts at LEASE
+    # time for every task in the batch — keep batch * per-task wall time
+    # well under task_timeout_s or tail leases expire while queued.
+    task_lease_batch: int = 1
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
@@ -247,6 +263,21 @@ class JobConfig:
             raise ValueError("grad_accum_steps must be >= 1")
         if self.master_restarts < 0:
             raise ValueError("master_restarts must be >= 0")
+        if self.journal_group_commit_ms < 0:
+            raise ValueError("journal_group_commit_ms must be >= 0 (0 = "
+                             "per-commit fsync)")
+        if self.journal_group_commit_ms > 10_000:
+            # Commit.wait gives a flush 30s before declaring the journal
+            # wedged; a window at (or past) that order would fail every
+            # journaled RPC before its batch could ever flush. 10s is
+            # already far beyond any sane fsync latency it could amortize.
+            raise ValueError(
+                "journal_group_commit_ms must be <= 10000 (the window is "
+                "latency every journaled ack pays; size it near your "
+                "fsync latency — see docs/performance.md)"
+            )
+        if self.task_lease_batch < 1:
+            raise ValueError("task_lease_batch must be >= 1")
         if self.master_restarts > 0 and not self.checkpoint_dir:
             # a journal-less successor rebuilds the dispatcher from scratch
             # — every already-finished task would be recreated and re-run,

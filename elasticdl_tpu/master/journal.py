@@ -26,10 +26,26 @@ mutation order):
     member_join / member_death         — membership transitions
     world_version                      — cohort world-version bumps
 
-Durability contract: ``append`` returns only after the record is flushed
-and fsynced, so any transition the master *acted on* (a lease granted, a
-report accepted) is on disk before the effect is observable — a crash can
-lose at most a transition that no one was told about yet.
+Durability contract: a transition the master *acted on* (a lease granted,
+a report accepted) is on disk before the effect is observable — a crash
+can lose at most a transition that no one was told about yet. HOW that is
+achieved depends on the commit mode:
+
+- **per-commit** (``group_commit_ms == 0``, the PR 5 behavior): ``append``
+  writes + flushes + fsyncs before returning, inside the journal lock.
+- **group-commit** (``group_commit_ms > 0``): mutators only ENQUEUE their
+  records onto an ordered in-memory commit queue (still inside their own
+  owning lock, so queue order — and therefore disk order — IS mutation
+  order), and a committer thread flushes the whole queue under ONE
+  write + fsync within the bounded window. ``append``/``append_many``
+  return a :class:`Commit` handle; the caller releases its owning lock
+  and then ``wait()``s on the handle *before* acknowledging anything to a
+  worker (ack-after-fsync). Nothing acknowledged can be lost; what a
+  crash CAN lose is a queued-but-unflushed suffix no one was told about —
+  exactly per-commit mode's lost-response window, so crash-replay
+  accounting is identical across both modes. A whole flushed group rides
+  ONE ``batch`` journal line: a torn group write drops the group whole at
+  replay, never a parseable prefix of a multi-record commit.
 
 Recovery contract: opening an existing journal replays it to the final
 state, **bumps the master generation**, and atomically rotates the file
@@ -50,6 +66,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from dataclasses import asdict, dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -78,6 +95,18 @@ _RECOVERIES = _reg.counter(
     "edl_master_recoveries_total", "master boots that replayed a journal")
 _GENERATION = _reg.gauge(
     "edl_master_generation", "current master generation")
+_GROUP_FLUSHES = _reg.counter(
+    "edl_journal_group_commit_flushes_total",
+    "group-commit flushes (one write+fsync each)")
+_GROUP_RECORDS = _reg.counter(
+    "edl_journal_group_commit_records_total",
+    "records committed through the group-commit queue")
+_GROUP_BATCH = _reg.histogram(
+    "edl_journal_group_commit_batch_records",
+    "records per group-commit flush")
+_COMMIT_LATENCY = _reg.histogram(
+    "edl_journal_commit_latency_seconds",
+    "enqueue-to-durable latency per commit (both modes)")
 
 
 @dataclass
@@ -224,7 +253,8 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                     membership.workers.remove(w)
                     break
             membership.workers.append(
-                {"worker_id": wid, "name": rec.get("name", ""), "alive": True}
+                {"worker_id": wid, "name": rec.get("name", ""), "alive": True,
+                 "led_by": rec.get("led_by")}
             )
             membership.next_id = max(membership.next_id, wid + 1)
             membership.version = max(membership.version, int(rec.get("version", 0)))
@@ -301,24 +331,167 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     return result
 
 
+def _render(recs: List[Dict[str, Any]]) -> str:
+    """Serialize one commit (or one group flush) as ONE journal line —
+    multi-record payloads ride a ``batch`` wrapper so a torn write drops
+    them whole at replay, never as a parseable prefix."""
+    if len(recs) == 1:
+        return json.dumps(recs[0]) + "\n"
+    return json.dumps({"t": "batch", "records": recs}) + "\n"
+
+
+class JournalCommitError(RuntimeError):
+    """A group commit could not be made durable (flush failed or timed
+    out). Callers must NOT acknowledge the transition they enqueued."""
+
+
+# shared pre-completed event for per-commit / no-journal commits — wait()
+# on these returns immediately
+_DONE_EVENT = threading.Event()
+_DONE_EVENT.set()
+
+
+class Commit:
+    """Durability handle for one journal commit.
+
+    ``wait()`` blocks until the commit's records are flushed + fsynced
+    (a no-op in per-commit mode, where ``append`` already did the fsync).
+    The ack-after-fsync contract: release your owning lock, ``wait()``,
+    THEN send the RPC response that acknowledges the transition."""
+
+    __slots__ = ("_event", "_batch")
+
+    def __init__(self, event: threading.Event = _DONE_EVENT, batch=None):
+        self._event = event
+        self._batch = batch
+
+    def wait(self, timeout_s: float = 30.0) -> None:
+        if not self._event.wait(timeout_s):
+            raise JournalCommitError(
+                f"journal group commit not durable after {timeout_s:.0f}s "
+                "(committer wedged or disk stalled)"
+            )
+        err = getattr(self._batch, "error", None)
+        if err is not None:
+            raise JournalCommitError(f"journal group commit failed: {err!r}")
+
+
+class CommitGate:
+    """Mixin: the ack-after-fsync plumbing shared by journal-owning
+    control-plane components (TaskDispatcher, Membership).
+
+    The owning class declares ``self._journal`` (or None) and
+    ``self._pending_commit = None  # guarded_by: _lock`` in its own
+    ``__init__``. The protocol: mutators call :meth:`_j` (or assign
+    ``self._pending_commit`` from ``append_many`` directly) INSIDE their
+    ``_lock`` critical section, take the parked commit with
+    :meth:`_take_commit_locked` in the SAME lock hold, and
+    :meth:`_await` it after release — before sending any RPC response
+    that acknowledges the journaled transition. In per-commit mode the
+    wait is a no-op (append already fsynced)."""
+
+    _journal = None
+    _pending_commit = None
+
+    def _j(self, rtype: str, **fields: Any) -> None:  # holds: _lock
+        """Enqueue one journal record (no-op without a journal); the
+        Commit parks on ``_pending_commit`` for the take-and-await."""
+        if self._journal is not None:
+            self._pending_commit = self._journal.append(rtype, **fields)
+
+    def _take_commit_locked(self):  # holds: _lock
+        """The last commit this critical section enqueued (None if none).
+        Flush order is enqueue order, so waiting on the LAST commit also
+        covers every earlier record of the same critical section (a lost
+        earlier window poisons the journal, failing later waits too)."""
+        commit, self._pending_commit = self._pending_commit, None
+        return commit
+
+    @staticmethod
+    def _await(commit: Optional[Commit]) -> None:
+        """Ack-after-fsync barrier: block (outside the lock) until the
+        critical section's journal records are durable. A commit that
+        cannot be made durable raises — the caller's RPC fails instead of
+        acknowledging a transition the disk never saw."""
+        if commit is not None:
+            commit.wait()
+
+
+class _OpenBatch:
+    """The commit queue between two flushes: records land here in mutation
+    order (enqueued under the mutator's owning lock), the committer swaps
+    the whole batch out and flushes it under one fsync."""
+
+    __slots__ = ("records", "enqueued_at", "opened_at", "event", "error")
+
+    def __init__(self):
+        self.records: List[Dict[str, Any]] = []
+        self.enqueued_at: List[float] = []   # perf_counter per commit
+        self.opened_at = 0.0                 # monotonic, first enqueue
+        self.event = threading.Event()
+        self.error: Optional[BaseException] = None
+
+
 class ControlPlaneJournal:
     """Append-only WAL with atomic rotation and a persisted generation.
 
     Thread-safe; appends are called from inside the dispatcher's and
     membership's ``_lock`` critical sections (lock order: owner lock ->
-    journal ``_lock``; the journal never calls back out, so no cycle).
+    journal ``_lock``/``_qcv``; the journal never calls back out, so no
+    cycle). With ``group_commit_ms > 0`` appends only enqueue (no I/O
+    under the owning lock) and a committer thread owns the write+fsync —
+    callers wait on the returned :class:`Commit` AFTER releasing their
+    lock, before acknowledging the transition.
     """
 
-    def __init__(self, checkpoint_dir: str, fsync: bool = True):
+    def __init__(self, checkpoint_dir: str, fsync: bool = True,
+                 group_commit_ms: float = 0.0):
         self.dir = os.path.join(checkpoint_dir, JOURNAL_DIRNAME)
         self.path = os.path.join(self.dir, JOURNAL_FILENAME)
         self._fsync = fsync
+        self._window_s = max(0.0, group_commit_ms) / 1000.0
+        if self._window_s > 10.0:
+            # config.validate rejects this at submit time; direct
+            # constructions (tests, bench) get the clamp so a window can
+            # never approach Commit.wait's 30s wedge deadline
+            logger.warning(
+                "journal group-commit window clamped %.0fms -> 10000ms",
+                self._window_s * 1000,
+            )
+            self._window_s = 10.0
         self._lock = threading.Lock()
         self._fh = None                      # guarded_by: _lock
+        # group-commit queue state: _qcv (a Condition) guards the open
+        # batch; NEVER held during I/O, so enqueuers — who hold their own
+        # control-plane lock — never block behind an fsync
+        self._qcv = threading.Condition(threading.Lock())
+        self._queue = _OpenBatch()           # guarded_by: _qcv
+        self._closing = False                # guarded_by: _qcv
+        # First flush failure poisons the journal: a failed write can
+        # leave a PARTIAL line, and appending past it would fuse the next
+        # flush into one unparseable line — silently dropping acknowledged
+        # records at replay. Worse, a later window's successful fsync
+        # would let its waiters ack while an EARLIER window's records are
+        # not on disk (flush order == ack-validity order only while every
+        # flush succeeds). Once poisoned, every queued and future commit
+        # fails its wait() — no ack ever leaves for an undurable record.
+        self._poisoned: Optional[BaseException] = None   # guarded_by: _qcv
+        self._committer: Optional[threading.Thread] = None
         self.generation = 1
         self.recovered = False
         self.replay: Optional[ReplayResult] = None
         self._open()
+        if self._window_s > 0:
+            self._committer = threading.Thread(
+                target=self._committer_loop,
+                name="journal-committer",
+                daemon=True,
+            )
+            self._committer.start()
+
+    @property
+    def group_commit(self) -> bool:
+        return self._window_s > 0
 
     # -------------------------------------------------------------- #
     # open / rotate / replay
@@ -388,6 +561,9 @@ class ControlPlaneJournal:
                     "world_version": self.replay.world_version,
                 }) + "\n")
             f.flush()
+            # boot-time rotation: single-threaded (the append handle is
+            # not open yet), so no mutator can queue behind this fsync:
+            # edl-lint: disable=EDL403
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
         self._fsync_dir()
@@ -413,31 +589,32 @@ class ControlPlaneJournal:
     # -------------------------------------------------------------- #
     # append path
 
-    def append(self, rtype: str, **fields: Any) -> None:
-        """Commit one transition record: write + flush + fsync."""
-        self.append_many([(rtype, fields)])
+    def append(self, rtype: str, **fields: Any) -> Commit:
+        """Commit one transition record; see :meth:`append_many`."""
+        return self.append_many([(rtype, fields)])
 
-    def append_many(self, records: List[Tuple[str, Dict[str, Any]]]) -> None:
-        """Commit a batch of records under ONE fsync (bulk task creation).
+    def append_many(self, records: List[Tuple[str, Dict[str, Any]]]) -> Commit:
+        """Commit a batch of records under ONE fsync (bulk task creation,
+        batched lease grants).
 
         A multi-record batch is serialized as ONE ``batch`` line: a large
         batch can span several write(2) syscalls, and a crash between them
         must not persist a parseable prefix (an ``epoch_advance`` with only
         some of its ``task_create`` lines would replay a partial epoch).
         One line is either whole at replay or a torn tail dropped whole —
-        the batch commits all-or-nothing."""
+        the batch commits all-or-nothing.
+
+        Per-commit mode: the records are durable when this returns (the
+        returned Commit is pre-completed). Group-commit mode: the records
+        are ENQUEUED in call order; ``wait()`` the returned Commit (after
+        releasing your owning lock) before acknowledging the transition."""
         if not records:
-            return
-        if len(records) == 1:
-            rtype, fields = records[0]
-            data = json.dumps({"t": rtype, **fields}) + "\n"
-        else:
-            data = json.dumps({
-                "t": "batch",
-                "records": [
-                    {"t": rtype, **fields} for rtype, fields in records
-                ],
-            }) + "\n"
+            return Commit()
+        recs = [{"t": rtype, **fields} for rtype, fields in records]
+        if self._window_s > 0:
+            return self._enqueue(recs)
+        data = _render(recs)
+        t0 = time.perf_counter()
         with self._lock:
             if self._fh is None:
                 # post-close append (a component outliving its master after
@@ -447,19 +624,181 @@ class ControlPlaneJournal:
                     "journal append after close dropped (%d record(s))",
                     len(records),
                 )
-                return
+                return Commit()
             self._fh.write(data)
             self._fh.flush()
             if self._fsync:
-                os.fsync(self._fh.fileno())
+                # the one sanctioned per-commit fsync site: the journal
+                # lock is a leaf I/O lock, not a control-plane lock — the
+                # group-commit committer is the scalable path
+                os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403
         _APPENDS.inc(len(records))
+        _COMMIT_LATENCY.observe(time.perf_counter() - t0)
+        return Commit()
+
+    # -------------------------------------------------------------- #
+    # group-commit pipeline
+
+    def _enqueue(self, recs: List[Dict[str, Any]]) -> Commit:
+        """Queue one commit's records onto the open batch (called under the
+        mutator's owning lock — cheap: list appends, no I/O). Queue order
+        is mutation order, and the committer flushes in queue order, so
+        disk order stays mutation order exactly as in per-commit mode."""
+        with self._qcv:
+            if self._poisoned is not None:
+                return self._failed_commit(self._poisoned)
+            if self._closing:
+                logger.warning(
+                    "journal append after close dropped (%d record(s))",
+                    len(recs),
+                )
+                return Commit()
+            batch = self._queue
+            if not batch.records:
+                batch.opened_at = time.monotonic()
+            batch.records.extend(recs)
+            batch.enqueued_at.append(time.perf_counter())
+            self._qcv.notify_all()
+            return Commit(batch.event, batch)
+
+    def _committer_loop(self) -> None:
+        """The single committer: waits for the open batch to fill its
+        bounded window (``--journal_group_commit_ms``), swaps it out, and
+        flushes it under one write+fsync. Only this thread (and close())
+        touches the file handle in group-commit mode."""
+        while True:
+            with self._qcv:
+                while not self._queue.records and not self._closing:
+                    self._qcv.wait()
+                if self._closing:
+                    # close() drains or aborts the remaining queue itself
+                    return
+                deadline = self._queue.opened_at + self._window_s
+                while not self._closing:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._qcv.wait(remaining)
+                batch, self._queue = self._queue, _OpenBatch()
+            if batch.records:
+                # a close() racing the window wait can hand us a freshly
+                # swapped EMPTY batch — flushing it would write a spurious
+                # empty batch line and count a zero-record flush
+                self._flush_batch(batch)
+            else:
+                batch.event.set()
+
+    @staticmethod
+    def _failed_commit(err: BaseException) -> Commit:
+        batch = _OpenBatch()
+        batch.error = err
+        batch.event.set()
+        return Commit(batch.event, batch)
+
+    def _flush_batch(self, batch: _OpenBatch) -> None:
+        """One write + flush + fsync for everything queued since the last
+        flush, serialized as ONE line (all-or-nothing at replay), then
+        release every commit waiting on it. Never raises: a flush failure
+        parks the error on the batch, POISONS the journal (the failed
+        write may have torn the tail — writing past it would fuse lines
+        and drop acknowledged records at replay; and a later successful
+        flush must not release acks ordered after a lost window), and
+        every ``wait()`` re-raises — no gated ack ever goes out."""
+        with self._qcv:
+            poisoned = self._poisoned
+        if poisoned is not None:
+            batch.error = poisoned
+            batch.event.set()
+            return
+        t_flush = time.perf_counter()
+        try:
+            data = _render(batch.records)
+            with self._lock:
+                if self._fh is None:
+                    raise JournalCommitError("journal closed under committer")
+                self._fh.write(data)
+                self._fh.flush()
+                if self._fsync:
+                    # the group-commit fsync: ONE syscall for the whole
+                    # window's commits, on the committer thread — never
+                    # under a control-plane lock (the EDL403 idiom)
+                    os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403
+        except BaseException as e:
+            batch.error = e
+            with self._qcv:
+                self._poisoned = e
+                self._qcv.notify_all()
+            logger.exception(
+                "journal group-commit flush FAILED (%d record(s)); their "
+                "acks will not be released and the journal is POISONED — "
+                "every further commit fails until a new master takes over",
+                len(batch.records),
+            )
+        finally:
+            batch.event.set()
+        if batch.error is None:
+            _APPENDS.inc(len(batch.records))
+            _GROUP_FLUSHES.inc()
+            _GROUP_RECORDS.inc(len(batch.records))
+            _GROUP_BATCH.observe(len(batch.records))
+            now = time.perf_counter()
+            for t0 in batch.enqueued_at:
+                _COMMIT_LATENCY.observe(now - t0)
+            if now - t_flush > 1.0:
+                logger.warning(
+                    "slow journal group-commit flush: %.2fs for %d records",
+                    now - t_flush, len(batch.records),
+                )
+
+    def _stop_committer(self, drain: bool) -> None:
+        """Wind the committer down. ``drain=True`` (orderly close) flushes
+        whatever is still queued; ``drain=False`` (simulated crash) drops
+        it — exactly what SIGKILL would lose: queued records whose acks
+        were never released — and fails any waiters."""
+        with self._qcv:
+            self._closing = True
+            batch, self._queue = self._queue, _OpenBatch()
+            self._qcv.notify_all()
+        if self._committer is not None:
+            self._committer.join(timeout=10.0)
+            self._committer = None
+        if not batch.records:
+            return
+        if drain:
+            self._flush_batch(batch)
+        else:
+            batch.error = JournalCommitError(
+                "journal crashed with the commit queued but not flushed"
+            )
+            batch.event.set()
+            logger.warning(
+                "journal crash-close dropped %d queued record(s) "
+                "(unacknowledged by construction)", len(batch.records),
+            )
 
     def close(self) -> None:
+        """Orderly close: drain the commit queue, then fsync + close."""
+        self._close(drain=True)
+
+    def abort(self) -> None:
+        """Simulated-crash close (Master.crash): queued-but-unflushed
+        commits are DROPPED, as SIGKILL would — nothing they gated was
+        acknowledged, so the successor's replay accounting is identical
+        to a real kill."""
+        self._close(drain=False)
+
+    def _close(self, drain: bool) -> None:
+        if self._window_s > 0:
+            self._stop_committer(drain)
         with self._lock:
             if self._fh is not None:
                 try:
                     self._fh.flush()
                     if self._fsync:
+                        # teardown: the committer is already stopped and
+                        # mutators' post-close appends drop — nothing can
+                        # queue behind this final fsync:
+                        # edl-lint: disable=EDL403
                         os.fsync(self._fh.fileno())
                 finally:
                     self._fh.close()

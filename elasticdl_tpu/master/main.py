@@ -95,7 +95,10 @@ class Master:
         # of a job-killing one. Opening the journal FIRST (before dispatcher
         # and membership) means their constructors see the replayed state.
         self.journal = (
-            ControlPlaneJournal(cfg.checkpoint_dir, fsync=cfg.journal_fsync)
+            ControlPlaneJournal(
+                cfg.checkpoint_dir, fsync=cfg.journal_fsync,
+                group_commit_ms=cfg.journal_group_commit_ms,
+            )
             if cfg.checkpoint_dir else None
         )
         if self.journal is not None and self.journal.recovered:
@@ -323,7 +326,9 @@ class Master:
                 logger.debug("crashed master: metrics stop failed", exc_info=True)
             self.metrics_server = None
         if self.journal is not None:
-            self.journal.close()
+            # abort, not close: queued group commits whose acks were never
+            # released are dropped, exactly as SIGKILL would drop them
+            self.journal.abort()
         logger.warning("master CRASHED (simulated): serving stopped abruptly")
 
     def shutdown(self, grace_s: float = 5.0) -> None:

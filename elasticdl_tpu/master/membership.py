@@ -23,9 +23,10 @@ from __future__ import annotations
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Dict, List
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.master.journal import CommitGate
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.registry import default_registry
 
@@ -40,9 +41,19 @@ _MB_REAPED = _reg.counter(
     "edl_membership_reaped_total",
     "workers declared dead by heartbeat-timeout reaping")
 _MB_ALIVE = _reg.gauge(
-    "edl_membership_alive_workers", "currently alive workers")
+    "edl_membership_alive_workers",
+    "currently alive logical workers (cohort leaders + singletons)")
 _MB_VERSION = _reg.gauge(
     "edl_membership_version", "current membership version")
+_MB_MEMBERS = _reg.gauge(
+    "edl_membership_cohort_members",
+    "registered cohort member processes (telemetry entities; liveness "
+    "rides their leader's beat)")
+_MB_BEATS = _reg.counter(
+    "edl_membership_heartbeats_total", "heartbeat RPCs applied")
+_MB_COALESCED = _reg.counter(
+    "edl_membership_coalesced_beats_total",
+    "member beats carried inside a leader's single heartbeat")
 
 
 @dataclass
@@ -52,9 +63,21 @@ class WorkerInfo:
     last_heartbeat: float
     model_version: int = 0
     alive: bool = True
+    # cohort membership: set = this entry is a member PROCESS of the
+    # cohort led by that worker id. Members are telemetry entities — they
+    # are skipped by reap scans (their liveness IS the leader's beat),
+    # never bump the membership version, and die with their leader.
+    led_by: Optional[int] = None
 
 
-class Membership:
+class Membership(CommitGate):
+    #: server-side ceiling on one cohort's member registrations — the
+    #: membership twin of the servicer's MAX_LEASE_BATCH: a corrupted or
+    #: hostile RegisterWorker must not allocate unbounded WorkerInfo
+    #: entries, build an unbounded journal batch line, and hold the
+    #: membership lock throughout, all from one RPC
+    MAX_COHORT_MEMBERS = 4096
+
     def __init__(self, heartbeat_timeout_s: float = 30.0, journal=None):
         self._lock = threading.Lock()
         # Crash durability (master/journal.py): join/death transitions are
@@ -63,6 +86,8 @@ class Membership:
         # reconnecting worker to shut down as an unknown. None = volatile.
         self._journal = journal
         self._workers: Dict[int, WorkerInfo] = {}    # guarded_by: _lock
+        # last journal Commit of the current critical section (see _j)
+        self._pending_commit = None                  # guarded_by: _lock
         # rolling per-worker heartbeat telemetry (health.py records);
         # NEVER reset by reregister/mark_dead — see module docstring
         self._health: Dict[int, Dict] = {}           # guarded_by: _lock
@@ -86,11 +111,13 @@ class Membership:
         now = time.time()
         for w in snap.workers:
             wid = int(w["worker_id"])
+            led_by = w.get("led_by")
             self._workers[wid] = WorkerInfo(
                 worker_id=wid,
                 name=w.get("name", ""),
                 last_heartbeat=now,
                 alive=bool(w.get("alive", True)),
+                led_by=int(led_by) if led_by is not None else None,
             )
         self._next_id = snap.next_id
         self._version = snap.version
@@ -102,10 +129,10 @@ class Membership:
             self._alive_count_locked(),
         )
 
-    def _j(self, rtype: str, **fields) -> None:  # holds: _lock
-        """Commit one journal record (no-op without a journal)."""
-        if self._journal is not None:
-            self._journal.append(rtype, **fields)
+    # _j / _take_commit_locked / _await come from CommitGate
+    # (master/journal.py) — the ack-after-fsync plumbing shared with the
+    # dispatcher, e.g. the RegisterWorker response that tells a worker
+    # its id must not leave before the join is on disk
 
     def add_death_callback(self, cb: Callable[[int], None]) -> None:
         """cb(worker_id) fires when a worker is declared dead — wire this to
@@ -136,11 +163,84 @@ class Membership:
                 "worker %d (%s) joined; membership v%d, %d alive",
                 wid, name, self._version, self._alive_count_locked(),
             )
+            commit = self._take_commit_locked()
+        # ack-after-fsync: the response hands the worker an id it will
+        # lease under — the join must be durable first
+        self._await(commit)
         tracing.event(
             "membership.join", worker_id=info.worker_id, worker_name=name,
             version=version,
         )
         return info
+
+    def register_members(
+        self, leader_id: int, names: Sequence[str]
+    ) -> List[WorkerInfo]:
+        """Register a cohort leader's member processes in ONE pass under
+        the lock and ONE journal commit (cohort-aggregated membership).
+
+        Members are telemetry entities, not rendezvous participants: the
+        cohort is still ONE logical worker, so member joins bump NO
+        membership version (a bump would re-form the mesh) and reap scans
+        skip them (their liveness is the leader's beat). Idempotent by
+        (name, leader): a leader re-registering after a master restart
+        gets the same member ids back, revived if the outage reaped the
+        cohort."""
+        if len(names) > self.MAX_COHORT_MEMBERS:
+            raise ValueError(
+                f"cohort of {len(names)} members exceeds the "
+                f"{self.MAX_COHORT_MEMBERS}-member registration cap"
+            )
+        with self._lock:
+            leader = self._workers.get(leader_id)
+            if leader is None or leader.led_by is not None:
+                raise KeyError(
+                    f"worker {leader_id} is not a registered cohort leader"
+                )
+            by_name = {
+                w.name: w for w in self._workers.values()
+                if w.led_by == leader_id
+            }
+            infos: List[WorkerInfo] = []
+            records: List[Tuple[str, Dict]] = []
+            now = time.time()
+            for name in names:
+                info = by_name.get(name)
+                if info is None:
+                    info = WorkerInfo(
+                        worker_id=self._next_id, name=name,
+                        last_heartbeat=now, led_by=leader_id,
+                    )
+                    self._next_id += 1
+                    self._workers[info.worker_id] = info
+                    records.append((
+                        "member_join",
+                        {"worker_id": info.worker_id, "name": name,
+                         "version": self._version, "led_by": leader_id},
+                    ))
+                else:
+                    info.last_heartbeat = now
+                    if not info.alive:
+                        info.alive = True
+                        records.append((
+                            "member_join",
+                            {"worker_id": info.worker_id, "name": name,
+                             "version": self._version, "led_by": leader_id},
+                        ))
+                infos.append(info)
+            commit = (
+                self._journal.append_many(records)
+                if self._journal is not None and records else None
+            )
+            _MB_MEMBERS.set(self._member_count_locked())
+        self._await(commit)
+        if records:
+            logger.info(
+                "cohort leader %d registered %d member process(es) "
+                "(%d new/revived; no version bump)",
+                leader_id, len(names), len(records),
+            )
+        return infos
 
     def reregister(self, worker_id: int, name: str) -> WorkerInfo:
         """Idempotent re-register of a worker that was ALREADY a member —
@@ -171,6 +271,9 @@ class Membership:
                     "worker %d (%s) re-registered%s; membership v%d",
                     worker_id, name, " (revived)" if revived else "", version,
                 )
+            commit = self._take_commit_locked()
+        if info is not None:
+            self._await(commit)
         if info is None:
             return self.register(name, preferred_id=worker_id)
         tracing.event(
@@ -180,63 +283,119 @@ class Membership:
         return info
 
     def heartbeat(self, worker_id: int, model_version: int = 0,
-                  stats: "Dict | None" = None) -> bool:
+                  stats: "Dict | None" = None,
+                  members: "Sequence[Tuple[int, int, Dict | None]] | None"
+                  = None) -> bool:
         """Liveness stamp + (optionally) a telemetry record update. `stats`
         is the decoded heartbeat payload (observability/health.py) or None
         for a liveness-only beat — old workers mid-rolling-restart send
-        none and lose nothing but the straggler detector's view of them."""
+        none and lose nothing but the straggler detector's view of them.
+
+        `members` is a cohort leader's coalesced beat: (member_id,
+        model_version, stats) per member process, applied under the SAME
+        lock acquisition and timestamp — one RPC, one lock pass, N
+        telemetry records. Beats for ids this leader does not lead are
+        ignored (a stale leader must not refresh someone else's member)."""
         with self._lock:
             info = self._workers.get(worker_id)
             if info is None or not info.alive:
                 return False
-            info.last_heartbeat = time.time()
-            info.model_version = max(info.model_version, model_version)
-            if stats:
-                prev = self._health.get(worker_id)
-                rec = dict(stats)
-                rec.update(
-                    worker_id=worker_id,
-                    name=info.name,
-                    model_version=info.model_version,
-                    updated_at=info.last_heartbeat,
-                    updates=(prev.get("updates", 0) + 1) if prev else 1,
-                )
-                self._health[worker_id] = rec
-            return True
+            now = time.time()
+            self._beat_locked(info, now, model_version, stats)
+            coalesced = 0
+            for mid, m_version, m_stats in members or ():
+                member = self._workers.get(mid)
+                if member is None or member.led_by != worker_id:
+                    continue
+                member.alive = True    # the leader's beat IS their liveness
+                self._beat_locked(member, now, m_version, m_stats)
+                coalesced += 1
+        _MB_BEATS.inc()
+        if coalesced:
+            _MB_COALESCED.inc(coalesced)
+        return True
+
+    def _beat_locked(self, info: WorkerInfo, now: float,
+                     model_version: int, stats: "Dict | None") -> None:
+        info.last_heartbeat = now
+        info.model_version = max(info.model_version, model_version)
+        if stats:
+            prev = self._health.get(info.worker_id)
+            rec = dict(stats)
+            rec.update(
+                worker_id=info.worker_id,
+                name=info.name,
+                model_version=info.model_version,
+                updated_at=now,
+                updates=(prev.get("updates", 0) + 1) if prev else 1,
+            )
+            self._health[info.worker_id] = rec
 
     def mark_dead(self, worker_id: int, reason: str = "") -> bool:
+        """Declare a worker dead. A cohort LEADER's death cascades to its
+        member processes in the same critical section — members die with
+        their leader under ONE version bump and ONE journal commit, so a
+        thousand-process cohort going away costs the same as a singleton
+        (O(cohorts), not O(workers))."""
         with self._lock:
             info = self._workers.get(worker_id)
             if info is None or not info.alive:
                 return False
             info.alive = False
-            self._version += 1
-            version = self._version     # the version THIS death created
-            self._j("member_death", worker_id=worker_id, version=version)
-            _MB_DEATHS.inc()
+            if info.led_by is None:
+                self._version += 1      # a LOGICAL worker left the world
+            version = self._version
+            records = [
+                ("member_death", {"worker_id": worker_id, "version": version})
+            ]
+            cascade = []
+            if info.led_by is None:
+                cascade = [
+                    w for w in self._workers.values()
+                    if w.alive and w.led_by == worker_id
+                ]
+                for member in cascade:
+                    member.alive = False
+                    records.append((
+                        "member_death",
+                        {"worker_id": member.worker_id, "version": version},
+                    ))
+            if self._journal is not None:
+                self._pending_commit = self._journal.append_many(records)
+            commit = self._take_commit_locked()
+            _MB_DEATHS.inc(1 + len(cascade))
             _MB_ALIVE.set(self._alive_count_locked())
+            _MB_MEMBERS.set(self._member_count_locked())
             _MB_VERSION.set(self._version)
             logger.warning(
-                "worker %d declared dead (%s); membership v%d, %d alive",
-                worker_id, reason or "unknown", self._version,
-                self._alive_count_locked(),
+                "worker %d declared dead (%s)%s; membership v%d, %d alive",
+                worker_id, reason or "unknown",
+                f" with {len(cascade)} cohort member(s)" if cascade else "",
+                self._version, self._alive_count_locked(),
             )
+        self._await(commit)
         tracing.event(
             "membership.death", worker_id=worker_id, reason=reason or "",
-            version=version,
+            version=version, cascade=len(cascade),
         )
         for cb in self._death_callbacks:
             cb(worker_id)
+            for member in cascade:
+                cb(member.worker_id)
         return True
 
     def reap(self) -> List[int]:
-        """Declare workers dead whose heartbeats lapsed. Returns their ids."""
+        """Declare workers dead whose heartbeats lapsed. Returns their ids.
+        Cohort members are SKIPPED — their liveness is the leader's beat
+        (they die with it via the mark_dead cascade) — so the scan is
+        O(cohorts + singletons), not O(worker processes)."""
         now = time.time()
         with self._lock:
             lapsed = [
                 wid
                 for wid, info in self._workers.items()
-                if info.alive and now - info.last_heartbeat > self._timeout
+                if info.alive and info.led_by is None
+                and now - info.last_heartbeat > self._timeout
             ]
         for wid in lapsed:
             if self.mark_dead(wid, reason="heartbeat timeout"):
@@ -244,7 +403,18 @@ class Membership:
         return lapsed
 
     def _alive_count_locked(self) -> int:
-        return sum(1 for w in self._workers.values() if w.alive)
+        """Alive LOGICAL workers (cohort leaders + singletons): member
+        processes are not rendezvous participants and must not inflate
+        num_workers (LR scaling, wait-for-workers logic)."""
+        return sum(
+            1 for w in self._workers.values() if w.alive and w.led_by is None
+        )
+
+    def _member_count_locked(self) -> int:
+        return sum(
+            1 for w in self._workers.values()
+            if w.alive and w.led_by is not None
+        )
 
     @property
     def version(self) -> int:

@@ -446,10 +446,16 @@ class ProcessManager:
                 world_version = self._world_version
                 if self._journal is not None:
                     # committed inside the lock, like every other journaled
-                    # transition: replay restores the version monotonically
+                    # transition — and made DURABLE before the version
+                    # becomes observable below (spawned worker envs, the
+                    # membership-signal announcement): in group-commit
+                    # mode a crash inside the window must not let workers
+                    # see a world version the successor's replay lacks
+                    # (the reform path is rare, so waiting out the bounded
+                    # window under the manager lock is acceptable)
                     self._journal.append(
                         "world_version", version=world_version
-                    )
+                    ).wait()
                 if new_size != old_size:
                     # a deliberate resize opens a fresh in-place relaunch
                     # budget

@@ -135,24 +135,48 @@ class MasterServicer:
             _REREGISTERS.inc()
         else:
             info = self._membership.register(request.worker_name, preferred)
+        member_ids = []
+        if request.member_names:
+            # cohort-aggregated membership: the leader's member processes
+            # join in the SAME round-trip (one lock pass, one journal
+            # commit, no version bumps) — idempotent across re-registers
+            members = self._membership.register_members(
+                info.worker_id, list(request.member_names)
+            )
+            member_ids = [m.worker_id for m in members]
         return pb.RegisterWorkerResponse(
             worker_id=info.worker_id,
             membership_version=self._membership.version,
             num_workers=self._membership.alive_count(),
+            member_ids=member_ids,
         )
+
+    #: server-side ceiling on max_tasks: a misconfigured (or hostile)
+    #: worker must not drain the whole queue into one lease batch — every
+    #: leased task's timeout clock starts NOW, and a huge batch would
+    #: expire its own tail
+    MAX_LEASE_BATCH = 256
 
     def GetTask(self, request, context):
         self._fence_generation("GetTask", context)
         if self._dispatcher.finished():
             return pb.GetTaskResponse(job_done=True)
-        task = self._dispatcher.get(request.worker_id)
-        if task is None:
+        # max_tasks == 0 is an old worker (proto3 default): classic
+        # one-lease protocol. The response is released only after the
+        # lease batch's journal commit fsyncs (ack-after-fsync inside
+        # get_many) — nothing a worker ever runs can be lost by a crash.
+        n = min(max(1, request.max_tasks), self.MAX_LEASE_BATCH)
+        tasks = self._dispatcher.get_many(request.worker_id, n)
+        if not tasks:
             return pb.GetTaskResponse(
                 task=pb.Task(type=pb.WAIT),
                 backoff_seconds=self._wait_backoff_s,
                 job_done=self._dispatcher.finished(),
             )
-        return pb.GetTaskResponse(task=task.to_proto())
+        protos = [t.to_proto() for t in tasks]
+        # `task` mirrors the first lease for old workers (which never set
+        # max_tasks and never read `tasks`)
+        return pb.GetTaskResponse(task=protos[0], tasks=protos)
 
     def ReportTaskResult(self, request, context):
         self._fence_generation("ReportTaskResult", context)
@@ -201,8 +225,17 @@ class MasterServicer:
         stats = health_lib.decode_stats(
             self._request_metadata(context).get(health_lib.STATS_METADATA_KEY)
         )
+        # coalesced member beats (cohort leaders): decode_stats bounds
+        # each payload the same way it bounds the metadata flavor — a
+        # garbage member payload degrades THAT member to liveness-only
+        members = [
+            (m.worker_id, m.model_version,
+             health_lib.decode_stats(m.stats_json))
+            for m in request.members
+        ]
         known = self._membership.heartbeat(
-            request.worker_id, request.model_version, stats=stats
+            request.worker_id, request.model_version, stats=stats,
+            members=members or None,
         )
         with self._ctrl_lock:
             # one atomic test-and-clear: the flag is one-shot, and two

@@ -21,6 +21,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.master.journal import CommitGate
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
@@ -47,6 +48,9 @@ _QUEUE_TODO = _reg.gauge(
     "edl_dispatcher_todo_tasks", "queued tasks")
 _QUEUE_DOING = _reg.gauge(
     "edl_dispatcher_doing_tasks", "leased (in-flight) tasks")
+_LEASE_BATCH = _reg.histogram(
+    "edl_dispatcher_lease_batch_tasks",
+    "tasks leased per GetTask round-trip (batched leases)")
 
 
 @dataclass
@@ -86,7 +90,7 @@ class _Lease:
 Shard = Tuple[str, int, int]  # (shard_name, start, end)
 
 
-class TaskDispatcher:
+class TaskDispatcher(CommitGate):
     """Thread-safe todo/doing task queue with epochs, retries and leases."""
 
     def __init__(
@@ -120,6 +124,9 @@ class TaskDispatcher:
         self._rng = random.Random(shuffle_seed)      # guarded_by: _lock
         self._task_timeout_s = task_timeout_s
 
+        # the last journal Commit enqueued by the current critical section
+        # (group-commit ack-after-fsync; see _j/_take_commit_locked)
+        self._pending_commit = None                  # guarded_by: _lock
         self._todo: deque[TaskSpec] = deque()        # guarded_by: _lock
         self._doing: Dict[int, _Lease] = {}          # guarded_by: _lock
         self._next_task_id = 1                       # guarded_by: _lock
@@ -206,10 +213,9 @@ class TaskDispatcher:
             self._finished_training, self._failed_permanently,
         )
 
-    def _j(self, rtype: str, **fields) -> None:  # holds: _lock
-        """Commit one journal record (no-op without a journal)."""
-        if self._journal is not None:
-            self._journal.append(rtype, **fields)
+    # _j / _take_commit_locked / _await: the ack-after-fsync plumbing is
+    # CommitGate (master/journal.py) — shared with Membership so the
+    # durability protocol cannot drift between the two
 
     # ------------------------------------------------------------------ #
     # task creation
@@ -261,7 +267,7 @@ class TaskDispatcher:
                 ("task_create", {"task": dataclasses.asdict(t), "front": front})
                 for t in ordered
             )
-            self._journal.append_many(records)
+            self._pending_commit = self._journal.append_many(records)
         return len(tasks)
 
     def _start_next_epoch(self) -> None:  # holds: _lock
@@ -288,6 +294,8 @@ class TaskDispatcher:
             n = self._create_tasks(
                 self._evaluation_shards, pb.EVALUATION, eval_job_id, front=True
             )
+            commit = self._take_commit_locked()
+        self._await(commit)
         logger.info("eval job %d: created %d evaluation tasks", eval_job_id, n)
         return n
 
@@ -295,6 +303,17 @@ class TaskDispatcher:
     # leasing / reporting
 
     def get(self, worker_id: int) -> Optional[TaskSpec]:
+        """One lease (the classic protocol): get_many with max_tasks=1."""
+        tasks = self.get_many(worker_id, 1)
+        return tasks[0] if tasks else None
+
+    def get_many(self, worker_id: int, max_tasks: int = 1) -> List[TaskSpec]:
+        """Lease up to ``max_tasks`` tasks in ONE pass under the lock and
+        ONE journal commit (batched leases): the per-round-trip costs —
+        lock acquisition, journal fsync (group-committed), RPC overhead —
+        amortize across the batch. Lease-expiry/requeue/fencing semantics
+        stay per task; an empty list means WAIT (or job done)."""
+        max_tasks = max(1, max_tasks)
         callbacks: List[Callable] = []
         with self._lock:
             self._reap_expired_locked()
@@ -306,21 +325,37 @@ class TaskDispatcher:
         with self._lock:
             if not self._todo:
                 self._set_queue_gauges_locked()
-                return None
-            task = self._todo.popleft()
-            self._doing[task.task_id] = _Lease(worker_id, task, time.time())
-            # journaled BEFORE the lease is observable (the RPC response):
-            # a crash after this point replays the lease and requeues it
-            self._j("task_lease", task_id=task.task_id, worker_id=worker_id)
+                return []
+            now = time.time()
+            tasks: List[TaskSpec] = []
+            records = []
+            while self._todo and len(tasks) < max_tasks:
+                task = self._todo.popleft()
+                self._doing[task.task_id] = _Lease(worker_id, task, now)
+                records.append(
+                    ("task_lease",
+                     {"task_id": task.task_id, "worker_id": worker_id})
+                )
+                tasks.append(task)
+            # journaled (enqueued) BEFORE the leases are observable; the
+            # whole batch commits under one fsync, and a crash after this
+            # point replays every lease and requeues it
+            if self._journal is not None:
+                self._pending_commit = self._journal.append_many(records)
+            commit = self._take_commit_locked()
             self._set_queue_gauges_locked()
+        # ack-after-fsync: the GetTask response IS the acknowledgment —
+        # it must not leave before the lease records are durable
+        self._await(commit)
         # lease-transition event OUTSIDE the lock (file I/O never runs
         # under the dispatcher lock)
-        _TASKS_LEASED.inc()
+        _TASKS_LEASED.inc(len(tasks))
+        _LEASE_BATCH.observe(len(tasks))
         tracing.event(
-            "task.lease", task_id=task.task_id, worker_id=worker_id,
-            task_type=task.type,
+            "task.lease", task_ids=[t.task_id for t in tasks],
+            worker_id=worker_id, batch=len(tasks),
         )
-        return task
+        return tasks
 
     def _set_queue_gauges_locked(self) -> None:  # holds: _lock
         _QUEUE_TODO.set(len(self._todo))
@@ -408,7 +443,12 @@ class TaskDispatcher:
                 else:
                     self._fail_permanently_locked(task, err)
             callbacks = self._maybe_advance_epoch_locked()
+            commit = self._take_commit_locked()
             self._set_queue_gauges_locked()
+        # ack-after-fsync: accepted=True is the acknowledgment the worker
+        # keys destructive decisions off (drain-checkpoint retention) — it
+        # must not leave before the finish/requeue record is durable
+        self._await(commit)
         tracing.event(
             "task.report", task_id=task_id, worker_id=worker_id,
             success=bool(success), preempted=bool(preempted),
@@ -453,7 +493,9 @@ class TaskDispatcher:
             for tid in stale:
                 task = self._doing.pop(tid).task
                 self._requeue_locked(task, f"worker {worker_id} died")
+            commit = self._take_commit_locked()
             self._set_queue_gauges_locked()
+        self._await(commit)
         if stale:
             logger.info("recovered %d tasks from worker %d", len(stale), worker_id)
         return len(stale)
@@ -563,6 +605,8 @@ class TaskDispatcher:
                 "tasks, no further epochs", reason or "no reason", dropped,
             )
             callbacks = self._maybe_advance_epoch_locked()
+            commit = self._take_commit_locked()
+        self._await(commit)
         self._flush_callbacks(callbacks)
 
     # ------------------------------------------------------------------ #
@@ -585,6 +629,8 @@ class TaskDispatcher:
         with self._lock:
             self._reap_expired_locked()
             callbacks = self._maybe_advance_epoch_locked()
+            commit = self._take_commit_locked()
+        self._await(commit)
         self._flush_callbacks(callbacks)
 
     def finished(self) -> bool:

@@ -1,0 +1,194 @@
+"""Regenerate ``elasticdl_tpu_pb2.py`` WITHOUT protoc.
+
+This image ships the protobuf runtime but no protoc / grpcio-tools (the
+constraint that previously pushed new wire surfaces onto gRPC metadata —
+the generation handshake, the worker-stats payload). A pb2 module,
+however, is nothing but a serialized ``FileDescriptorProto`` plus builder
+boilerplate — and the runtime's ``descriptor_pb2`` can build that proto in
+pure Python. This tool loads the CURRENT serialized descriptor from the
+checked-in pb2, applies the schema additions declared in ``PATCHES``
+below, and re-emits the module in the standard generated style (including
+the ``_serialized_start/_end`` offsets, recomputed by locating each
+message's serialized sub-descriptor inside the file bytes).
+
+Keep ``elasticdl_tpu.proto`` — the human-readable source of truth — in
+sync by hand; ``tests/test_master_servicer.py`` pins the fields this tool
+adds so the two cannot drift silently.
+
+Run from the repo root:
+
+    python -m elasticdl_tpu.proto.gen_pb2
+
+Proto3 back/forward compatibility does the rest: an old worker never sets
+the new fields (defaults decode as absent), a new worker talking to an old
+master sends fields the old descriptor skips as unknown.
+"""
+
+from __future__ import annotations
+
+import os
+
+from google.protobuf import descriptor_pb2
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_PB2_PATH = os.path.join(_HERE, "elasticdl_tpu_pb2.py")
+
+# (message, field name, field number, type, extras)
+_SCALAR = {
+    "int32": descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
+    "string": descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+}
+
+
+def _add_field(msg, name, number, ftype, *, repeated=False, type_name=""):
+    if any(f.name == name for f in msg.field):
+        return False
+    f = msg.field.add()
+    f.name = name
+    f.number = number
+    f.label = (
+        descriptor_pb2.FieldDescriptorProto.LABEL_REPEATED
+        if repeated else descriptor_pb2.FieldDescriptorProto.LABEL_OPTIONAL
+    )
+    if type_name:
+        f.type = descriptor_pb2.FieldDescriptorProto.TYPE_MESSAGE
+        f.type_name = type_name
+    else:
+        f.type = _SCALAR[ftype]
+    f.json_name = _json_name(name)
+    return True
+
+
+def _json_name(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.capitalize() for p in parts[1:])
+
+
+def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
+    """The schema additions this repo has accrued post-protoc. Idempotent —
+    re-running against an already-patched descriptor changes nothing."""
+    msgs = {m.name: m for m in fd.message_type}
+    changed = 0
+
+    # Batched task leases: the worker asks for up to max_tasks in one
+    # round-trip; the master answers with `tasks` (the legacy singular
+    # `task` stays populated with the first lease for old workers).
+    changed += _add_field(msgs["GetTaskRequest"], "max_tasks", 2, "int32")
+    changed += _add_field(
+        msgs["GetTaskResponse"], "tasks", 4, "",
+        repeated=True, type_name=".elasticdl_tpu.Task",
+    )
+
+    # Cohort-aggregated membership: a leader registers its member
+    # processes in the SAME RegisterWorker round-trip, and its single
+    # heartbeat carries one MemberBeat per member — reap scans and
+    # version bumps stay O(cohorts), telemetry stays O(workers).
+    if "MemberBeat" not in msgs:
+        mb = fd.message_type.add()
+        mb.name = "MemberBeat"
+        _add_field(mb, "worker_id", 1, "int32")
+        _add_field(mb, "model_version", 2, "int32")
+        # same compact JSON payload as the edl-worker-stats metadata
+        # (observability/health.py encode_stats/decode_stats bounds apply)
+        _add_field(mb, "stats_json", 3, "string")
+        changed += 1
+        msgs["MemberBeat"] = mb
+    changed += _add_field(
+        msgs["HeartbeatRequest"], "members", 3, "",
+        repeated=True, type_name=".elasticdl_tpu.MemberBeat",
+    )
+    changed += _add_field(
+        msgs["RegisterWorkerRequest"], "member_names", 3, "string",
+        repeated=True,
+    )
+    changed += _add_field(
+        msgs["RegisterWorkerResponse"], "member_ids", 4, "int32",
+        repeated=True,
+    )
+    return changed
+
+
+def _offsets(fd: descriptor_pb2.FileDescriptorProto, data: bytes):
+    """(name, start, end) for every top-level message/enum, byte offsets of
+    each serialized sub-descriptor inside the file's serialized bytes —
+    what protoc emits as ``_serialized_start/_end``."""
+    out = []
+    for enum in fd.enum_type:
+        sub = enum.SerializeToString()
+        start = data.find(sub)
+        out.append(("_" + enum.name.upper(), start, start + len(sub)))
+    for msg in fd.message_type:
+        sub = msg.SerializeToString()
+        start = data.find(sub)
+        out.append(("_" + msg.name.upper(), start, start + len(sub)))
+        for nested in msg.nested_type:
+            nsub = nested.SerializeToString()
+            nstart = data.find(nsub)
+            out.append((
+                "_" + msg.name.upper() + "_" + nested.name.upper(),
+                nstart, nstart + len(nsub),
+            ))
+    return out
+
+
+_TEMPLATE = '''# -*- coding: utf-8 -*-
+# Generated by elasticdl_tpu/proto/gen_pb2.py (no protoc on this image —
+# the serialized descriptor is patched programmatically; schema source of
+# truth: elasticdl_tpu.proto).  DO NOT EDIT BY HAND.
+# source: elasticdl_tpu.proto
+"""Generated protocol buffer code."""
+from google.protobuf.internal import builder as _builder
+from google.protobuf import descriptor as _descriptor
+from google.protobuf import descriptor_pool as _descriptor_pool
+from google.protobuf import symbol_database as _symbol_database
+# @@protoc_insertion_point(imports)
+
+_sym_db = _symbol_database.Default()
+
+
+
+
+DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile({serialized!r})
+
+_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())
+_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR, 'elasticdl_tpu_pb2', globals())
+if _descriptor._USE_C_DESCRIPTORS == False:
+
+  DESCRIPTOR._options = None
+  _JOBSTATUSRESPONSE_EVALMETRICSENTRY._options = None
+  _JOBSTATUSRESPONSE_EVALMETRICSENTRY._serialized_options = b'8\\001'
+{offset_lines}
+# @@protoc_insertion_point(module_scope)
+'''
+
+
+def main() -> None:
+    # read the CURRENT descriptor out of the checked-in pb2 without
+    # importing it (importing would register it in the default pool and
+    # block re-adding the patched file in this same process)
+    with open(_PB2_PATH, encoding="utf-8") as f:
+        src = f.read()
+    marker = "AddSerializedFile("
+    start = src.index(marker) + len(marker)
+    # the literal sits on one line and may contain raw ')' bytes — take the
+    # whole line and strip the closing paren of the call
+    line = src[start:src.index("\n", start)]
+    serialized = eval(line.rsplit(")", 1)[0])  # bytes literal from protoc
+
+    fd = descriptor_pb2.FileDescriptorProto.FromString(serialized)
+    changed = apply_patches(fd)
+    data = fd.SerializeToString()
+
+    lines = []
+    for name, s, e in _offsets(fd, data):
+        lines.append(f"  {name}._serialized_start={s}")
+        lines.append(f"  {name}._serialized_end={e}")
+    with open(_PB2_PATH, "w", encoding="utf-8") as f:
+        f.write(_TEMPLATE.format(
+            serialized=data, offset_lines="\n".join(lines) + "\n"))
+    print(f"{_PB2_PATH}: {changed} schema addition(s), "
+          f"{len(data)} descriptor bytes")
+
+
+if __name__ == "__main__":
+    main()

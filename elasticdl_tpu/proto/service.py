@@ -147,6 +147,19 @@ DEFAULT_POLICIES: Dict[str, RpcPolicy] = {
 }
 
 
+def jittered(seconds: float, rng: Optional[random.Random] = None) -> float:
+    """An interval with full spread jitter: ``uniform(0.5, 1.5) * base``.
+
+    The polling/heartbeat twin of the stub's retry backoff jitter (EDL304):
+    a swarm of workers relaunched together — or unblocked together by a
+    master restart or a rescale settling — would otherwise beat and
+    re-poll in phase forever, hitting the master as one synchronized herd
+    every interval. Every periodic control-plane sleep (heartbeat loops,
+    WAIT backoffs, lease re-polls) goes through here so the fleet's
+    arrivals stay spread."""
+    return max(0.0, seconds) * (rng or random).uniform(0.5, 1.5)
+
+
 class MasterUnreachableError(ConnectionError):
     """Raised fast (no wire traffic) while the circuit breaker is open."""
 
@@ -616,6 +629,7 @@ def register_with_retry(
     window_s: float,
     shutdown: threading.Event,
     what: str = "worker",
+    member_names=(),
 ):
     """Boot-time registration hardened against a master that is down or
     RESTARTING right now (observed: a master crash with the registration
@@ -638,6 +652,7 @@ def register_with_retry(
         request = pb.RegisterWorkerRequest(
             worker_name=name,
             preferred_id_plus_one=preferred_id + 1 if preferred_id >= 0 else 0,
+            member_names=list(member_names),
         )
         metadata = (
             ((REREGISTER_KEY, "1"),) if attempt and preferred_id >= 0 else None
@@ -661,7 +676,8 @@ def register_with_retry(
                 raise
 
 
-def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int):
+def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int,
+               member_names=()):
     """The reconnect handshake after a master restart: clear the stale
     generation claim (a generation-free RegisterWorker is what learns the
     new one from the response's trailing metadata), then re-register under
@@ -674,6 +690,7 @@ def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int):
     return stub.RegisterWorker(
         pb.RegisterWorkerRequest(
             worker_name=name, preferred_id_plus_one=worker_id + 1,
+            member_names=list(member_names),
         ),
         timeout=30,
         metadata=((REREGISTER_KEY, "1"),),

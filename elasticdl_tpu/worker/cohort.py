@@ -37,6 +37,7 @@ from __future__ import annotations
 import os
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -61,6 +62,7 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import (
     RetryingMasterStub,
     is_stale_generation,
+    jittered,
     make_channel,
     register_with_retry,
     reregister,
@@ -122,6 +124,14 @@ class CohortWorker:
         self._spec_compiler = None
         self.worker_id = -1
         self._name = ""               # set at leader registration
+        # cohort-aggregated membership: master-assigned ids for this
+        # cohort's member processes 1..N-1 (leader only; empty for
+        # single-process worlds). Their beats ride the leader's single
+        # Heartbeat as MemberBeat entries.
+        self._member_ids: List[int] = []
+        # batched leases (--task_lease_batch): leases still to broadcast,
+        # drained before the next GetTask poll; cleared on reconnect
+        self._lease_queue: "deque" = deque()
         # leader-only heartbeat telemetry (observability/health.py): the
         # cohort is ONE logical worker, so its health record is the
         # leader's view of the collective step cadence
@@ -264,13 +274,27 @@ class CohortWorker:
             window_s=self.cfg.master_unreachable_timeout_s,
             shutdown=self._shutdown,
             what="cohort leader",
+            # cohort-aggregated membership: member processes join in the
+            # SAME round-trip as telemetry entities — the master's fleet
+            # view is per-process while reap/version stay per-cohort
+            member_names=self._member_names(),
         )
         self.worker_id = resp.worker_id
+        self._member_ids = list(resp.member_ids)
         logger.info(
-            "cohort leader registered as worker %d (%d processes, %d devices)",
+            "cohort leader registered as worker %d (%d processes, %d devices"
+            ", %d member entries)",
             self.worker_id, self.ctx.num_processes,
-            len(__import__("jax").devices()),
+            len(__import__("jax").devices()), len(self._member_ids),
         )
+
+    def _member_names(self) -> List[str]:
+        """Stable per-process member identities (processes 1..N-1; the
+        leader itself IS the cohort's logical worker entry). Stable across
+        reconnects so a restarted master's register_members is idempotent."""
+        return [
+            f"{self._name}#p{i}" for i in range(1, self.ctx.num_processes)
+        ]
 
     def _note_master_ok(self) -> None:
         self._last_master_ok = time.monotonic()
@@ -282,8 +306,13 @@ class CohortWorker:
         is re-established; followers never notice."""
         resp = reregister(
             self._stub, name=self._name, worker_id=self.worker_id,
+            member_names=self._member_names(),
         )
+        # the restarted master's replay requeued every lease whole — drop
+        # the local queue; fresh leases re-run the tasks exactly once
+        self._lease_queue.clear()
         self.worker_id = resp.worker_id
+        self._member_ids = list(resp.member_ids)
         logger.warning(
             "cohort leader re-registered with restarted master as worker %d; "
             "resuming leases under the new generation", self.worker_id,
@@ -339,6 +368,32 @@ class CohortWorker:
         )
         return stats
 
+    def _member_beats(self) -> List[pb.MemberBeat]:
+        """Coalesced per-member beats riding the leader's ONE heartbeat
+        (cohort-aggregated membership): each member process's entry
+        carries the cohort's collective step cadence — the train step IS
+        a lockstep collective, so the leader's dispatch clock is the
+        honest per-process cadence — plus its process index. What this
+        buys today is fleet-scale telemetry at O(cohorts) RPC cost;
+        follower-LOCAL signals (per-host input-pipeline timing) need a
+        follower->leader channel and stay future work."""
+        if not self._member_ids:
+            return []
+        base = self._step_stats.snapshot()
+        beats = []
+        for idx, mid in enumerate(self._member_ids, start=1):
+            stats = dict(base)
+            stats.update(
+                phase=self._phase, process_index=idx,
+                source="leader-coalesced",
+            )
+            beats.append(pb.MemberBeat(
+                worker_id=mid,
+                model_version=self._model_version,
+                stats_json=encode_stats(stats),
+            ))
+        return beats
+
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
             try:
@@ -349,10 +404,15 @@ class CohortWorker:
                            encode_stats(self._stats_payload())),)
                 except Exception:
                     md = None
+                try:
+                    members = self._member_beats()
+                except Exception:
+                    members = []    # member telemetry never costs the beat
                 resp = self._stub.Heartbeat(
                     pb.HeartbeatRequest(
                         worker_id=self.worker_id,
                         model_version=self._model_version,
+                        members=members,
                     ),
                     timeout=10,
                     metadata=md,
@@ -375,7 +435,9 @@ class CohortWorker:
                 logger.warning("cohort heartbeat failed: %s", e)
                 if not self._maybe_reconnect(e):
                     self._master_unreachable()
-            self._shutdown.wait(self.cfg.worker_heartbeat_s)
+            # jittered beat (shared helper): cohorts relaunched together
+            # must not arrive at the master in phase every interval
+            self._shutdown.wait(jittered(self.cfg.worker_heartbeat_s))
 
     def request_preempt(self) -> bool:
         """Leader SIGTERM hook (signal-handler safe: sets a flag, no I/O).
@@ -408,30 +470,42 @@ class CohortWorker:
                 # the GetTask-path abort below (the save needs no master)
                 ctrl[6] = FLAG_CHECKPOINT
             return ctrl
-        try:
-            resp = self._stub.GetTask(
-                pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
-            )
-        except Exception as e:
-            logger.warning("cohort get_task failed: %s", e)
-            if self._maybe_reconnect(e):
-                # master restarted; handshake landed — the cohort stays up
-                # and the next control vector re-leases under the new
-                # generation
+        if self._lease_queue:
+            # drain locally held leases (batched GetTask) before re-polling
+            task = self._lease_queue.popleft()
+        else:
+            try:
+                resp = self._stub.GetTask(
+                    pb.GetTaskRequest(
+                        worker_id=self.worker_id,
+                        max_tasks=self.cfg.task_lease_batch,
+                    ),
+                    timeout=30,
+                )
+            except Exception as e:
+                logger.warning("cohort get_task failed: %s", e)
+                if self._maybe_reconnect(e):
+                    # master restarted; handshake landed — the cohort stays
+                    # up and the next control vector re-leases under the
+                    # new generation
+                    return [OP_NOOP] + [0] * (CTRL_LEN - 1)
+                if self._master_unreachable():
+                    # carry FLAG_CHECKPOINT: we sit at a clean task boundary
+                    # and the collective save needs no master, so a
+                    # partitioned-but-relaunched cohort resumes here instead
+                    # of redoing up to checkpoint_steps of work (same path
+                    # as the SIGTERM drain)
+                    ctrl = [OP_ABORT] + [0] * (CTRL_LEN - 1)
+                    ctrl[6] = FLAG_CHECKPOINT
+                    return ctrl
                 return [OP_NOOP] + [0] * (CTRL_LEN - 1)
-            if self._master_unreachable():
-                # carry FLAG_CHECKPOINT: we sit at a clean task boundary and
-                # the collective save needs no master, so a partitioned-but-
-                # relaunched cohort resumes here instead of redoing up to
-                # checkpoint_steps of work (same path as the SIGTERM drain)
-                ctrl = [OP_ABORT] + [0] * (CTRL_LEN - 1)
-                ctrl[6] = FLAG_CHECKPOINT
-                return ctrl
-            return [OP_NOOP] + [0] * (CTRL_LEN - 1)
-        if resp.job_done:
-            self._job_done = True
-            return [OP_DONE] + [0] * (CTRL_LEN - 1)
-        task = resp.task
+            if resp.job_done:
+                self._job_done = True
+                return [OP_DONE] + [0] * (CTRL_LEN - 1)
+            # old master: `tasks` empty, fall back to the singular field
+            leased = list(resp.tasks) or [resp.task]
+            task = leased[0]
+            self._lease_queue.extend(leased[1:])
         if task.type == pb.WAIT:
             return [OP_NOOP] + [0] * (CTRL_LEN - 1)
         due = (
@@ -939,7 +1013,11 @@ class CohortWorker:
                 ctrl = [int(x) for x in self.ctx.broadcast_ints(leader_ctrl)]
                 op = ctrl[0]
                 if op == OP_NOOP:
-                    time.sleep(backoff)
+                    # jittered on the LEADER only (followers just follow
+                    # the broadcast), so idle cohorts de-phase their polls
+                    time.sleep(
+                        jittered(backoff) if self.ctx.is_leader else backoff
+                    )
                     continue
                 if op == OP_TASK:
                     self._run_task(ctrl)

@@ -11,10 +11,10 @@ only RPCs left are one lease + one report per task plus heartbeats.
 from __future__ import annotations
 
 import os
-import random
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -35,6 +35,7 @@ from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 from elasticdl_tpu.proto.service import (
     RetryingMasterStub,
     is_stale_generation,
+    jittered,
     make_channel,
     register_with_retry,
     reregister,
@@ -108,6 +109,12 @@ class Worker:
         # them into the stats payload the master's straggler scorer reads
         self._step_stats = WorkerStepStats()
         self._rescaling = False       # True while _rescale_in_place runs
+        # Batched leases (--task_lease_batch): locally leased tasks still
+        # to run — drained before the next GetTask poll. Cleared on every
+        # reconnect handshake: a restarted master's replay requeued these
+        # leases whole, so running a local copy would be wasted work (its
+        # report comes back accepted=False either way).
+        self._lease_queue: "deque[pb.Task]" = deque()
 
     # ------------------------------------------------------------------ #
     # setup
@@ -189,6 +196,10 @@ class Worker:
         resp = reregister(
             self._stub, name=self._name, worker_id=self.worker_id,
         )
+        # drop locally queued leases: the restarted master conservatively
+        # requeued every lease of the dead generation, so these tasks will
+        # re-run (exactly once) through fresh leases
+        self._lease_queue.clear()
         self.worker_id = resp.worker_id
         self._membership_version = resp.membership_version
         self._last_known_workers = resp.num_workers or self._last_known_workers
@@ -466,7 +477,9 @@ class Worker:
                 # the unreachable exit
                 if not self._maybe_reconnect(e):
                     self._master_unreachable()
-            self._shutdown.wait(self.cfg.worker_heartbeat_s)
+            # jittered beat: a synchronized swarm (mass relaunch, master
+            # restart) must de-phase instead of arriving as one herd
+            self._shutdown.wait(jittered(self.cfg.worker_heartbeat_s))
 
     def _on_membership_change(self, new_version: int, num_workers: int = 0) -> None:
         """Elastic hook: the worker set changed. This worker's only local
@@ -984,29 +997,44 @@ class Worker:
         self._heartbeat_thread.start()
 
         tasks_done = 0
+        wait_backoff = 1.0
         while not self._shutdown.is_set():
-            try:
-                resp = self._stub.GetTask(
-                    pb.GetTaskRequest(worker_id=self.worker_id), timeout=30
-                )
-            except Exception as e:
-                logger.warning("get_task failed: %s; retrying", e)
-                if self._maybe_reconnect(e):
-                    # master restarted: the handshake landed, re-lease
-                    # immediately under the new generation
+            if self._lease_queue:
+                # drain locally held leases before re-polling (batched
+                # leases: N tasks per GetTask round-trip)
+                task = self._lease_queue.popleft()
+            else:
+                try:
+                    resp = self._stub.GetTask(
+                        pb.GetTaskRequest(
+                            worker_id=self.worker_id,
+                            max_tasks=self.cfg.task_lease_batch,
+                        ),
+                        timeout=30,
+                    )
+                except Exception as e:
+                    logger.warning("get_task failed: %s; retrying", e)
+                    if self._maybe_reconnect(e):
+                        # master restarted: the handshake landed, re-lease
+                        # immediately under the new generation
+                        continue
+                    if self._master_unreachable():
+                        break
+                    # jittered: a cohort of relaunched workers retrying a
+                    # recovering master on the same constant beat is a
+                    # thundering herd (edl-lint EDL304)
+                    time.sleep(jittered(2))
                     continue
-                if self._master_unreachable():
+                if resp.job_done:
+                    logger.info("job done after %d tasks", tasks_done)
+                    self._job_done = True
                     break
-                # jittered: a cohort of relaunched workers retrying a
-                # recovering master on the same constant beat is a
-                # thundering herd (edl-lint EDL304)
-                time.sleep(2 * random.uniform(0.5, 1.5))
-                continue
-            if resp.job_done:
-                logger.info("job done after %d tasks", tasks_done)
-                self._job_done = True
-                break
-            task = resp.task
+                # an old master never fills `tasks`; fall back to the
+                # classic singular field (WAIT only ever arrives alone)
+                leased = list(resp.tasks) or [resp.task]
+                task = leased[0]
+                self._lease_queue.extend(leased[1:])
+                wait_backoff = resp.backoff_seconds or 1.0
             pending_lr, self._pending_lr = self._pending_lr, None
             if pending_lr is not None and self._state is not None:
                 from elasticdl_tpu.training.lr_modulation import (
@@ -1037,7 +1065,9 @@ class Worker:
                 except Exception:
                     logger.exception("in-place rescale failed; mesh kept")
             if task.type == pb.WAIT:
-                time.sleep(resp.backoff_seconds or 1.0)
+                # jittered so an idle swarm does not re-poll in phase
+                # (epoch boundaries unblock every worker at once)
+                time.sleep(jittered(wait_backoff))
                 continue
 
             report = pb.ReportTaskResultRequest(

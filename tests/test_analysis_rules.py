@@ -931,12 +931,144 @@ def test_cli_json_output_and_exit_code(tmp_path, capsys):
     assert payload["new"][0]["rule"] == "EDL303"
 
 
+# ---------------------------------------------------------------------- #
+# EDL403 fsync-under-lock
+
+
+EDL403_BAD = """
+    import os
+    import threading
+
+    class Journalish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._fh = None   # guarded_by: _lock
+
+        def append(self, data):
+            with self._lock:
+                self._fh.write(data)
+                self._fh.flush()
+                os.fsync(self._fh.fileno())
+
+        def _commit_locked(self):
+            os.fsync(self._fh.fileno())   # holds the lock by idiom
+"""
+
+EDL403_GOOD = """
+    import os
+    import threading
+
+    class Journalish:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._fh = None    # guarded_by: _lock
+            self._queue = []   # guarded_by: _lock
+
+        def append(self, data):
+            # the group-commit idiom: ENQUEUE under the lock, flush+fsync
+            # on the committer outside any control-plane critical section
+            with self._lock:
+                self._queue.append(data)
+            return self._wait_durable()
+
+        def _wait_durable(self):
+            pass
+
+        def flush_outside(self):
+            fh = self._grab()
+            os.fsync(fh.fileno())    # no lock held: fine
+
+        def _grab(self):
+            with self._lock:
+                return self._fh
+"""
+
+
+def test_fsync_under_lock_fires_on_lock_and_locked_idiom():
+    fs = findings_for(EDL403_BAD, select={"EDL403"})
+    assert rule_ids(fs) == ["EDL403"]
+    assert len(fs) == 2
+    assert sorted(f.context for f in fs) == [
+        "Journalish._commit_locked",
+        "Journalish.append",
+    ]
+    assert all("fsync" in f.message for f in fs)
+
+
+def test_fsync_under_lock_quiet_on_group_commit_idiom():
+    assert findings_for(EDL403_GOOD, select={"EDL403"}) == []
+
+
+def test_fsync_under_lock_catches_from_import_alias():
+    src = """
+        import threading
+        from os import fsync as _sync
+
+        class W:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fh = None   # guarded_by: _lock
+
+            def f(self):
+                with self._lock:
+                    _sync(self._fh.fileno())
+    """
+    fs = findings_for(src, select={"EDL403"})
+    assert len(fs) == 1 and fs[0].rule == "EDL403"
+
+
+def test_fsync_under_lock_only_in_guarded_classes():
+    # no guarded_by annotation -> no declared lock discipline to anchor
+    # on (the EDL101/EDL402 contract)
+    src = """
+        import os
+        import threading
+
+        class Plain:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def f(self):
+                with self._lock:
+                    os.fsync(0)
+    """
+    assert findings_for(src, select={"EDL403"}) == []
+
+
+def test_fsync_under_lock_suppressible_at_sanctioned_sites():
+    src = """
+        import os
+        import threading
+
+        class Journalish:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._fh = None   # guarded_by: _lock
+
+            def _flush_batch(self):
+                with self._lock:
+                    # the committer: edl-lint: disable=EDL403
+                    os.fsync(self._fh.fileno())
+    """
+    assert findings_for(src, select={"EDL403"}) == []
+
+
+def test_journal_committer_is_the_sanctioned_fsync_site():
+    # the live tree must stay EDL403-clean WITH the journal's committer
+    # carrying explicit reviewed disables — the rule would fire there
+    # otherwise (meta-test: keeps the disables from silently rotting)
+    import elasticdl_tpu.master.journal as jmod
+
+    src = open(jmod.__file__, encoding="utf-8").read()
+    assert src.count("edl-lint: disable=EDL403") >= 3
+
+
 def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
                 "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
-                "EDL401"):
+                "EDL401", "EDL402", "EDL403"):
         assert rid in out
 
 

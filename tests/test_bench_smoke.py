@@ -104,6 +104,43 @@ def test_rescale_leg_reports_recovery_and_exactness(bench, mesh8, monkeypatch):
     ), (cp, res["time_to_recovery_s"])
 
 
+def test_control_plane_leg_smoke(bench, monkeypatch):
+    """The control-plane swarm scenario (ISSUE 8): a tiny swarm must run
+    the full 2x2 {commit mode} x {lease batch} matrix with exactly-once
+    accounting in every cell, produce the heartbeat fan-in comparison,
+    and show kill-master replay accounting IDENTICAL across commit modes
+    (the acceptance identity; the >=5x throughput claim itself is sized
+    for the 64-worker bench run, not this smoke)."""
+    monkeypatch.setattr(bench, "CP_WORKERS", 4)
+    monkeypatch.setattr(bench, "CP_TASKS", 48)
+    monkeypatch.setattr(bench, "CP_BATCH", 8)
+    monkeypatch.setattr(bench, "CP_HEARTBEATS", 5)
+    monkeypatch.setattr(bench, "CP_COHORT", 4)
+    res = bench.bench_control_plane()
+    assert set(res["modes"]) == {
+        "per_commit_b1", "per_commit_b8",
+        "group_commit_b1", "group_commit_b8",
+    }
+    for label, mode in res["modes"].items():
+        assert "accounting_error" not in mode, (label, mode)
+        assert "errors" not in mode, (label, mode)
+        assert mode["finished_training"] == 48, (label, mode)
+        assert mode["leases_per_sec"] > 0 and mode["reports_per_sec"] > 0
+        assert mode["journal_commit_p50_ms"] > 0
+    hb = res["heartbeats"]
+    assert hb["point_to_point_beats_per_sec"] > 0
+    assert hb["coalesced_member_beats_per_sec"] > 0
+    # every member's stats landed as its own health record: leader+members
+    # for the cohort, plus the point-to-point workers
+    assert hb["health_records"] >= 4 + hb["cohort_size"]
+    rc = res["replay_check"]
+    assert rc["identical"] is True, rc
+    for mode in ("per_commit", "group_commit"):
+        assert rc[mode]["exactly_once"] is True, rc
+        assert rc[mode]["generation"] == 2, rc
+        assert rc[mode]["stranded_lease_requeued"] is True, rc
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)

@@ -339,7 +339,7 @@ def test_spawn_fault_site_spawns_doomed_process(tmp_path):
 
 
 def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
-                                tag: str = ""):
+                                tag: str = "", group_commit_ms: float = 0.0):
     """One full job where the master is killed mid-epoch and restarted.
 
     The worker is the SAME single-threaded loop throughout (no process
@@ -372,7 +372,7 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
     faults.install(spec, seed=seed)
 
     def boot(port=0):
-        journal = ControlPlaneJournal(ckpt_dir)
+        journal = ControlPlaneJournal(ckpt_dir, group_commit_ms=group_commit_ms)
         dispatcher = TaskDispatcher(
             training_shards=SHARDS, records_per_task=40, shuffle=True,
             shuffle_seed=seed, task_timeout_s=1e9, journal=journal,
@@ -458,9 +458,11 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
             except faults.FaultInjected:
                 # the chaos driver's half: abrupt death (no shutdown
                 # handshake, no worker teardown), then a successor boots
-                # from the journal on the same address
+                # from the journal on the same address. abort(), not
+                # close(): queued-but-unacknowledged group commits must
+                # DROP, exactly as SIGKILL would drop them
                 server.stop(None).wait(5)
-                journal.close()
+                journal.abort()
                 journal, dispatcher, membership, servicer, server, port = (
                     boot(port)
                 )
@@ -552,6 +554,41 @@ def test_kill_master_smoke_exactly_once_and_deterministic(tmp_path):
     for shard, _, length in SHARDS:
         marks = [0] * length
         for s, a, b in run_a["applied"]:
+            if s == shard:
+                for i in range(a, b):
+                    marks[i] += 1
+        bad = [i for i, m in enumerate(marks) if m != 1]
+        assert not bad, (shard, bad[:10])
+
+
+@pytest.mark.chaos
+def test_kill_master_smoke_group_commit_mode_identical(tmp_path):
+    """ISSUE 8 acceptance: kill-master replay accounting must be
+    IDENTICAL across commit modes. The same seeded scenario runs with
+    `--journal_group_commit_ms` > 0 — same fault schedule, same
+    accepted-task set, same final counts as the per-commit twin, because
+    group commit changes only how records pack into fsyncs: everything
+    acknowledged is still durable (ack-after-fsync), and what the abrupt
+    death drops was never acknowledged to the worker."""
+    per = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "per"), crash_at=5, tag="per",
+    )
+    grp = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "grp"), crash_at=5, tag="grp",
+        group_commit_ms=5.0,
+    )
+    assert grp["trace"] == per["trace"] == ["master_crash:drop#5"]
+    # the acceptance identity: accounting does not depend on commit mode
+    assert grp["applied"] == per["applied"]
+    assert grp["counts"] == per["counts"]
+    assert grp["restarts"] == 1 and grp["generation"] == 2
+    assert grp["stub_generation"] == 2
+    assert grp["counts"]["failed_permanently"] == 0
+    assert grp["counts"]["todo"] == 0 and grp["counts"]["doing"] == 0
+    # exactly-once span coverage under group commit
+    for shard, _, length in SHARDS:
+        marks = [0] * length
+        for s, a, b in grp["applied"]:
             if s == shard:
                 for i in range(a, b):
                     marks[i] += 1

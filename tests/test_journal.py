@@ -402,6 +402,217 @@ def test_discard_retires_journal_so_resubmit_starts_fresh(tmp_path):
 
 
 # ---------------------------------------------------------------------- #
+# group-commit crash consistency (ISSUE 8)
+
+
+def drive_schedule(journal):
+    """One fixed dispatcher schedule (the replay-identity probe): lease,
+    finish, lease+requeue, lease — leaves one in-flight lease behind."""
+    d = make_dispatcher(journal)
+    t1 = d.get(0)
+    assert d.report(t1.task_id, 0, success=True)
+    t2 = d.get(0)
+    assert d.report(t2.task_id, 0, success=False, err="boom")   # requeue
+    t3 = d.get(0)                       # in-flight at "crash" time
+    assert t3 is not None
+    return d
+
+
+def flatten_records(lines):
+    """Journal lines -> the flat record sequence (batch lines unwrapped),
+    headers dropped — the unit 'record-identical' compares in."""
+    out = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        rec = json.loads(line)
+        if rec.get("t") == "batch":
+            out.extend(rec["records"])
+        elif rec.get("t") != "header":
+            out.append(rec)
+    return out
+
+
+def test_group_commit_replay_record_identical_to_per_commit(tmp_path):
+    """The same mutation schedule journaled in per-commit and group-commit
+    mode must leave RECORD-IDENTICAL journals (group mode only changes how
+    records are packed into lines/fsyncs, never which records exist or
+    their order) — and therefore identical crash replays."""
+    per_dir, grp_dir = str(tmp_path / "per"), str(tmp_path / "grp")
+    j_per = ControlPlaneJournal(per_dir)
+    drive_schedule(j_per)
+    j_per.close()
+    j_grp = ControlPlaneJournal(grp_dir, group_commit_ms=10.0)
+    drive_schedule(j_grp)
+    j_grp.close()
+
+    def lines(d):
+        path = os.path.join(d, "control", "journal.jsonl")
+        return open(path, encoding="utf-8").read().splitlines()
+
+    assert flatten_records(lines(per_dir)) == flatten_records(lines(grp_dir))
+    # and the replays agree exactly (incl. the conservative lease requeue)
+    r_per, r_grp = replay_lines(lines(per_dir)), replay_lines(lines(grp_dir))
+    assert r_per.dispatcher == r_grp.dispatcher
+
+
+def test_torn_group_batch_drops_whole(tmp_path):
+    """A group flush rides ONE batch line: tearing it (crash mid-write)
+    must drop every commit of that window together — a parseable prefix
+    would replay some of a window's commits and not others, an ordering
+    no per-commit run could produce."""
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=50.0)
+    commits = [
+        j.append("epoch_advance", epoch=0),
+        j.append("task_create",
+                 task={"task_id": 1, "type": 0, "shard_name": "s",
+                       "start": 0, "end": 10, "epoch": 0, "retries": 0},
+                 front=False),
+        j.append("task_lease", task_id=1, worker_id=0),
+    ]
+    for c in commits:
+        c.wait()
+    j.close()
+    path = os.path.join(str(tmp_path), "control", "journal.jsonl")
+    lines = open(path, encoding="utf-8").read().splitlines()
+    assert len(lines) == 2                 # header + ONE group batch line
+    torn = [lines[0], lines[1][: len(lines[1]) // 2]]
+    res = replay_lines(torn)
+    assert res.dropped_lines == 1
+    assert res.dispatcher is None          # the whole window dropped
+    res = replay_lines(lines)
+    assert res.dispatcher.epoch == 0       # intact window replays whole
+
+
+def test_acked_lease_survives_kill_between_ack_and_queue_drain(tmp_path):
+    """THE ack-after-fsync guarantee: once get() returned (the ack a
+    worker acts on), a kill — even with LATER records still queued and
+    unflushed — must replay the lease. The abort drops only the queued
+    suffix nobody was told about."""
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=25.0)
+    d = make_dispatcher(j)
+    task = d.get(0)                        # returns only after the fsync
+    assert task is not None
+    # a later transition enqueued but NOT awaited: the crash may lose it
+    unacked = j.append("world_version", version=9)
+    j.abort()                              # SIGKILL semantics
+    import pytest
+
+    from elasticdl_tpu.master.journal import JournalCommitError
+    with pytest.raises(JournalCommitError):
+        unacked.wait(timeout_s=1)
+
+    j2 = ControlPlaneJournal(str(tmp_path))
+    snap = j2.dispatcher_snapshot()
+    # the acked lease is there — conservatively requeued at the front
+    assert snap.requeued_leases == 1
+    assert snap.todo[0]["task_id"] == task.task_id
+    # the unacked suffix is gone (and that is fine: no one saw its ack)
+    assert j2.world_version == 0
+    j2.close()
+
+
+def test_group_commit_flush_coalesces_concurrent_commits(tmp_path):
+    """Commits enqueued within one window land in ONE fsync (the
+    throughput mechanism) and every waiter is released by it."""
+    import threading
+
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=30.0)
+    results = []
+
+    def mutate(i):
+        c = j.append("world_version", version=i)
+        c.wait()
+        results.append(i)
+
+    threads = [threading.Thread(target=mutate, args=(i,)) for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(results) == list(range(8))
+    j.close()
+    path = os.path.join(str(tmp_path), "control", "journal.jsonl")
+    lines = open(path, encoding="utf-8").read().splitlines()
+    # 8 commits, far fewer lines than commits (coalesced windows); replay
+    # sees the max version regardless of packing
+    assert len(lines) < 9
+    assert replay_lines(lines).world_version == 7
+
+
+def test_flush_failure_poisons_journal_no_ack_after_lost_window(
+    tmp_path, monkeypatch
+):
+    """A failed group flush POISONS the journal: a later window's
+    successful fsync must not release acks while an earlier window's
+    records are lost (flush order == ack-validity order), and writing
+    past a possibly-torn tail would fuse lines at replay. Every commit
+    after the failure fails its wait()."""
+    import pytest
+
+    from elasticdl_tpu.master.journal import JournalCommitError
+
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=10.0)
+    j.append("epoch_advance", epoch=0).wait()     # healthy window
+
+    real_fsync = os.fsync
+    broken = {"on": True}
+
+    def flaky_fsync(fd):
+        if broken["on"]:
+            raise OSError(28, "No space left on device")
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", flaky_fsync)
+    with pytest.raises(JournalCommitError):
+        j.append("task_lease", task_id=1, worker_id=0).wait()
+    # the disk "recovers" — but the journal must stay poisoned: a commit
+    # ordered after the lost window can never validly ack
+    broken["on"] = False
+    with pytest.raises(JournalCommitError):
+        j.append("epoch_advance", epoch=1).wait()
+    monkeypatch.setattr(os, "fsync", real_fsync)
+    j.abort()
+    # replay sees only what was durable BEFORE the failure
+    j2 = ControlPlaneJournal(str(tmp_path))
+    assert j2.dispatcher_snapshot().epoch == 0
+    assert j2.replay.dropped_lines <= 1          # a torn tail at most
+    j2.close()
+
+
+def test_close_racing_window_writes_no_empty_batch_line(tmp_path):
+    """close()/abort() racing the committer's window wait must not flush
+    a freshly swapped EMPTY batch (a spurious `{"t":"batch","records":[]}`
+    line + a zero-record flush in the metrics)."""
+    for _ in range(5):                 # the race needs a few attempts
+        j = ControlPlaneJournal(str(tmp_path), group_commit_ms=40.0)
+        j.append("epoch_advance", epoch=0)      # opens a window
+        j.close()                               # races the window wait
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        for line in lines:
+            rec = json.loads(line)
+            if rec.get("t") == "batch":
+                assert rec["records"], lines
+        assert replay_lines(lines).dispatcher.epoch == 0
+        os.remove(j.path)
+
+
+def test_member_join_replay_carries_led_by():
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "member_join", "worker_id": 0, "name": "leader",
+                    "version": 1}),
+        json.dumps({"t": "member_join", "worker_id": 1, "name": "leader#p1",
+                    "version": 1, "led_by": 0}),
+    ]
+    ms = replay_lines(lines).membership
+    by_id = {w["worker_id"]: w for w in ms.workers}
+    assert by_id[0]["led_by"] is None
+    assert by_id[1]["led_by"] == 0
+
+
+# ---------------------------------------------------------------------- #
 # membership-signal takeover hygiene (satellite)
 
 

@@ -261,3 +261,73 @@ def test_reregister_of_unknown_id_falls_through_to_fresh_join(fenced_stack):
     # unknown id becomes a fresh registration under that preferred id
     assert r.worker_id == 7
     assert membership.alive_count() == 1
+
+
+# ---------------------------------------------------------------------- #
+# batched leases + cohort-aggregated RPCs (ISSUE 8)
+
+
+def test_get_task_max_tasks_batches_leases(master_stack):
+    stub, dispatcher, *_ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    resp = stub.GetTask(
+        pb.GetTaskRequest(worker_id=r.worker_id, max_tasks=3)
+    )
+    assert len(resp.tasks) == 3
+    # back-compat: the singular field mirrors the first lease
+    assert resp.task.task_id == resp.tasks[0].task_id
+    assert dispatcher.counts()["doing"] == 3
+    # max_tasks unset (old worker) stays the classic single-lease shape
+    resp1 = stub.GetTask(pb.GetTaskRequest(worker_id=r.worker_id))
+    assert len(resp1.tasks) == 1
+    assert resp1.task.type == pb.TRAINING
+
+
+def test_get_task_max_tasks_is_capped_server_side(master_stack):
+    stub, dispatcher, *_ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(worker_name="w"))
+    resp = stub.GetTask(
+        pb.GetTaskRequest(worker_id=r.worker_id, max_tasks=10_000)
+    )
+    # 4 tasks exist (40 records / 10): all leased, none invented, and the
+    # request's absurd batch did not fault the server
+    assert len(resp.tasks) == 4
+    from elasticdl_tpu.master.servicer import MasterServicer
+
+    assert MasterServicer.MAX_LEASE_BATCH == 256
+
+
+def test_register_with_member_names_and_coalesced_heartbeat(master_stack):
+    stub, dispatcher, membership, *_ = master_stack
+    r = stub.RegisterWorker(pb.RegisterWorkerRequest(
+        worker_name="cohort", member_names=["cohort#p1", "cohort#p2"],
+    ))
+    assert len(r.member_ids) == 2
+    assert r.num_workers == 1           # members are not logical workers
+    from elasticdl_tpu.observability.health import encode_stats
+
+    beat = pb.HeartbeatRequest(
+        worker_id=r.worker_id,
+        model_version=3,
+        members=[
+            pb.MemberBeat(
+                worker_id=mid, model_version=3,
+                stats_json=encode_stats(
+                    {"step_p50_ms": 7.0, "phase": "train"}),
+            )
+            for mid in r.member_ids
+        ],
+    )
+    resp = stub.Heartbeat(beat)
+    assert not resp.shutdown
+    recs = {h["worker_id"]: h for h in membership.health_snapshot()}
+    for mid in r.member_ids:
+        assert recs[mid]["step_p50_ms"] == 7.0
+    # a garbage member payload degrades THAT member to liveness-only,
+    # never the beat
+    bad = pb.HeartbeatRequest(
+        worker_id=r.worker_id,
+        members=[pb.MemberBeat(worker_id=r.member_ids[0],
+                               stats_json="}{not json")],
+    )
+    assert not stub.Heartbeat(bad).shutdown

@@ -129,3 +129,114 @@ def test_concurrent_reaps_declare_each_lapsed_worker_once():
             t.join(timeout=5)
         assert sorted(deaths) == sorted(ids)      # every worker died once
         assert m.alive_count() == 0
+
+
+# ---------------------------------------------------------------------- #
+# cohort-aggregated membership (ISSUE 8)
+
+
+def test_register_members_no_version_bump_and_idempotent():
+    m = Membership(heartbeat_timeout_s=30)
+    leader = m.register("leader")
+    v = m.version
+    members = m.register_members(leader.worker_id, ["leader#p1", "leader#p2"])
+    assert [mm.name for mm in members] == ["leader#p1", "leader#p2"]
+    assert all(mm.led_by == leader.worker_id for mm in members)
+    assert m.version == v                  # members are not rendezvous events
+    # logical worker count excludes members (LR scaling, num_workers)
+    assert m.alive_count() == 1
+    # idempotent: the same names come back with the same ids
+    again = m.register_members(leader.worker_id, ["leader#p1", "leader#p2"])
+    assert [mm.worker_id for mm in again] == [mm.worker_id for mm in members]
+
+
+def test_member_registration_requires_a_leader():
+    m = Membership(heartbeat_timeout_s=30)
+    leader = m.register("leader")
+    members = m.register_members(leader.worker_id, ["leader#p1"])
+    try:
+        m.register_members(members[0].worker_id, ["nested"])
+        assert False, "a member cannot lead members"
+    except KeyError:
+        pass
+    try:
+        m.register_members(999, ["orphan"])
+        assert False, "unknown leader id must be rejected"
+    except KeyError:
+        pass
+
+
+def test_register_members_rejects_oversized_cohort():
+    # the membership twin of the servicer's MAX_LEASE_BATCH cap: one RPC
+    # must not allocate unbounded entries / one unbounded journal line
+    m = Membership(heartbeat_timeout_s=30)
+    leader = m.register("leader")
+    try:
+        m.register_members(
+            leader.worker_id,
+            [f"p{i}" for i in range(Membership.MAX_COHORT_MEMBERS + 1)],
+        )
+        assert False, "oversized cohort must be rejected"
+    except ValueError:
+        pass
+    assert m.alive_count() == 1
+
+
+def test_coalesced_heartbeat_updates_member_health_under_one_beat():
+    m = Membership(heartbeat_timeout_s=30)
+    leader = m.register("leader")
+    members = m.register_members(leader.worker_id, ["leader#p1", "leader#p2"])
+    beats = [
+        (members[0].worker_id, 5, {"step_p50_ms": 10.0, "phase": "train"}),
+        (members[1].worker_id, 5, {"step_p50_ms": 90.0, "phase": "train"}),
+        (12345, 5, {"step_p50_ms": 1.0}),      # not a member: ignored
+    ]
+    assert m.heartbeat(leader.worker_id, 5, stats={"step_p50_ms": 10.0},
+                       members=beats)
+    recs = {r["worker_id"]: r for r in m.health_snapshot()}
+    assert set(recs) == {leader.worker_id,
+                         members[0].worker_id, members[1].worker_id}
+    assert recs[members[1].worker_id]["step_p50_ms"] == 90.0
+    assert 12345 not in recs
+
+
+def test_reap_skips_members_and_leader_death_cascades():
+    m = Membership(heartbeat_timeout_s=0.05)
+    leader = m.register("leader")
+    members = m.register_members(leader.worker_id, ["leader#p1", "leader#p2"])
+    singleton = m.register("loner")
+    v = m.version
+    deaths = []
+    m.add_death_callback(deaths.append)
+    time.sleep(0.08)
+    # keep only the leader fresh: members send NO beats of their own and
+    # must not be reaped (their liveness is the leader's)
+    m.heartbeat(leader.worker_id)
+    lapsed = m.reap()
+    assert lapsed == [singleton.worker_id]
+    assert m.version == v + 1
+    assert all(w.alive for w in [m._workers[mm.worker_id] for mm in members])
+    # now the leader lapses: ONE version bump kills the whole cohort
+    v = m.version
+    time.sleep(0.08)
+    m.reap()
+    assert m.version == v + 1
+    assert not any(
+        m._workers[mm.worker_id].alive for mm in members
+    )
+    # death callbacks fired for the leader AND each member (task recovery)
+    assert set(deaths) >= {leader.worker_id,
+                           members[0].worker_id, members[1].worker_id}
+
+
+def test_leader_reregister_revives_cascaded_members():
+    m = Membership(heartbeat_timeout_s=0.05)
+    leader = m.register("leader")
+    members = m.register_members(leader.worker_id, ["leader#p1"])
+    time.sleep(0.08)
+    m.reap()                                   # cohort dead
+    assert not m._workers[members[0].worker_id].alive
+    m.reregister(leader.worker_id, "leader")   # revival bumps version
+    again = m.register_members(leader.worker_id, ["leader#p1"])
+    assert again[0].worker_id == members[0].worker_id
+    assert m._workers[members[0].worker_id].alive

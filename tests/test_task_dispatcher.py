@@ -383,3 +383,63 @@ def test_request_stop_training_drops_recovered_and_expired_tasks():
     assert d.get(2) is None             # get() reaps expired leases
     assert d.counts()["todo"] == 0
     assert d.finished()
+
+
+# ---------------------------------------------------------------------- #
+# batched leases (ISSUE 8)
+
+
+def test_get_many_leases_up_to_n_in_order():
+    d = make(num_records=100, rpt=10)          # 10 tasks
+    batch = d.get_many(0, 4)
+    assert len(batch) == 4
+    assert [t.task_id for t in batch] == sorted(t.task_id for t in batch)
+    assert d.counts()["doing"] == 4 and d.counts()["todo"] == 6
+    # a short queue hands back what it has, never blocks for more
+    rest = d.get_many(1, 100)
+    assert len(rest) == 6
+    assert d.counts()["todo"] == 0
+    # drained: the next poll is a WAIT (empty list)
+    assert d.get_many(2, 4) == []
+
+
+def test_get_many_semantics_per_task():
+    """Expiry/report semantics stay per shard: tasks from one batch can
+    finish, fail, and expire independently."""
+    d = make(num_records=40, rpt=10, task_timeout_s=0.05)
+    batch = d.get_many(0, 3)
+    assert d.report(batch[0].task_id, 0, success=True)
+    assert d.report(batch[1].task_id, 0, success=False, err="boom")
+    time.sleep(0.06)
+    d.poke()                                   # expires the third lease
+    c = d.counts()
+    assert c["finished_training"] == 1
+    assert c["doing"] == 0
+    assert c["todo"] == 3                      # requeued fail + expiry + 1 fresh
+
+
+def test_get_many_journals_batch_under_one_commit(tmp_path):
+    from elasticdl_tpu.master.journal import ControlPlaneJournal, replay_lines
+
+    j = ControlPlaneJournal(str(tmp_path))
+    d = make(num_records=40, rpt=10, journal=j)
+    batch = d.get_many(7, 3)
+    j.close()
+    path = tmp_path / "control" / "journal.jsonl"
+    lines = path.read_text().splitlines()
+    import json as _json
+
+    lease_lines = [
+        ln for ln in lines
+        if '"task_lease"' in ln
+    ]
+    # the 3 lease records ride ONE batch line (one fsync)
+    assert len(lease_lines) == 1
+    rec = _json.loads(lease_lines[0])
+    assert rec["t"] == "batch" and len(rec["records"]) == 3
+    # and a crash replays every lease of the batch (requeued in order)
+    snap = replay_lines(lines).dispatcher
+    assert snap.requeued_leases == 3
+    assert [t["task_id"] for t in snap.todo[:3]] == [
+        t.task_id for t in batch
+    ]
