@@ -807,6 +807,119 @@ def bench_rescale(mesh, np):
     return out
 
 
+def bench_observability_overhead(mesh, np):
+    """Recorder+profiler overhead gate (ISSUE 9): the same jitted train
+    step measured per-step with the always-on observability hot-path
+    instrumentation OFF vs ON. The ON leg mirrors (and slightly
+    over-states) what a real worker step pays:
+
+    - step profiler: a data_wait attribution + the compute add +
+      step_done() rolling-window update (observability/profile.py);
+    - worker step stats: one observe_step into the heartbeat window;
+    - flight ring: the tracer sink attached AND one explicit ring record
+      per step (the real worker records nothing per step — spans stay at
+      task granularity per EDL404 — so this bounds the ring cost from
+      above).
+
+    Emits median/p90 per-step wall time for both modes and
+    `overhead_pct` = (on - off) / off over the medians; acceptance: <= 2%.
+    Steps are forced individually (float readback) because the PER-STEP
+    cost is the measurand — amortizing through train_many would hide it.
+    """
+    from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.observability import flight as flight_lib
+    from elasticdl_tpu.observability import profile as profile_lib
+    from elasticdl_tpu.observability.health import WorkerStepStats
+    from elasticdl_tpu.training.model_spec import ModelSpec
+    from elasticdl_tpu.training.trainer import Trainer
+
+    steps = int(os.environ.get("EDL_BENCH_OBS_STEPS", "200"))
+    batch_size = min(BATCH, 1024)
+    module, _ = load_module(os.path.join(REPO_ROOT, "model_zoo"),
+                            "census.wide_deep.custom_model")
+    spec = ModelSpec(
+        model=module.custom_model(), loss=module.loss,
+        optimizer=module.optimizer(), dataset_fn=None,
+        eval_metrics_fn=getattr(module, "eval_metrics_fn", None),
+        module_name="census.wide_deep",
+    )
+    trainer = Trainer(spec, mesh)
+    r = np.random.RandomState(3)
+    batch = {
+        "features": {
+            "dense": r.rand(batch_size, 5).astype(np.float32),
+            "cat": r.randint(0, 400, (batch_size, 9)).astype(np.int32),
+        },
+        "labels": r.randint(0, 2, (batch_size,)).astype(np.int32),
+    }
+    state = trainer.init_state(batch)
+    for _ in range(5):                       # compile + warmup
+        state, logs = trainer.train_step(state, batch)
+    float(logs["loss"])
+
+    def run(instrumented: bool):
+        nonlocal state
+        prof = profile_lib.StepProfiler()
+        stats = WorkerStepStats()
+        rec = flight_lib.FlightRecorder(ring=4096, role="bench")
+        if instrumented:
+            rec.attach_tracing()
+        times = []
+        try:
+            for i in range(steps):
+                # times[] captures the WHOLE loop body — the step AND the
+                # instrumentation that follows its readback — so the
+                # profiler/stats/ring cost actually lands in the measured
+                # per-step time (a window closed at the readback would
+                # read ~0% overhead no matter how expensive they got)
+                t0 = time.perf_counter()
+                if instrumented:
+                    # nonzero, so the add takes its real (locked) path
+                    prof.add("data_wait", 1e-9)
+                    state, logs = trainer.train_step(state, batch)
+                    # the scalar readback is the completion barrier —
+                    # deliberate per-step sync, it IS the measurement:
+                    # edl-lint: disable=EDL201
+                    loss = float(logs["loss"])
+                    compute_s = time.perf_counter() - t0
+                    prof.add("compute", compute_s)
+                    prof.step_done()
+                    stats.observe_step(compute_s, batch_size)
+                    rec.record("step", "bench.step", i=i, loss=loss)
+                else:
+                    state, logs = trainer.train_step(state, batch)
+                    # same barrier, uninstrumented twin:
+                    # edl-lint: disable=EDL201
+                    float(logs["loss"])
+                times.append(time.perf_counter() - t0)
+        finally:
+            rec.detach_tracing()
+        times.sort()
+        return times
+
+    # interleave off/on/off to cancel drift (CPU boxes throttle); keep the
+    # faster OFF sample as the honest baseline
+    off_a = run(False)
+    on = run(True)
+    off_b = run(False)
+
+    def med(ts):
+        return ts[len(ts) // 2]
+
+    off = min(med(off_a), med(off_b))
+    out = {
+        "steps_per_mode": steps,
+        "median_step_s_off": round(off, 6),
+        "median_step_s_on": round(med(on), 6),
+        "p90_step_s_off": round(min(off_a[int(0.9 * steps)],
+                                    off_b[int(0.9 * steps)]), 6),
+        "p90_step_s_on": round(on[int(0.9 * steps)], 6),
+    }
+    out["overhead_pct"] = round(100.0 * (med(on) - off) / off, 3) if off else 0.0
+    out["gate"] = "<= 2% median step time (ISSUE 9 acceptance)"
+    return out
+
+
 # ---------------------------------------------------------------------- #
 # control-plane throughput (ISSUE 8): a simulated in-process worker swarm
 # (threads, no devices) driving register/lease/report/heartbeat against a
@@ -1351,6 +1464,8 @@ def _run_leg(leg, mesh, np):
         return bench_rescale(mesh, np)
     if leg == "control_plane":
         return bench_control_plane(mesh, np)
+    if leg == "obs_overhead":
+        return bench_observability_overhead(mesh, np)
     if leg == "transformer_lm":
         # the Pallas flash-attention kernel vs the XLA materialized-scores
         # path, same model/batch (ops/pallas_attention.py; TPU only — on CPU
@@ -1390,9 +1505,9 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "rescale", "control_plane", "embedding", "transformer_lm", "time_to_auc",
-    "mnist_cnn", "census_wide_deep", "xdeepfm", "cifar10_resnet20",
-    "resnet50_imagenet",
+    "rescale", "control_plane", "obs_overhead", "embedding",
+    "transformer_lm", "time_to_auc", "mnist_cnn", "census_wide_deep",
+    "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
@@ -1514,6 +1629,15 @@ def main():
         # line (CI uploads it as an artifact; tier-1 smoke asserts on it)
         mesh = build_mesh({"data": len(jax.devices())})
         print(json.dumps({"rescale": _run_leg("rescale", mesh, np)}))
+        return
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "obs_overhead":
+        # `python bench.py obs_overhead`: the recorder+profiler overhead
+        # gate alone (ISSUE 9 acceptance: <= 2% median step time)
+        mesh = build_mesh({"data": len(jax.devices())})
+        print(json.dumps(
+            {"obs_overhead": _run_leg("obs_overhead", mesh, np)}
+        ))
         return
 
     if len(sys.argv) >= 3 and sys.argv[1] == "--leg":

@@ -31,6 +31,20 @@ EDL402 span-emit-under-lock
     membership/dispatcher pattern), or open the span around the `with
     self._lock:` block (the process-manager pattern).
 
+EDL404 span-sink-in-hot-loop
+    A span opened or an event emitted (same call shapes as EDL402)
+    lexically inside a PER-STEP hot loop — a for/while whose body
+    dispatches device steps (`train_step`/`train_many`/`eval_step`/...,
+    the EDL201 hot-loop definition). Every span/event emission writes
+    (and flushes) trace.jsonl under the tracer lock: per-step emission
+    puts file I/O on the training hot path, thousands of times per task.
+    Per-step telemetry belongs in the structures built for it — the step
+    profiler's phase accumulators (observability/profile.py: perf_counter
+    reads + float adds) and the flight recorder's in-memory ring
+    (observability/flight.py), which capture full fidelity without
+    touching a file until an incident dumps them. Emit spans at task /
+    rescale / reform granularity instead.
+
 EDL403 fsync-under-lock
     An ``os.fsync`` call lexically inside a `guarded_by:`-annotated
     lock's critical section. An fsync is milliseconds on local disk and
@@ -52,6 +66,10 @@ import re
 from typing import Iterator, List, Set
 
 from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+from elasticdl_tpu.analysis.jax_rules import (
+    _DISPATCH_METHODS,
+    _called_attr_names,
+)
 from elasticdl_tpu.analysis.locks import (
     _CONSTRUCTION_METHODS,
     guarded_attrs,
@@ -344,3 +362,54 @@ class FsyncUnderLockRule(Rule):
             self, ctx, lambda node: _is_fsync_call(node, direct_names),
             message,
         )
+
+
+# ------------------------------------------------------------------ #
+# EDL404 span-sink-in-hot-loop
+
+
+@register
+class SpanSinkInHotLoopRule(Rule):
+    id = "EDL404"
+    name = "span-sink-in-hot-loop"
+    doc = (
+        "span/event emitted inside a per-step hot loop — trace emission "
+        "is file I/O; per-step telemetry goes through the flight ring / "
+        "step profiler, spans stay at task/rescale granularity"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct_names = _direct_emit_imports(ctx.tree)
+        reported: Set[int] = set()   # a call nested in two loops fires once
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            called: Set[str] = set()
+            for stmt in body:
+                called |= _called_attr_names(stmt)
+            if not (called & _DISPATCH_METHODS):
+                continue
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if (
+                        isinstance(sub, ast.Call)
+                        and _is_emit_call(sub, direct_names)
+                        and id(sub) not in reported
+                    ):
+                        reported.add(id(sub))
+                        kind = (
+                            sub.func.attr
+                            if isinstance(sub.func, ast.Attribute)
+                            else sub.func.id
+                        )
+                        yield self.finding(
+                            ctx, sub,
+                            f"{kind} emission inside a per-step hot loop "
+                            "— trace emission writes trace.jsonl; "
+                            "per-step telemetry goes through the flight "
+                            "ring / step profiler "
+                            "(observability/flight.py, profile.py), "
+                            "spans stay at task/rescale granularity "
+                            "(EDL404)",
+                        )

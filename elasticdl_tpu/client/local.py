@@ -81,6 +81,28 @@ def run_local(
         checkpoint_request_fn=lambda: master.servicer.request_checkpoint(0),
         journal=master.journal,
     )
+    # Straggler-onset OFFENDER snapshot (the master's own hook already
+    # dumps the MASTER's flight ring): only this launcher knows worker
+    # pids, so the SIGUSR2 trigger that cuts the offender's black box is
+    # wired here. Cohort member names carry their process index
+    # (`...#p<i>`), so the signal lands on the one slow process.
+    def _offender_flight_hook(info: dict) -> None:
+        name = str(info.get("worker_name", ""))
+        process_index = None
+        if "#p" in name:
+            try:
+                process_index = int(name.rsplit("#p", 1)[1])
+            except ValueError:
+                process_index = None
+        worker_id = int(info.get("worker_id", -1))
+        if process_index is not None:
+            # a cohort member: the proc table is keyed by process index
+            # under the leader's logical worker
+            manager.request_flight_dump(0, process_index=process_index)
+        elif worker_id >= 0:
+            manager.request_flight_dump(worker_id)
+
+    master.health.add_hook(_offender_flight_hook)
     master.start()
     manager.start_workers()
     deadline = time.time() + timeout_s if timeout_s else None
@@ -101,6 +123,10 @@ def run_local(
                 )
                 master.crash()
                 master = _rebuild_master(cfg)
+                # the successor's health scorer needs the launcher hook
+                # re-wired (Master.__init__ only adds its own master-side
+                # dump hook)
+                master.health.add_hook(_offender_flight_hook)
                 manager.rebind_master(
                     master.membership,
                     master.dispatcher.finished,

@@ -134,6 +134,15 @@ class JobConfig:
     # <trace_dir>/<role>/): "" derives <summary_dir>/trace when summary_dir
     # is set (spans stay in-memory otherwise); "off" disables the file sink.
     trace_dir: str = ""
+    # Incident flight recorder (observability/flight.py): where per-process
+    # flight-<role>-<pid>.json bundles land on crash, SIGUSR2, the
+    # /debug/flight endpoint, or straggler-hook escalation. "" derives
+    # <summary_dir|checkpoint_dir>/flight
+    # (memory-only when neither is set); "off" disables dumping (the ring
+    # still records); EDL_FLIGHT_DIR overrides either way.
+    flight_dir: str = ""
+    # Flight ring capacity (records kept at full fidelity per process).
+    flight_ring: int = 4096
 
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
@@ -278,6 +287,10 @@ class JobConfig:
             )
         if self.task_lease_batch < 1:
             raise ValueError("task_lease_batch must be >= 1")
+        if self.flight_ring < 16:
+            # a ring too small to hold even one incident's records would
+            # silently produce useless bundles; fail at submit time
+            raise ValueError("flight_ring must be >= 16 records")
         if self.master_restarts > 0 and not self.checkpoint_dir:
             # a journal-less successor rebuilds the dispatcher from scratch
             # — every already-finished task would be recreated and re-run,

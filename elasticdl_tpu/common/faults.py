@@ -104,6 +104,35 @@ TRACE_ENV = "EDL_FAULTS_TRACE"
 
 ACTIONS = ("drop", "delay", "crash")
 
+#: pre-crash hooks: called (with the firing site) immediately before a
+#: `crash` action's os._exit — which skips atexit, so this is the ONLY
+#: chance for a black box (the flight recorder) to hit disk. Process-
+#: lifetime: reset()/uninstall() leave them installed. A raising hook is
+#: swallowed; the crash must proceed (that is the injected contract).
+_CRASH_HOOKS: List = []
+
+
+def add_crash_hook(fn) -> None:
+    """Register `fn(site)` to run before a `crash` action kills the
+    process (observability/flight.py wires its bundle dump here)."""
+    if fn not in _CRASH_HOOKS:
+        _CRASH_HOOKS.append(fn)
+
+
+def remove_crash_hook(fn) -> None:
+    if fn in _CRASH_HOOKS:
+        _CRASH_HOOKS.remove(fn)
+
+
+def _run_crash_hooks(site: str) -> None:
+    for hook in list(_CRASH_HOOKS):
+        try:
+            hook(site)
+        except Exception:
+            # the simulated kill must happen regardless:
+            # edl-lint: disable=EDL303
+            logger.exception("pre-crash hook %r failed (ignored)", hook)
+
 # trigger aliases accepted in specs (issue/operator shorthand)
 _PARAM_ALIASES = {"step": "at"}
 _KNOWN_PARAMS = {"p", "at", "every", "max", "ms", "code"}
@@ -302,6 +331,9 @@ class FaultInjector:
         elif fired.action == "drop":
             raise FaultInjected(site, fired.hit)
         elif fired.action == "crash":
+            # black-box dumps first (os._exit skips atexit and excepthook:
+            # the flight recorder would otherwise die with its evidence)
+            _run_crash_hooks(site)
             self.flush_trace()
             os._exit(int(fired.params.get("code", 1)))
 

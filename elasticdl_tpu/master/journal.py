@@ -467,6 +467,11 @@ class ControlPlaneJournal:
         self._qcv = threading.Condition(threading.Lock())
         self._queue = _OpenBatch()           # guarded_by: _qcv
         self._closing = False                # guarded_by: _qcv
+        # flush(): ask the committer to cut its window NOW (the file
+        # handle stays single-writer; a foreign-thread write could land a
+        # NEWER batch before an older already-swapped one, inverting the
+        # disk-order == mutation-order replay invariant)
+        self._flush_now = False              # guarded_by: _qcv
         # First flush failure poisons the journal: a failed write can
         # leave a PARTIAL line, and appending past it would fuse the next
         # flush into one unparseable line — silently dropping acknowledged
@@ -674,12 +679,13 @@ class ControlPlaneJournal:
                     # close() drains or aborts the remaining queue itself
                     return
                 deadline = self._queue.opened_at + self._window_s
-                while not self._closing:
+                while not self._closing and not self._flush_now:
                     remaining = deadline - time.monotonic()
                     if remaining <= 0:
                         break
                     self._qcv.wait(remaining)
                 batch, self._queue = self._queue, _OpenBatch()
+                self._flush_now = False
             if batch.records:
                 # a close() racing the window wait can hand us a freshly
                 # swapped EMPTY batch — flushing it would write a spurious
@@ -775,6 +781,32 @@ class ControlPlaneJournal:
                 "journal crash-close dropped %d queued record(s) "
                 "(unacknowledged by construction)", len(batch.records),
             )
+
+    def flush(self, timeout_s: float = 30.0) -> None:
+        """Make the OPEN group-commit batch durable now, without closing
+        (no-op in per-commit mode, where appends are already durable, and
+        on an empty queue). The clean-shutdown hook for owners whose last
+        record may still be riding the committer's window — e.g. the
+        ProcessManager's newest ``world_version`` record at a clean stop.
+
+        The flush itself runs on the COMMITTER thread (this method only
+        signals it to cut the window early and waits for the batch's
+        event): a foreign-thread write could land a newer batch before an
+        older already-swapped one and invert the disk-order == mutation-
+        order replay invariant. Failures park on the batch exactly as a
+        committer flush failure would (waiters raise; the journal
+        poisons); a wedged committer bounds this wait at `timeout_s`."""
+        if self._window_s <= 0:
+            return
+        with self._qcv:
+            if self._closing or not self._queue.records:
+                # nothing queued — or the committer already swapped the
+                # batch out and is flushing it as we speak
+                return
+            batch = self._queue
+            self._flush_now = True
+            self._qcv.notify_all()
+        batch.event.wait(timeout_s)
 
     def close(self) -> None:
         """Orderly close: drain the commit queue, then fsync + close."""

@@ -34,9 +34,15 @@ class Master:
         self.cfg = cfg
         # observability first: every span/log below carries the role, and
         # trace.jsonl lands under <trace_dir|summary_dir/trace>/master/
+        from elasticdl_tpu.observability import flight as flight_lib
         from elasticdl_tpu.observability import tracing
 
         tracing.configure_from_config(cfg, role="master")
+        # flight recorder (observability/flight.py): the master's black
+        # box — dumps on crash/SIGUSR2//debug/flight and on straggler
+        # onset (the health hook below)
+        flight_lib.configure_from_config(cfg, role="master")
+        flight_lib.install_crash_hooks()
         self.metrics_server = None
         # cfg.instance_manager == "k8s": this master owns worker pods
         # (created in start()); k8s_api injects a fake for tests
@@ -144,6 +150,12 @@ class Master:
         from elasticdl_tpu.observability.health import ClusterHealth
 
         self.health = ClusterHealth(self.membership)
+        # the PR 6 straggler hook's first real consumer: onset cuts the
+        # MASTER's black box (fleet view, journal state, recent control-
+        # plane events at the moment the fleet went ragged). The OFFENDER
+        # side is launcher-wired (client/local.py SIGUSR2s the worker
+        # process — only the launcher knows pids).
+        self.health.add_hook(self._straggler_flight_hook)
 
         metrics = None
         callbacks = []
@@ -255,6 +267,16 @@ class Master:
         if self.evaluation is not None and self.cfg.job_type == JobType.EVALUATION_ONLY:
             self.evaluation.trigger(0)
 
+    def _straggler_flight_hook(self, info: dict) -> None:
+        """Straggler onset -> snapshot the master's flight ring. Hook
+        exceptions are swallowed by ClusterHealth, and dump() never
+        raises, so this can only ever cost a file write."""
+        from elasticdl_tpu.observability import flight as flight_lib
+
+        flight_lib.get_recorder().dump(
+            f"straggler:worker-{info.get('worker_id')}"
+        )
+
     def _healthz_extra(self) -> dict:
         """What the master's /healthz adds over the per-process base:
         which master (generation), which worker set (membership version +
@@ -329,6 +351,11 @@ class Master:
             # abort, not close: queued group commits whose acks were never
             # released are dropped, exactly as SIGKILL would drop them
             self.journal.abort()
+        # the black box survives the simulated kill (a real SIGKILL is
+        # covered by the fault injector's pre-crash hook instead)
+        from elasticdl_tpu.observability import flight as flight_lib
+
+        flight_lib.get_recorder().dump("master_crash")
         logger.warning("master CRASHED (simulated): serving stopped abruptly")
 
     def shutdown(self, grace_s: float = 5.0) -> None:

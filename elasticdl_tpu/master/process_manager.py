@@ -12,6 +12,7 @@ worker processes, as the reference's integration tests killed real pods.
 from __future__ import annotations
 
 import os
+import signal
 import subprocess
 import sys
 import threading
@@ -709,6 +710,45 @@ class ProcessManager:
                 return
             self._stop.wait(poll_s)
 
+    def request_flight_dump(
+        self, worker_id: int, process_index: Optional[int] = None
+    ) -> bool:
+        """SIGUSR2 a worker's process(es): the flight recorder's explicit
+        trigger — the straggler hook's OFFENDER snapshot rides this
+        (client/local.py wires it; only the launcher knows pids). Plain
+        mode: the proc registered under `worker_id`. Cohort mode: the
+        member process at `process_index`, or the whole cohort when None
+        (a cohort-level flag with no process attribution). Returns True
+        when at least one live process was signalled."""
+        with self._lock:
+            if self._cohort_mode:
+                keys = (
+                    [process_index] if process_index is not None
+                    else list(self._procs)
+                )
+            else:
+                keys = [worker_id]
+            procs = [
+                self._procs[k].proc for k in keys if k in self._procs
+            ]
+        signalled = False
+        for proc in procs:
+            if proc.poll() is not None:
+                continue
+            try:
+                proc.send_signal(signal.SIGUSR2)
+                signalled = True
+            except (OSError, ValueError):
+                continue
+        if signalled:
+            logger.info(
+                "flight dump requested from worker %d%s (SIGUSR2)",
+                worker_id,
+                f" process {process_index}" if process_index is not None
+                else "",
+            )
+        return signalled
+
     # ------------------------------------------------------------------ #
 
     def stop(self, grace_s: float = 10.0) -> None:
@@ -733,6 +773,20 @@ class ProcessManager:
                 wp.proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
                 wp.proc.kill()
+        # Flush-on-shutdown (closes the PR 7 known boundary): in group-
+        # commit mode the newest world_version record may still be riding
+        # the committer's bounded window when a clean stop lands — force
+        # the open batch to disk NOW so an orderly teardown never loses
+        # the version sequence the workers already observed. (The owning
+        # Master's close() would drain too, but this manager must not
+        # depend on who tears down first.)
+        with self._lock:
+            journal = self._journal
+        if journal is not None:
+            try:
+                journal.flush()
+            except Exception:
+                logger.exception("journal flush at manager stop failed")
 
     def all_exited(self) -> bool:
         with self._lock:

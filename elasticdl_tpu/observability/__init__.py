@@ -26,6 +26,16 @@ including the framework-free client submit path):
   detector whose rollup rides the master's /metrics + /healthz.
 - `analyzer` (+ the `analyze` CLI): offline trace merge and per-resize
   critical-path attribution over the `trace.jsonl` files.
+- `flight`: the per-process incident black box — a bounded in-memory
+  ring of recent spans/events/logs/metric deltas at full fidelity,
+  dumped as an atomic `flight-<role>-<pid>.json` bundle on crash,
+  SIGUSR2, `/debug/flight`, or straggler-hook escalation.
+- `profile`: the always-on step profiler — per-step phase attribution
+  (data_wait / h2d / compute / handoff) and memory watermarks, exported
+  as `edl_step_phase_seconds` / `edl_mem_*` gauges and riding the
+  heartbeat stats payload.
+- `incident` (+ CLI): offline cross-role correlation of flight bundles,
+  traces, the journal tail, and health snapshots into one timeline.
 
 See docs/observability.md for the metric catalog and trace schema.
 """

@@ -56,6 +56,14 @@ STATS_METADATA_KEY = "edl-worker-stats"
 MAX_PAYLOAD_BYTES = 2048
 MAX_PAYLOAD_KEYS = 24
 
+#: step-profiler keys (observability/profile.py snapshot schema) carried
+#: from a worker's health record into its straggler info — the WHY behind
+#: a straggler flag
+_PROFILE_KEYS = (
+    "phase_data_wait_ms", "phase_h2d_ms", "phase_compute_ms",
+    "phase_handoff_ms", "mem_host_mb", "mem_dev_mb",
+)
+
 # cluster rollup gauges (master-side; docs/observability.md)
 _reg = default_registry()
 _CL_REPORTING = _reg.gauge(
@@ -296,14 +304,23 @@ class ClusterHealth:
                 scores = robust_scores(p50s)
                 for r, x, score in zip(fresh, p50s, scores):
                     if score >= self.threshold and x >= self.min_ratio * med:
-                        stragglers.append({
+                        info = {
                             "worker_id": int(r.get("worker_id", -1)),
                             "worker_name": str(r.get("name", "")),
                             "score": round(score, 2),
                             "step_time_p50_s": round(x, 6),
                             "median_step_time_s": round(med, 6),
                             "phase": str(r.get("phase", "")),
-                        })
+                        }
+                        # the step profiler's per-phase breakdown + memory
+                        # watermarks (observability/profile.py), when the
+                        # worker's payload carried them: the difference
+                        # between "worker 3 is slow" and "worker 3 is
+                        # blocked on its input pipeline"
+                        for key in _PROFILE_KEYS:
+                            if key in r:
+                                info[key] = r[key]
+                        stragglers.append(info)
 
         # "Cleared" must mean SCORED HEALTHY (or left the fleet) — not
         # "we lost the ability to score". A flagged worker whose telemetry

@@ -44,10 +44,15 @@ class ObservabilityServer:
 
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  role: str = "", host: str = "127.0.0.1",
-                 health_fn: Optional[Callable[[], Dict]] = None):
+                 health_fn: Optional[Callable[[], Dict]] = None,
+                 flight=None):
         self.registry = registry or default_registry()
         self.role = role
         self.host = host
+        # /debug/flight serves (and dumps) this recorder's bundle; None
+        # falls back to the process singleton at request time — the
+        # recorder may be configured after the server starts
+        self.flight = flight
         # /healthz enrichment: a dict merged into the response (the master
         # wires generation/alive-count/cluster-rollup here). Best-effort
         # like everything else on this surface — a raising callback marks
@@ -84,6 +89,26 @@ class ObservabilityServer:
                 if self.path.split("?")[0] == "/metrics":
                     body = outer.registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.split("?")[0] == "/debug/flight":
+                    # explicit incident trigger: dump the flight ring (the
+                    # atomic file write is best-effort) AND serve the
+                    # bundle back. dump()/bundle() copy the ring under its
+                    # leaf lock and do file I/O outside it, so a dump in
+                    # progress never blocks a concurrent /metrics or
+                    # /healthz scrape (satellite-tested).
+                    from elasticdl_tpu.observability import (
+                        flight as flight_lib,
+                    )
+
+                    rec = outer.flight or flight_lib.get_recorder()
+                    bundle = rec.bundle(reason="http")
+                    bundle["dumped_to"] = rec.dump(
+                        reason="http", bundle=bundle
+                    )
+                    body = (
+                        json.dumps(bundle, default=repr) + "\n"
+                    ).encode()
+                    ctype = "application/json"
                 elif self.path.split("?")[0] == "/healthz":
                     payload = {
                         "status": "ok",

@@ -1,0 +1,440 @@
+"""Cross-role incident correlation: merge flight bundles, traces, the
+journal tail, and cluster-health snapshots into ONE timeline.
+
+    python -m elasticdl_tpu.observability.incident <path> [path ...]
+        [--json] [--strict] [--tail N]
+
+A chaos failure (or a real one) leaves per-role evidence scattered: a
+`flight-<role>-<pid>.json` bundle per process (observability/flight.py),
+per-role `trace.jsonl` files, the master's replayed control-plane journal,
+and `*health.json` rollup snapshots. This module reads all of it from one
+directory (or explicit paths) and renders the incident as a single
+timeline — the crash, the successor's recovery, each worker's reconnect,
+straggler flags — ordered by wall clock, with the trace analyzer's
+critical-path machinery (observability/analyzer.py) reused for any resize
+timelines the records contain.
+
+Tolerance contract (the analyzer's conventions):
+
+- a bundle that fails to parse is a TORN bundle — tolerated and counted
+  (the atomic tmp+replace writer means a torn bundle is itself evidence
+  the writer died mid-incident), never a failure;
+- a bundle that parses but violates the schema (no `records` list, no
+  role) is a WRITER BUG: `--strict` exits 1;
+- unparseable non-tail lines inside *.jsonl inputs are writer bugs too
+  (`--strict` exits 1, via the analyzer's loader);
+- a NAMED path that cannot be read at all is a USAGE error: exit 2.
+
+Timeline entries are deduplicated across sources: a span that is both in
+a worker's ring and in its trace.jsonl appears once.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from elasticdl_tpu.observability import analyzer
+from elasticdl_tpu.observability.flight import BUNDLE_PREFIX
+
+#: event names a postmortem reader always wants called out, whatever else
+#: the ring carries
+KEY_EVENT_NAMES = (
+    "flight.crash", "flight.dump", "master.crash", "master.recovered",
+    "worker.reconnect", "membership.reregister", "membership.death",
+    "cluster.straggler", "cluster.straggler_cleared",
+    "rpc.generation_handshake", "rpc.breaker_open", "rpc.breaker_reset",
+    "reform.announce",
+)
+
+#: default journal-tail length carried into the report
+TAIL_DEFAULT = 40
+
+
+@dataclass
+class LoadedBundles:
+    bundles: List[dict] = field(default_factory=list)
+    files: List[str] = field(default_factory=list)
+    #: paths that failed to parse — the tolerated crash shape
+    torn: List[str] = field(default_factory=list)
+    #: (path, problem) pairs for parsed-but-malformed bundles (--strict)
+    strict_violations: List[Tuple[str, str]] = field(default_factory=list)
+    unreadable: List[str] = field(default_factory=list)
+
+
+def _iter_bundle_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if fn.startswith(BUNDLE_PREFIX) and fn.endswith(".json"):
+                        out.append(os.path.join(dirpath, fn))
+        elif os.path.basename(p).startswith(BUNDLE_PREFIX):
+            out.append(p)
+    return out
+
+
+def load_bundles(paths: Iterable[str]) -> LoadedBundles:
+    loaded = LoadedBundles()
+    for path in _iter_bundle_files(paths):
+        loaded.files.append(path)
+        try:
+            with open(path, encoding="utf-8") as f:
+                data = json.load(f)
+        except OSError:
+            loaded.unreadable.append(path)
+            continue
+        except ValueError:
+            loaded.torn.append(path)
+            continue
+        problem = None
+        if not isinstance(data, dict):
+            problem = "bundle is not a JSON object"
+        elif not isinstance(data.get("records"), list):
+            problem = "bundle has no records list"
+        elif not data.get("role"):
+            problem = "bundle carries no role"
+        if problem is not None:
+            loaded.strict_violations.append((path, problem))
+            # still usable as far as it goes — a partial schema carries
+            # partial evidence
+            if isinstance(data, dict):
+                loaded.bundles.append(data)
+            continue
+        data["_path"] = path
+        loaded.bundles.append(data)
+    return loaded
+
+
+# ---------------------------------------------------------------------- #
+# journal tail
+
+
+def _journal_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if "journal.jsonl" in fn:
+                        out.append(os.path.join(dirpath, fn))
+        elif "journal.jsonl" in os.path.basename(p):
+            out.append(p)
+    return out
+
+
+def _load_journal(paths: Iterable[str], tail: int) -> Optional[dict]:
+    """Replay every journal file found (master/journal.py's replay is
+    jsonl-only and protobuf-free) and keep the parsed tail — generation
+    boundaries and the last transitions before/after the incident."""
+    files = _journal_files(paths)
+    if not files:
+        return None
+    from elasticdl_tpu.master.journal import replay_lines
+
+    out: dict = {"files": files, "generations": [], "records": 0,
+                 "dropped_lines": 0, "tail": []}
+    for path in files:
+        try:
+            with open(path, encoding="utf-8") as f:
+                lines = f.readlines()
+        except OSError:
+            continue
+        result = replay_lines(lines)
+        out["records"] += result.records
+        out["dropped_lines"] += result.dropped_lines
+        out["generations"].append(result.prior_generation)
+        parsed = []
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                parsed.append(json.loads(line))
+            except ValueError:
+                continue
+        out["tail"].extend(
+            {"file": os.path.basename(path), **rec}
+            for rec in parsed[-tail:]
+        )
+        out["world_version"] = max(
+            out.get("world_version", 0), result.world_version
+        )
+    out["generations"] = sorted(set(out["generations"]))
+    return out
+
+
+def _health_snapshots(paths: Iterable[str]) -> List[dict]:
+    out: List[dict] = []
+    for p in paths:
+        candidates: List[str] = []
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames.sort()
+                for fn in sorted(filenames):
+                    if fn.endswith("health.json"):
+                        candidates.append(os.path.join(dirpath, fn))
+        elif p.endswith("health.json"):
+            candidates.append(p)
+        for path in candidates:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(data, dict):
+                data["_path"] = os.path.basename(path)
+                out.append(data)
+    return out
+
+
+# ---------------------------------------------------------------------- #
+# timeline assembly
+
+
+def _entry_key(rec: dict) -> Tuple:
+    """Dedup key across sources (ring + trace.jsonl carry the same
+    records): identity is what/when/who, not which file it came from."""
+    return (
+        str(rec.get("kind", "")), str(rec.get("name", "")),
+        round(float(rec.get("ts", 0.0)), 6), str(rec.get("role", "")),
+        str(rec.get("span_id", "")),
+    )
+
+
+def _timeline_entry(rec: dict, source: str) -> Optional[dict]:
+    ts = rec.get("ts")
+    if not isinstance(ts, (int, float)):
+        return None
+    entry = {
+        "ts": float(ts),
+        "kind": str(rec.get("kind", "")),
+        "name": str(rec.get("name", "")),
+        "role": str(rec.get("role", "")),
+        "source": source,
+    }
+    for k in ("dur_ms", "reason", "error", "level", "msg", "worker_id",
+              "generation", "trace_id", "score", "world_version"):
+        if k in rec and rec[k] is not None:
+            entry[k] = rec[k]
+    return entry
+
+
+def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
+    """The incident report: bundles + traces + journal + health, merged."""
+    paths = list(paths)
+    bundles = load_bundles(paths)
+    traces = analyzer.load_traces(paths)
+
+    seen: Dict[Tuple, dict] = {}
+    span_records: List[dict] = []
+
+    def add(rec: dict, source: str) -> None:
+        entry = _timeline_entry(rec, source)
+        if entry is None:
+            return
+        key = _entry_key(rec)
+        if key not in seen:
+            seen[key] = entry
+        if rec.get("kind") in ("span", "event") and rec.get("trace_id"):
+            span_records.append(rec)
+
+    for b in bundles.bundles:
+        role = str(b.get("role", "?"))
+        # the dump itself is a timeline fact: when the black box was cut
+        add({
+            "kind": "dump", "name": "flight.dump", "ts": b.get("ts"),
+            "role": role, "reason": b.get("reason"),
+            "world_version": b.get("world_version"),
+        }, source="bundle")
+        for rec in b.get("records") or []:
+            if isinstance(rec, dict):
+                rec = dict(rec)
+                rec.setdefault("role", role)
+                add(rec, source="bundle")
+    for rec in traces.records:
+        add(rec, source="trace")
+
+    timeline = sorted(seen.values(), key=lambda e: (e["ts"], e["name"]))
+    key_events = [
+        e for e in timeline
+        if e["name"] in KEY_EVENT_NAMES or e["kind"] in ("dump", "log")
+    ]
+
+    # resize/critical-path analysis over every span that carries a trace
+    # id, pooled across bundles AND trace files (the analyzer dedups
+    # nothing — feed it the deduped pool)
+    pooled = list({_entry_key(r): r for r in span_records}.values())
+    analysis = analyzer.analyze_records(pooled)
+
+    report = {
+        "paths": paths,
+        "bundles": [
+            {
+                "role": b.get("role"), "pid": b.get("pid"),
+                "reason": b.get("reason"), "ts": b.get("ts"),
+                "records": len(b.get("records") or []),
+                "world_version": b.get("world_version"),
+                "dump_seq": b.get("dump_seq"),
+                "file": os.path.basename(b.get("_path", "")),
+            }
+            for b in bundles.bundles
+        ],
+        "bundle_files": bundles.files,
+        "torn_bundles": bundles.torn,
+        "strict_violations": (
+            [{"file": p, "problem": w} for p, w in bundles.strict_violations]
+            + [
+                {"file": p, "line": n, "problem": f"unparseable line: {t}"}
+                for p, n, t in traces.strict_violations
+            ]
+        ),
+        "unreadable_files": (
+            list(bundles.unreadable) + list(traces.unreadable_files)
+        ),
+        "roles": sorted({
+            str(b.get("role")) for b in bundles.bundles if b.get("role")
+        } | {e["role"] for e in timeline if e["role"]}),
+        "timeline": timeline,
+        "key_events": key_events,
+        "traces": analysis,
+        "journal": _load_journal(paths, tail),
+        "health": _health_snapshots(paths),
+    }
+    return report
+
+
+# ---------------------------------------------------------------------- #
+# rendering
+
+
+def render_text(report: dict, max_entries: int = 200) -> str:
+    lines: List[str] = []
+    bundles = report["bundles"]
+    lines.append(
+        f"incident: {len(bundles)} flight bundle(s) "
+        f"[{', '.join(report['roles'])}]"
+        + (f", {len(report['torn_bundles'])} torn" if report["torn_bundles"]
+           else "")
+    )
+    for b in bundles:
+        lines.append(
+            f"  bundle {b['file'] or '?'}  role={b['role']} pid={b['pid']} "
+            f"reason={b['reason']} records={b['records']} "
+            f"world_v={b.get('world_version')}"
+        )
+    journal = report.get("journal")
+    if journal:
+        lines.append(
+            f"journal: {journal['records']} record(s) across "
+            f"generation(s) {journal['generations']}, "
+            f"{journal['dropped_lines']} dropped line(s), "
+            f"tail of {len(journal['tail'])} kept"
+        )
+    for snap in report.get("health") or ():
+        lines.append(
+            f"health {snap.get('_path', '?')}: "
+            f"{snap.get('workers_reporting', 0)} reporting, "
+            f"{snap.get('straggler_count', 0)} straggler(s), "
+            f"skew {snap.get('skew', 1.0)}"
+        )
+
+    timeline = report["timeline"]
+    shown = timeline
+    note = ""
+    if len(timeline) > max_entries:
+        # keep every key event + the most recent tail, in order
+        keep = {id(e) for e in report["key_events"]}
+        keep |= {id(e) for e in timeline[-max_entries:]}
+        shown = [e for e in timeline if id(e) in keep]
+        note = f" (showing {len(shown)} of {len(timeline)})"
+    lines.append(f"timeline{note}:")
+    t0 = timeline[0]["ts"] if timeline else 0.0
+    for e in shown:
+        extra = ""
+        for k in ("reason", "error", "msg", "worker_id", "generation"):
+            if k in e:
+                extra += f" {k}={e[k]}"
+        dur = f" {e['dur_ms']:.1f}ms" if "dur_ms" in e else ""
+        lines.append(
+            f"  +{e['ts'] - t0:9.3f}s  [{e['role'] or '?':<12s}] "
+            f"{e['kind']:<5s} {e['name']}{dur}{extra}"
+        )
+    resize = report["traces"].get("resize_traces", 0)
+    if resize:
+        lines.append(f"{resize} resize timeline(s) — critical paths:")
+        for t in report["traces"]["traces"]:
+            tl = t.get("timeline")
+            if not t["is_resize"] or not tl:
+                continue
+            phases = "  ".join(
+                f"{k}={v:.3f}s" for k, v in tl["phases"].items()
+            )
+            lines.append(
+                f"  trace {t['trace_id']}: wall {tl['wall_s']:.3f}s  {phases}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m elasticdl_tpu.observability.incident",
+        description="correlate flight bundles, traces, the journal tail "
+                    "and health snapshots into one incident timeline",
+    )
+    parser.add_argument(
+        "paths", nargs="+",
+        help="directories (walked for flight-*.json / *.jsonl / "
+             "*health.json) and/or explicit files",
+    )
+    parser.add_argument(
+        "--json", action="store_true", dest="as_json",
+        help="emit the full JSON report instead of text",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="exit 1 on writer bugs (malformed-but-parseable bundles, "
+             "unparseable non-tail trace lines); torn bundles — the "
+             "documented crash shape — stay tolerated",
+    )
+    parser.add_argument(
+        "--tail", type=int, default=TAIL_DEFAULT,
+        help=f"journal-tail records to keep (default {TAIL_DEFAULT})",
+    )
+    args = parser.parse_args(argv)
+
+    report = correlate(args.paths, tail=args.tail)
+    if args.as_json:
+        print(json.dumps(report, indent=2, sort_keys=True, default=repr))
+    else:
+        print(render_text(report), end="")
+
+    have_inputs = (
+        report["bundle_files"] or report["torn_bundles"]
+        or report["timeline"] or report.get("journal")
+    )
+    if not have_inputs:
+        print("no incident inputs found", file=sys.stderr)
+        return 2
+    if report["unreadable_files"]:
+        for path in report["unreadable_files"]:
+            print(f"unreadable input file: {path}", file=sys.stderr)
+        return 2
+    if args.strict and report["strict_violations"]:
+        for v in report["strict_violations"]:
+            print(
+                f"strict: {v['file']}: {v['problem']}", file=sys.stderr,
+            )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

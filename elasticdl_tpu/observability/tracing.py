@@ -89,6 +89,10 @@ class Tracer:
         self.role = ""
         self._world_version = 0
         self.records: "deque[dict]" = deque(maxlen=BUFFER_RECORDS)
+        # record sinks (the flight recorder's full-fidelity ring rides
+        # here): called per emitted record, under the tracer lock — a sink
+        # must be CHEAP and leaf-locked only, and must never raise at us
+        self._sinks: List = []
 
     # ------------------------------------------------------------------ #
     # configuration
@@ -134,11 +138,32 @@ class Tracer:
     # ------------------------------------------------------------------ #
     # emission
 
+    def add_sink(self, fn) -> None:
+        """Subscribe `fn(record_dict)` to every emitted record (the flight
+        recorder's ring). Runs under the tracer lock: keep it to a leaf-
+        locked append; exceptions are swallowed (emission is best-effort
+        for sinks exactly as for the file)."""
+        with self._lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn) -> None:
+        with self._lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
     def _emit(self, rec: dict) -> None:
         with self._lock:
             rec.setdefault("role", self.role)
             rec.setdefault("world_version", self._world_version)
             self.records.append(rec)
+            for sink in self._sinks:
+                try:
+                    sink(rec)
+                except Exception:
+                    # a broken sink must not cost the span (or the file
+                    # sink below): edl-lint: disable=EDL303
+                    continue
             if self._file is not None:
                 try:
                     self._file.write(json.dumps(rec) + "\n")

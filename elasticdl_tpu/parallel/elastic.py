@@ -107,6 +107,27 @@ class CohortContext:
         )
         return np.ascontiguousarray(out).view(np.int64)
 
+    def allgather_ints(self, values: Sequence[int]) -> np.ndarray:
+        """All -> all: every process contributes a small int64 row, every
+        process receives the (num_processes, len(values)) stack — the
+        follower->leader telemetry channel (worker/cohort.py's member-
+        stats exchange rides this at task boundaries). COLLECTIVE: every
+        process of the world must call it with an equal-length row.
+
+        Same int32-halving discipline as broadcast_ints: with
+        jax_enable_x64 off an int64 array entering the collective would be
+        silently canonicalized to int32, wrapping anything past 2^31."""
+        arr = np.ascontiguousarray(np.asarray(values, np.int64))
+        if jax.process_count() == 1:
+            return arr[None, :]
+        from jax.experimental import multihost_utils
+
+        halves = arr.view(np.int32)            # (2n,), little-endian pairs
+        out = np.asarray(
+            multihost_utils.process_allgather(halves), dtype=np.int32
+        )                                      # (P, 2n)
+        return np.ascontiguousarray(out).view(np.int64)
+
     def barrier(self, name: str) -> None:
         from jax.experimental import multihost_utils
 

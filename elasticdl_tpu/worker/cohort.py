@@ -47,6 +47,8 @@ from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import ExitCode
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.observability import flight as flight_lib
+from elasticdl_tpu.observability import profile as profile_lib
 from elasticdl_tpu.observability.health import (
     STATS_METADATA_KEY,
     WorkerStepStats,
@@ -132,11 +134,17 @@ class CohortWorker:
         # batched leases (--task_lease_batch): leases still to broadcast,
         # drained before the next GetTask poll; cleared on reconnect
         self._lease_queue: "deque" = deque()
-        # leader-only heartbeat telemetry (observability/health.py): the
-        # cohort is ONE logical worker, so its health record is the
-        # leader's view of the collective step cadence
+        # heartbeat telemetry (observability/health.py): every process —
+        # leader AND followers — keeps its own step-stats window now
+        # (followers force their local view of each collective dispatch),
+        # exchanged to the leader over the cohort's collective channel
+        # (allgather_ints) so MemberBeats carry REAL follower step times
         self._step_stats = WorkerStepStats()
         self._phase = "boot"          # boot -> train/idle (leader payload)
+        # leader: latest follower-local stats rows by process index
+        # (written by the task loop at the post-task exchange, read by the
+        # heartbeat thread — whole-dict swaps only, so no lock needed)
+        self._member_stats: Dict[int, Dict[str, Any]] = {}
 
     # ------------------------------------------------------------------ #
     # setup (identical on every process)
@@ -366,33 +374,116 @@ class CohortWorker:
             num_processes=self.ctx.num_processes,
             world_version=tracing.get_tracer().world_version,
         )
+        # per-step phase breakdown + memory watermarks (the leader's own;
+        # follower profiles ride their MemberBeats via the exchange)
+        stats.update(profile_lib.get_profiler().snapshot())
         return stats
 
     def _member_beats(self) -> List[pb.MemberBeat]:
         """Coalesced per-member beats riding the leader's ONE heartbeat
-        (cohort-aggregated membership): each member process's entry
-        carries the cohort's collective step cadence — the train step IS
-        a lockstep collective, so the leader's dispatch clock is the
-        honest per-process cadence — plus its process index. What this
-        buys today is fleet-scale telemetry at O(cohorts) RPC cost;
-        follower-LOCAL signals (per-host input-pipeline timing) need a
-        follower->leader channel and stay future work."""
+        (cohort-aggregated membership). Each member entry carries that
+        FOLLOWER's OWN step telemetry when the post-task collective
+        exchange (`_exchange_member_stats`, over the cohort's existing
+        broadcast/allgather channel) has delivered a row — real follower
+        step times, per-host data-wait/h2d/compute attribution included —
+        and falls back to the leader's collective cadence for a follower
+        no exchange has covered yet (a just-reformed world). Fleet-scale
+        telemetry still costs O(cohorts) RPCs; only the in-cohort channel
+        moved, and it rides collectives the task boundary already pays."""
         if not self._member_ids:
             return []
         base = self._step_stats.snapshot()
+        member_stats = self._member_stats   # whole-dict snapshot (atomic)
         beats = []
         for idx, mid in enumerate(self._member_ids, start=1):
-            stats = dict(base)
-            stats.update(
-                phase=self._phase, process_index=idx,
-                source="leader-coalesced",
-            )
+            row = member_stats.get(idx)
+            if row is not None:
+                stats = dict(row)
+                stats["source"] = "follower-local"
+            else:
+                stats = dict(base)
+                stats["source"] = "leader-coalesced"
+            stats.update(phase=self._phase, process_index=idx)
             beats.append(pb.MemberBeat(
                 worker_id=mid,
                 model_version=self._model_version,
                 stats_json=encode_stats(stats),
             ))
         return beats
+
+    #: fields of the fixed-width int64 exchange row, in wire order (times
+    #: in microseconds, rates in milli-units — integers survive the int64
+    #: channel exactly; floats would need a bit-pattern dance)
+    _EXCHANGE_FIELDS = (
+        "steps", "step_p50_us", "step_p90_us", "step_max_us",
+        "records_per_s_milli", "phase_data_wait_us", "phase_h2d_us",
+        "phase_compute_us",
+    )
+
+    def _exchange_row(self) -> List[int]:
+        """This process's stats as the fixed-width integer row."""
+        snap = self._step_stats.snapshot()
+        prof = profile_lib.get_profiler().snapshot(update_memory=False)
+        return [
+            int(snap.get("steps", 0)),
+            int(1e3 * snap.get("step_p50_ms", 0.0)),
+            int(1e3 * snap.get("step_p90_ms", 0.0)),
+            int(1e3 * snap.get("step_max_ms", 0.0)),
+            int(1e3 * snap.get("records_per_s", 0.0)),
+            int(1e3 * prof.get("phase_data_wait_ms", 0.0)),
+            int(1e3 * prof.get("phase_h2d_ms", 0.0)),
+            int(1e3 * prof.get("phase_compute_ms", 0.0)),
+        ]
+
+    @classmethod
+    def _decode_exchange_row(cls, row) -> Dict[str, Any]:
+        """Back to the heartbeat-payload schema (ms / records-per-s)."""
+        vals = dict(zip(cls._EXCHANGE_FIELDS, (int(v) for v in row)))
+        out: Dict[str, Any] = {"steps": vals["steps"]}
+        if vals["steps"]:
+            out.update(
+                step_p50_ms=round(vals["step_p50_us"] / 1e3, 3),
+                step_p90_ms=round(vals["step_p90_us"] / 1e3, 3),
+                step_max_ms=round(vals["step_max_us"] / 1e3, 3),
+                records_per_s=round(vals["records_per_s_milli"] / 1e3, 3),
+            )
+        for us_key, ms_key in (
+            ("phase_data_wait_us", "phase_data_wait_ms"),
+            ("phase_h2d_us", "phase_h2d_ms"),
+            ("phase_compute_us", "phase_compute_ms"),
+        ):
+            if vals[us_key]:
+                out[ms_key] = round(vals[us_key] / 1e3, 3)
+        return out
+
+    def _exchange_member_stats(self) -> None:
+        """COLLECTIVE: every process contributes its local stats row via
+        the cohort's allgather channel (parallel/elastic.py — the same
+        int32-halved int64 wire the control broadcast rides); the leader
+        keeps the follower rows for the next heartbeat's MemberBeats.
+
+        Called at the end of every TRAINING task body, a point all
+        processes reach in lockstep (the task_type gate branches
+        identically everywhere — the control vector is shared state).
+        Closes PR 7's "follower->leader channel" future-work note. A
+        failed collective degrades the members to leader-coalesced
+        telemetry, never the task."""
+        if self.ctx.num_processes <= 1:
+            return
+        try:
+            rows = self.ctx.allgather_ints(self._exchange_row())
+        except Exception:
+            logger.warning(
+                "member-stats allgather failed; member beats fall back to "
+                "leader-coalesced", exc_info=True,
+            )
+            return
+        if not self.ctx.is_leader:
+            return
+        fresh: Dict[int, Dict[str, Any]] = {}
+        for idx in range(1, min(len(rows), self.ctx.num_processes)):
+            fresh[idx] = self._decode_exchange_row(rows[idx])
+        self._member_stats = fresh   # atomic swap; heartbeat thread reads
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -726,28 +817,47 @@ class CohortWorker:
             """Run the buffered host batches: one train_many dispatch for a
             full k-group (every process dispatches the identical program —
             collective), single steps for a trailing partial (so only two
-            compiled programs exist, not one per remainder length)."""
+            compiled programs exist, not one per remainder length).
+
+            EVERY process forces its local view of the dispatch (the
+            leader via float(loss), followers via block_until_ready) so
+            follower step times are REAL wall times — the train step is a
+            lockstep collective, so the follower sync completes with the
+            leader's and costs no extra device time; what it buys is each
+            process's own host-side/data-path skew showing up in ITS
+            telemetry (the member-stats exchange ships it to the leader).
+            """
             nonlocal loss_sum, loss_count, step_time_sum
             if not buf:
                 return
+            import jax
             import jax.numpy as jnp
 
+            prof = profile_lib.get_profiler()
             # batch assembly stays OUTSIDE the timed region — step_time_ms
             # has always meant dispatch + device compute, and host-side
-            # stack/H2D would otherwise read as a phantom slowdown
+            # stack/H2D would otherwise read as a phantom slowdown (the
+            # profiler books it under h2d instead)
             if len(buf) == k and k > 1:
-                stacked = make_global_batch_stack(
-                    self._mesh, buf, self._spec.batch_partition
-                )
+                with prof.phase("h2d"):
+                    stacked = make_global_batch_stack(
+                        self._mesh, buf, self._spec.batch_partition
+                    )
                 t0 = time.perf_counter()
                 self._state, m = self._trainer.train_many(self._state, stacked)
                 if self.ctx.is_leader:
                     loss_sum += float(jnp.sum(m["loss"]))
+                else:
+                    # follower-local completion barrier (see docstring):
+                    # edl-lint: disable=EDL201
+                    jax.block_until_ready(m["loss"])
             else:
-                globals_ = [
-                    make_global_batch(self._mesh, b, self._spec.batch_partition)
-                    for b in buf
-                ]
+                with prof.phase("h2d"):
+                    globals_ = [
+                        make_global_batch(
+                            self._mesh, b, self._spec.batch_partition)
+                        for b in buf
+                    ]
                 t0 = time.perf_counter()
                 for gb in globals_:
                     self._state, logs = self._trainer.train_step(
@@ -757,17 +867,24 @@ class CohortWorker:
                         # step_time is honest (see comment below):
                         # edl-lint: disable=EDL201
                         loss_sum += float(logs["loss"])
+                    else:
+                        # follower twin of the leader's float():
+                        # edl-lint: disable=EDL201
+                        jax.block_until_ready(logs["loss"])
+            # wall time covers dispatch + device compute on THIS process
+            # (every process forced its own view above)
+            group_s = time.perf_counter() - t0
             if self.ctx.is_leader:
-                # the leader's float() forced the collective dispatch(es):
-                # wall time covers dispatch + device compute cohort-wide
-                group_s = time.perf_counter() - t0
                 step_time_sum += group_s
                 loss_count += len(buf)
-                # per-step telemetry sample for the heartbeat payload (the
-                # whole cohort advances minibatch_size rows per step)
-                self._step_stats.observe_step(
-                    group_s / max(1, len(buf)), self.cfg.minibatch_size
-                )
+            # per-step telemetry sample for the heartbeat payload / the
+            # member-stats exchange (the whole cohort advances
+            # minibatch_size rows per step)
+            self._step_stats.observe_step(
+                group_s / max(1, len(buf)), self.cfg.minibatch_size
+            )
+            prof.add("compute", group_s)
+            prof.step_done(len(buf))
             self._model_version += len(buf)
             buf.clear()
 
@@ -830,7 +947,12 @@ class CohortWorker:
 
         from elasticdl_tpu.data.prefetch import _wire_cast
 
-        for host_batch in svc.batches(shard, start, end):
+        # data-wait attribution: blocking on the reader/parse pipeline is
+        # this process's OWN input path (exactly what the follower-local
+        # exchange exists to surface)
+        for host_batch in profile_lib.timed_iter(
+            svc.batches(shard, start, end), profile_lib.get_profiler()
+        ):
             # same bf16 wire compression the single-process worker applies
             # (mask exempted by _wire_cast; cohort reports count by span,
             # not mask, so accounting is unaffected either way)
@@ -881,6 +1003,13 @@ class CohortWorker:
         flush_training_group()   # trailing partial group (single steps)
         metric_states = flush_eval_group(metric_states)  # trailing partial
         flush_predict_group()                            # trailing partial
+
+        if task_type == pb.TRAINING:
+            # COLLECTIVE member-stats exchange at the task boundary (every
+            # process reaches this point in lockstep; the task_type gate
+            # branches identically everywhere): followers' real step times
+            # land on the leader for the next heartbeat's MemberBeats
+            self._exchange_member_stats()
 
         if flags & FLAG_CHECKPOINT:
             mngr = self._checkpoint_manager()
@@ -969,6 +1098,10 @@ class CohortWorker:
         tracing.configure_from_config(
             self.cfg, role=role, world_version=self.ctx.world_version
         )
+        # flight recorder: every cohort process gets its own black box
+        # (crash/SIGUSR2//debug/flight triggers; flight.py trigger matrix)
+        flight_lib.configure_from_config(self.cfg, role=role)
+        flight_lib.install_crash_hooks()
         reform_tid = membership_signal.trace_id()
         # a set EDL_METRICS_PORT overrides cfg.metrics_port either way
         metrics_server = start_server(
@@ -987,6 +1120,9 @@ class CohortWorker:
                 self.ctx.coordinator_addr, self.ctx.process_id,
                 self.ctx.num_processes,
             )
+            # a formation failure's last seconds (coordinator address,
+            # port race, peer set) are postmortem gold — cut the box
+            flight_lib.get_recorder().dump("world_form_failed")
             if metrics_server is not None:
                 metrics_server.stop()
             return ExitCode.WORLD_FORM_FAILED

@@ -24,6 +24,8 @@ from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.common.constants import WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
+from elasticdl_tpu.observability import flight as flight_lib
+from elasticdl_tpu.observability import profile as profile_lib
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.health import (
     STATS_METADATA_KEY,
@@ -146,6 +148,13 @@ class Worker:
         tracing.configure_from_config(
             self.cfg, role=f"worker-{self.worker_id}"
         )
+        # flight recorder: the black box dumps on crash/SIGUSR2/endpoint
+        # (observability/flight.py trigger matrix); armed as soon as the
+        # role is known so even boot failures leave a bundle
+        flight_lib.configure_from_config(
+            self.cfg, role=f"worker-{self.worker_id}"
+        )
+        flight_lib.install_crash_hooks()
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
@@ -418,6 +427,9 @@ class Worker:
             prefetch_depth=depth,
             world_version=tracing.get_tracer().world_version,
         )
+        # step-profiler phase breakdown + memory watermarks (bounded key
+        # set): the master's ClusterHealth sees WHY a straggler is slow
+        stats.update(profile_lib.get_profiler().snapshot())
         return stats
 
     def _heartbeat_loop(self) -> None:
@@ -654,6 +666,11 @@ class Worker:
         step_time_sum = 0.0
         interrupted = False
         self._mid_training_task = True
+        # always-on step profiler (observability/profile.py): the
+        # prefetcher attributes data_wait/h2d internally; this loop
+        # attributes compute (the timed step region) and handoff (the
+        # mid-task rescale) and closes each step's phase record
+        prof = profile_lib.get_profiler()
         prefetcher = self._prefetched(
             svc.batches(task.shard_name, task.start, task.end))
         while True:
@@ -668,15 +685,16 @@ class Worker:
                 # on whatever mesh the worker ends up holding.
                 import itertools
 
-                leftover = prefetcher.drain()
-                source = prefetcher.source
-                try:
-                    self._rescale_in_place(reset_services=False)
-                except Exception:
-                    logger.exception(
-                        "mid-task in-place rescale failed; mesh kept")
-                prefetcher = self._prefetched(
-                    itertools.chain(iter(leftover), source))
+                with prof.phase("handoff"):
+                    leftover = prefetcher.drain()
+                    source = prefetcher.source
+                    try:
+                        self._rescale_in_place(reset_services=False)
+                    except Exception:
+                        logger.exception(
+                            "mid-task in-place rescale failed; mesh kept")
+                    prefetcher = self._prefetched(
+                        itertools.chain(iter(leftover), source))
             try:
                 batch = next(prefetcher)
             except StopIteration:
@@ -703,6 +721,10 @@ class Worker:
             step_s = time.perf_counter() - t0
             step_time_sum += step_s
             _TRAIN_STEP_S.observe(step_s)
+            # the already-measured region IS the compute phase — no second
+            # timer on the hot path
+            prof.add("compute", step_s)
+            prof.step_done()
             loss_count += 1
             self._global_step += 1
             self._model_version += 1
@@ -737,6 +759,12 @@ class Worker:
         buf = []
         if k == 1:
             stream = self._prefetched(stream)
+        else:
+            # grouped mode consumes host batches directly (no prefetcher
+            # to self-time): attribute each pull to data_wait here
+            stream = profile_lib.timed_iter(
+                stream, profile_lib.get_profiler()
+            )
         for batch in stream:
             if self._shutdown.is_set():
                 interrupted.append(True)
@@ -794,6 +822,10 @@ class Worker:
             group_s = time.perf_counter() - t0
             stats["step_time_sum"] += group_s
             _TRAIN_STEP_S.observe(group_s / max(1, len(buf)))
+            # one profile record per group, normalized per step inside
+            # step_done (grouped and single-step workers stay comparable)
+            profile_lib.get_profiler().add("compute", group_s)
+            profile_lib.get_profiler().step_done(len(buf))
             stats["loss_count"] += len(buf)
             self._global_step += len(buf)
             self._model_version += len(buf)
@@ -1061,7 +1093,8 @@ class Worker:
                 # handoff + executable-cache reuse, no teardown (the
                 # pending target is consumed either way — no retry loop)
                 try:
-                    self._rescale_in_place()
+                    with profile_lib.get_profiler().phase("handoff"):
+                        self._rescale_in_place()
                 except Exception:
                     logger.exception("in-place rescale failed; mesh kept")
             if task.type == pb.WAIT:
@@ -1136,6 +1169,9 @@ class Worker:
                 self._maybe_checkpoint(force=True)
             except Exception:
                 logger.exception("preemption checkpoint failed")
+            # the last seconds before a preemption exit are exactly what a
+            # postmortem wants: cut the black box here (explicit trigger)
+            flight_lib.get_recorder().dump("preempt")
 
         # Export runs here, not in the GetTask branch: a worker may learn the
         # job finished from the heartbeat shutdown flag (another worker took

@@ -1068,7 +1068,7 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
                 "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
-                "EDL401", "EDL402", "EDL403"):
+                "EDL401", "EDL402", "EDL403", "EDL404"):
         assert rid in out
 
 
@@ -1081,3 +1081,76 @@ def test_generated_proto_is_excluded():
     files = [rel for _, rel in iter_python_files([pkg])]
     assert not any(rel.endswith("elasticdl_tpu_pb2.py") for rel in files)
     assert any(rel.endswith("master/task_dispatcher.py") for rel in files)
+
+
+# ------------------------------------------------------------------ #
+# EDL404 span-sink-in-hot-loop
+
+
+EDL404_BAD = """
+    from elasticdl_tpu.observability import tracing
+
+    class Workerish:
+        def run_task(self, batches):
+            for batch in batches:
+                tracing.event("step.done", n=1)
+                self._state, logs = self._trainer.train_step(
+                    self._state, batch)
+
+        def run_grouped(self, groups):
+            while True:
+                with tracing.span("step"):
+                    self._state, m = self._trainer.train_many(
+                        self._state, next(groups))
+"""
+
+EDL404_GOOD = """
+    from elasticdl_tpu.observability import profile as profile_lib
+    from elasticdl_tpu.observability import tracing
+
+    class Workerish:
+        def run_task(self, batches):
+            prof = profile_lib.get_profiler()
+            with tracing.span("task"):          # task granularity: fine
+                for batch in batches:
+                    self._state, logs = self._trainer.train_step(
+                        self._state, batch)
+                    # per-step telemetry through the profiler, not spans
+                    prof.add("compute", 0.0)
+                    prof.step_done()
+            tracing.event("task.done")
+
+        def not_a_hot_loop(self, items):
+            for item in items:                  # no step dispatch here
+                tracing.event("control.tick", item=item)
+"""
+
+
+def test_span_sink_in_hot_loop_fires_on_per_step_emission():
+    fs = findings_for(EDL404_BAD, select={"EDL404"})
+    assert rule_ids(fs) == ["EDL404"]
+    assert len(fs) == 2
+    assert sorted(f.context for f in fs) == [
+        "Workerish.run_grouped", "Workerish.run_task",
+    ]
+    assert all("per-step hot loop" in f.message for f in fs)
+    assert all("flight ring" in f.message for f in fs)
+
+
+def test_span_sink_in_hot_loop_quiet_on_task_granularity():
+    assert findings_for(EDL404_GOOD, select={"EDL404"}) == []
+
+
+def test_span_sink_suppressible_inline():
+    src = """
+        from elasticdl_tpu.observability import tracing
+
+        class W:
+            def run(self, batches):
+                for batch in batches:
+                    self._state, _ = self._trainer.train_step(
+                        self._state, batch)
+                    # reviewed: once-per-task in practice
+                    tracing.event("x")  # edl-lint: disable=EDL404
+    """
+    assert findings_for(src, select={"EDL404"}) == []

@@ -144,3 +144,22 @@ def test_control_plane_leg_smoke(bench, monkeypatch):
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)
+
+
+def test_obs_overhead_leg_smoke(bench, mesh8, monkeypatch):
+    """The recorder+profiler overhead gate (ISSUE 9): the leg must run the
+    off/on/off protocol and report both medians plus the overhead ratio.
+    The <= 2% acceptance number belongs to the real bench run — a
+    throttled CI box can't hold a tight percentile — so the smoke pins
+    the RECORD SHAPE and sanity (positive medians, finite overhead, the
+    instrumented ring actually recorded)."""
+    monkeypatch.setenv("EDL_BENCH_OBS_STEPS", "12")
+    res = bench.bench_observability_overhead(mesh8, np)
+    assert res["steps_per_mode"] == 12
+    assert res["median_step_s_off"] > 0
+    assert res["median_step_s_on"] > 0
+    assert isinstance(res["overhead_pct"], float)
+    # the ON run cannot be an order of magnitude off the OFF run — that
+    # would mean the instrumentation path broke, not drifted
+    assert res["median_step_s_on"] < 10 * res["median_step_s_off"]
+    assert "2%" in res["gate"]

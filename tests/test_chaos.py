@@ -81,12 +81,21 @@ def run_control_plane_scenario(seed: int):
     from elasticdl_tpu.observability.registry import default_registry
 
     art_dir = os.environ.get("EDL_CHAOS_ARTIFACT_DIR")
+    flight_rec = None
     if art_dir:
         os.makedirs(art_dir, exist_ok=True)
         tracing.configure(
             path=os.path.join(art_dir, f"chaos-smoke-seed{seed}.trace.jsonl"),
             role="chaos-smoke",
         )
+        # the smoke's flight recorder (ISSUE 9): subscribes to the tracer
+        # so the run's spans/events fill its ring; dumped at scenario end
+        # and correlated by CI's incident-CLI --strict pass
+        from elasticdl_tpu.observability.flight import FlightRecorder
+
+        flight_rec = FlightRecorder(role=f"chaos-smoke-seed{seed}")
+        flight_rec.configure(dir=art_dir, seed=seed)
+        flight_rec.attach_tracing()
     faults.install(SMOKE_SPEC, seed=seed)
     dispatcher = TaskDispatcher(
         training_shards=SHARDS, records_per_task=40, shuffle=True,
@@ -175,6 +184,8 @@ def run_control_plane_scenario(seed: int):
         faults.uninstall()
         if art_dir:
             tracing.get_tracer().close()
+            flight_rec.dump("chaos_smoke")
+            flight_rec.detach_tracing()
             with open(
                 os.path.join(art_dir, f"chaos-smoke-seed{seed}.metrics.prom"),
                 "w",
@@ -350,6 +361,13 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
     once. `crash_at=0` runs the uncrashed baseline the accounting is
     compared against.
 
+    Incident evidence (ISSUE 9): master and worker each run a flight
+    recorder (observability/flight.py); the crash cuts the master's
+    black box, the scenario end cuts the worker's (whose ring carries
+    the reconnect), and both bundles land under <flight_dir> — the
+    artifact dir in CI, <ckpt_dir>/flight otherwise — where the incident
+    CLI correlates them into one timeline.
+
     With EDL_CHAOS_ARTIFACT_DIR set (CI), the replayed journal and the
     recovery trace/metrics land there for workflow-artifact upload.
     """
@@ -357,6 +375,7 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
 
     from elasticdl_tpu.master.journal import ControlPlaneJournal
     from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.flight import FlightRecorder
     from elasticdl_tpu.observability.registry import default_registry
     from elasticdl_tpu.proto.service import REREGISTER_KEY, is_stale_generation
 
@@ -368,6 +387,15 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
             path=os.path.join(art_dir, f"{stem}.trace.jsonl"),
             role="chaos-master-kill",
         )
+    flight_dir = art_dir or os.path.join(ckpt_dir, "flight")
+    # both roles live in this process, so each gets its OWN recorder (the
+    # singleton is per-process); the master's subscribes to the tracer so
+    # control-plane events land in its ring at full fidelity
+    master_flight = FlightRecorder(role="master").configure(
+        dir=flight_dir, tag=stem, scenario=stem)
+    master_flight.attach_tracing()
+    worker_flight = FlightRecorder(role="worker-0").configure(
+        dir=flight_dir, tag=stem, scenario=stem)
     spec = f"master_crash:drop@at={crash_at}" if crash_at else ""
     faults.install(spec, seed=seed)
 
@@ -420,13 +448,20 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
         # the reconnect handshake, exactly as worker.py runs it: clear the
         # stale claim, re-register under the existing id with the marker
         stub.generation = None
-        return stub.RegisterWorker(
+        new_wid = stub.RegisterWorker(
             pb.RegisterWorkerRequest(
                 worker_name="chaos-master-kill",
                 preferred_id_plus_one=wid + 1,
             ),
             metadata=((REREGISTER_KEY, "1"),),
         ).worker_id
+        # what worker.py's _reregister records via tracing.event — this
+        # single-threaded twin records it straight into its ring
+        worker_flight.record(
+            "event", "worker.reconnect", worker_id=new_wid,
+            generation=stub.generation,
+        )
+        return new_wid
 
     try:
         wid = stub.RegisterWorker(
@@ -463,8 +498,18 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
                 # DROP, exactly as SIGKILL would drop them
                 server.stop(None).wait(5)
                 journal.abort()
+                # the black box survives the kill (Master.crash does the
+                # same dump for in-process masters)
+                master_flight.record(
+                    "event", "master.crash", generation=journal.generation,
+                )
+                master_flight.dump("master_crash")
                 journal, dispatcher, membership, servicer, server, port = (
                     boot(port)
+                )
+                master_flight.record(
+                    "event", "master.recovered",
+                    generation=journal.generation,
                 )
                 restarts += 1
             try:
@@ -492,6 +537,14 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
         server.stop(None)
         journal.close()
         faults.uninstall()
+        # the worker's black box is cut by an explicit end-of-scenario
+        # trigger (its ring carries the reconnect handshake(s)); the
+        # master dumped at crash time — for the uncrashed baseline, dump
+        # it here too so every run leaves a master bundle
+        worker_flight.dump("scenario_end")
+        if master_flight.last_dump_path is None:
+            master_flight.dump("scenario_end")
+        master_flight.detach_tracing()
         if art_dir:
             tracing.get_tracer().close()
             shutil.copyfile(
@@ -503,6 +556,7 @@ def run_master_restart_scenario(seed: int, ckpt_dir: str, crash_at: int,
             ) as f:
                 f.write(default_registry().render_prometheus())
     return {
+        "flight_dir": flight_dir,
         "applied": applied,
         "counts": counts,
         "trace": trace,
@@ -594,6 +648,57 @@ def test_kill_master_smoke_group_commit_mode_identical(tmp_path):
                     marks[i] += 1
         bad = [i for i, m in enumerate(marks) if m != 1]
         assert not bad, (shard, bad[:10])
+
+
+@pytest.mark.chaos
+def test_kill_master_produces_incident_bundles(tmp_path, capsys):
+    """ISSUE 9 acceptance: a kill-master chaos run leaves flight bundles
+    from the master AND >= 1 worker, and the incident CLI merges them
+    into ONE timeline that places the crash and the reconnect on it (in
+    that order), exiting 0 under --strict."""
+    import glob
+
+    from elasticdl_tpu.observability import incident
+
+    run = run_master_restart_scenario(
+        seed=77, ckpt_dir=str(tmp_path / "ckpt"), crash_at=5, tag="flight",
+    )
+    assert run["restarts"] == 1 and run["reconnects"] >= 1
+
+    flight_dir = run["flight_dir"]
+    bundles = sorted(glob.glob(os.path.join(flight_dir, "flight-*.json")))
+    roles = set()
+    for path in bundles:
+        with open(path) as f:
+            roles.add(json.load(f)["role"])
+    assert "master" in roles, bundles
+    assert any(r.startswith("worker") for r in roles), bundles
+
+    report = incident.correlate([flight_dir])
+    # the tracer stamps its own role on sunk records (e.g. the CI
+    # artifact run's "chaos-master-kill"), so containment, not equality
+    assert {"master", "worker-0"} <= set(report["roles"])
+    names = [e["name"] for e in report["timeline"]]
+    assert "master.crash" in names and "worker.reconnect" in names
+    # the merged ordering is the story: the crash comes first, the
+    # reconnect follows it on the same timeline
+    assert names.index("master.crash") < names.index("worker.reconnect")
+    # the master's crash-time bundle is ON the timeline too (its dump)
+    crash_dumps = [
+        e for e in report["timeline"]
+        if e["kind"] == "dump" and e.get("reason") == "master_crash"
+    ]
+    assert crash_dumps and crash_dumps[0]["role"] == "master"
+
+    # CLI contract: text render names both, --strict exits 0 over the
+    # atomically-written bundles, --json round-trips
+    rc = incident.main([flight_dir, "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "master.crash" in out and "worker.reconnect" in out
+    rc = incident.main([flight_dir, "--json"])
+    json.loads(capsys.readouterr().out)
+    assert rc == 0
 
 
 @pytest.mark.chaos

@@ -1,0 +1,113 @@
+"""Incident correlator + CLI (observability/incident.py) over checked-in
+golden fixtures (tests/fixtures/incident/): multi-role bundle merge with
+cross-source dedup, the journal tail, torn-bundle tolerance, and the
+--strict / usage exit-code conventions shared with the trace analyzer."""
+
+import json
+import os
+
+import pytest
+
+from elasticdl_tpu.observability import incident
+
+FIXTURES = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "fixtures", "incident"
+)
+CLEAN = os.path.join(FIXTURES, "clean")
+TORN = os.path.join(FIXTURES, "torn")
+BAD = os.path.join(FIXTURES, "badschema")
+
+
+def test_multi_role_merge_and_timeline_order():
+    report = incident.correlate([CLEAN])
+    assert {b["role"] for b in report["bundles"]} == {"master", "worker-0"}
+    assert report["torn_bundles"] == []
+    assert report["strict_violations"] == []
+
+    names = [e["name"] for e in report["timeline"]]
+    # the story reads in order: straggler flag -> crash -> recovery ->
+    # reconnect -> the dumps that preserved it all
+    for earlier, later in (
+        ("cluster.straggler", "master.crash"),
+        ("master.crash", "master.recovered"),
+        ("master.recovered", "worker.reconnect"),
+        ("worker.reconnect", "flight.dump"),
+    ):
+        assert names.index(earlier) < names.index(later), names
+
+    # cross-source dedup: the rescale span exists in BOTH bundles AND the
+    # trace.jsonl, but appears on the timeline exactly once
+    assert names.count("rescale") == 1
+
+    # the log line captured by the ring is on the timeline
+    assert any(
+        e["kind"] == "log" and "CRASHED" in e.get("msg", "")
+        for e in report["timeline"]
+    )
+
+
+def test_journal_tail_and_health_snapshots_join_the_report():
+    report = incident.correlate([CLEAN])
+    journal = report["journal"]
+    assert journal["generations"] == [2]
+    assert journal["records"] == 5
+    assert any(rec.get("t") == "world_version" for rec in journal["tail"])
+    health = report["health"]
+    assert len(health) == 1 and health[0]["straggler_count"] == 1
+
+
+def test_resize_spans_reuse_analyzer_critical_path():
+    report = incident.correlate([CLEAN])
+    traces = report["traces"]["traces"]
+    rescale = [t for t in traces if t["trace_id"] == "aaaa000011112222"]
+    assert rescale and rescale[0]["is_resize"]
+    tl = rescale[0]["timeline"]
+    assert tl["wall_s"] == pytest.approx(3.0)
+    assert tl["phases"].get("compile", 0) == pytest.approx(2.0)
+
+
+def test_render_text_places_crash_and_reconnect():
+    report = incident.correlate([CLEAN])
+    text = incident.render_text(report)
+    assert "master.crash" in text and "worker.reconnect" in text
+    assert text.index("master.crash") < text.index("worker.reconnect")
+    assert "flight bundle(s)" in text and "journal:" in text
+
+
+def test_torn_bundle_tolerated_even_strict(capsys):
+    report = incident.correlate([TORN])
+    assert len(report["torn_bundles"]) == 1
+    assert "flight-worker-1-102.json" in report["torn_bundles"][0]
+    # the whole bundles still merged
+    assert {b["role"] for b in report["bundles"]} == {"master", "worker-0"}
+    rc = incident.main([TORN, "--strict"])
+    capsys.readouterr()
+    assert rc == 0         # torn = the documented crash shape, never red
+
+
+def test_bad_schema_bundle_is_strict_violation(capsys):
+    rc = incident.main([BAD])
+    capsys.readouterr()
+    assert rc == 0         # advisory without --strict
+    rc = incident.main([BAD, "--strict"])
+    err = capsys.readouterr().err
+    assert rc == 1
+    assert "flight-worker-2-103.json" in err
+
+
+def test_no_inputs_and_unreadable_are_usage_errors(tmp_path, capsys):
+    rc = incident.main([str(tmp_path)])
+    assert rc == 2
+    capsys.readouterr()
+    missing = str(tmp_path / "flight-nope-1.json")
+    rc = incident.main([missing])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_json_report_roundtrips(capsys):
+    rc = incident.main([CLEAN, "--json"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    report = json.loads(out)
+    assert report["roles"] and report["timeline"]

@@ -723,3 +723,69 @@ def test_process_manager_clears_stale_signal_at_its_own_path(tmp_path):
     assert data["world_size"] == 2 and data["world_version"] == 3
     assert membership_signal.master_generation(str(sig)) == 2
     j2.close()
+
+
+# ---------------------------------------------------------------------- #
+# flush-on-shutdown (ISSUE 9 satellite: the PR 7 known boundary)
+
+
+def test_flush_forces_open_batch_to_disk_without_closing(tmp_path):
+    """flush() must make a queued-but-unflushed record durable NOW — the
+    clean-shutdown hook for records whose owner never wait()s them —
+    while leaving the journal open for further commits."""
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=8000.0)
+    try:
+        j.append("world_version", version=7)     # rides the 8s window
+        # not yet on disk (the window has barely opened)
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        assert replay_lines(lines).world_version == 0
+        j.flush()
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        assert replay_lines(lines).world_version == 7
+        # the journal stays usable after a flush
+        j.append("world_version", version=8).wait()
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        assert replay_lines(lines).world_version == 8
+    finally:
+        j.close()
+
+
+def test_flush_is_noop_per_commit_and_empty_queue(tmp_path):
+    j = ControlPlaneJournal(str(tmp_path))          # per-commit mode
+    try:
+        j.append("world_version", version=3)
+        j.flush()                                   # no-op, no error
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        assert replay_lines(lines).world_version == 3
+    finally:
+        j.close()
+    g = ControlPlaneJournal(str(tmp_path), group_commit_ms=50.0)
+    try:
+        g.flush()                                   # empty queue: no-op
+        lines = open(g.path, encoding="utf-8").read().splitlines()
+        assert not any(
+            json.loads(line).get("t") == "batch" for line in lines
+        )
+    finally:
+        g.close()
+
+
+def test_process_manager_stop_flushes_newest_world_version(tmp_path):
+    """A clean ProcessManager.stop() must never lose the newest
+    world_version record to the group-commit window (the PR 7 boundary,
+    closed): stop() flushes the journal explicitly."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master.process_manager import ProcessManager
+
+    j = ControlPlaneJournal(str(tmp_path), group_commit_ms=8000.0)
+    cfg = JobConfig(model_def="mnist.mnist_cnn.custom_model",
+                    master_addr="localhost:1")
+    manager = ProcessManager(cfg, journal=j)
+    try:
+        # a record enqueued WITHOUT wait(), still riding the open window
+        j.append("world_version", version=41)
+        manager.stop(grace_s=0.5)
+        lines = open(j.path, encoding="utf-8").read().splitlines()
+        assert replay_lines(lines).world_version == 41
+    finally:
+        j.close()
