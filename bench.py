@@ -1304,6 +1304,379 @@ def bench_control_plane(mesh=None, np=None):
     return out
 
 
+# ---------------------------------------------------------------------- #
+# elastic sharded embedding tier (ISSUE 10; ROADMAP 1): sharded vs
+# single-host serving throughput, deduped push traffic, and a kill-worker
+# resharding run with exactly-once accounting — against a REAL gRPC
+# master owning the journal-durable shard map.
+
+ET_SHARDS = int(os.environ.get("EDL_BENCH_ET_SHARDS", "8"))
+ET_OWNERS = int(os.environ.get("EDL_BENCH_ET_OWNERS", "8"))
+ET_VOCAB = int(os.environ.get("EDL_BENCH_ET_VOCAB", "262144"))
+ET_DIM = int(os.environ.get("EDL_BENCH_ET_DIM", "32"))
+ET_BATCH = int(os.environ.get("EDL_BENCH_ET_BATCH", "4096"))
+ET_LEN = int(os.environ.get("EDL_BENCH_ET_LEN", "16"))
+ET_STEPS = int(os.environ.get("EDL_BENCH_ET_STEPS", "8"))
+ET_ZIPF = float(os.environ.get("EDL_BENCH_ET_ZIPF", "1.3"))
+
+
+def _et_master(tmp, num_shards):
+    """A real master control plane owning the embedding shard map:
+    journal (in `tmp`), membership with the death->reshard callback
+    wired exactly like master/main.py, servicer behind gRPC."""
+    from elasticdl_tpu.embedding.sharding import ShardMapOwner
+    from elasticdl_tpu.master.journal import ControlPlaneJournal
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto.service import add_master_servicer, make_server
+
+    journal = ControlPlaneJournal(tmp)
+    dispatcher = TaskDispatcher(
+        training_shards=[("et", 0, 1)], records_per_task=1,
+        shuffle=False, task_timeout_s=1e9, journal=journal,
+    )
+    membership = Membership(heartbeat_timeout_s=1e9, journal=journal)
+    owner = ShardMapOwner(num_shards, journal=journal)
+
+    def on_death(worker_id):
+        alive = [w.worker_id for w in membership.alive_workers()
+                 if w.led_by is None]
+        if alive and owner.view().owners:
+            owner.begin_resharding(alive, dead=[worker_id])
+
+    membership.add_death_callback(on_death)
+    servicer = MasterServicer(
+        dispatcher, membership, None, generation=journal.generation,
+        embedding=owner,
+    )
+    server = make_server(max_workers=16)
+    add_master_servicer(server, servicer)
+    port = server.add_insecure_port("localhost:0")
+    assert port, "could not bind an ephemeral port for the tier master"
+    server.start()
+    return {"journal": journal, "membership": membership, "owner": owner,
+            "servicer": servicer, "server": server, "port": port,
+            "dispatcher": dispatcher}
+
+
+def _et_full_table(spec, view, transport_):
+    """Assemble the dense (vocab, dim) table from its shards — the
+    bit-exactness oracle (strided layout: shard s owns ids s, s+S, ...)."""
+    import numpy as _np
+
+    out = _np.zeros((spec.vocab, spec.dim), _np.float32)
+    for s in range(view.num_shards):
+        rows = transport_.store_of(view.owners[s]).extract_shard(
+            spec.name, s)["rows"]
+        idx = _np.arange(s, spec.vocab, view.num_shards)
+        out[idx] = rows[: len(idx)]
+    return out
+
+
+def _et_serving_loops(np):
+    """Phase 1+2: single-host tier path (1 shard, no dedupe, per-
+    occurrence push — the reference PS protocol) vs the sharded deduped
+    path (unique pull, in-step inverse gather, per-unique-row push).
+    Pure serving measurement: no master needed, LocalTransport stores in
+    host mode (this box serves from host memory; the device mode's
+    kernel lane is phase 3's and the TPU run's)."""
+    from elasticdl_tpu.embedding import sharding, store, tier, transport
+
+    spec = sharding.TableSpec("users", vocab=ET_VOCAB, dim=ET_DIM, seed=3)
+    r = np.random.RandomState(7)
+    ids = (r.zipf(ET_ZIPF, (ET_BATCH, ET_LEN)) % ET_VOCAB).astype(np.int64)
+    n_ids = ids.size
+
+    def build(num_shards, owners_list, dedupe):
+        owners = sharding.assign_round_robin(num_shards, owners_list)
+        view = sharding.ShardMapView(
+            version=1, num_shards=num_shards, owners=tuple(owners),
+            tables=(spec,),
+        )
+        tr = transport.LocalTransport()
+        for o in owners_list:
+            st = store.EmbeddingShardStore(o, device=False)
+            st.attach(view)
+            tr.register(st)
+        return tier.EmbeddingTierClient(
+            lambda: view, tr, client_id="bench", dedupe=dedupe)
+
+    def timed(fn, steps):
+        pulls, pushes = [], []
+        fn(pulls, pushes)            # warmup (not recorded)
+        pulls.clear(); pushes.clear()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            fn(pulls, pushes)
+        wall = time.perf_counter() - t0
+        return {
+            "rows_per_sec": round(n_ids * steps / wall, 1),
+            "pull_p50_ms": round(_q(sorted(pulls), 0.5) * 1e3, 3),
+            "pull_p99_ms": round(_q(sorted(pulls), 0.99) * 1e3, 3),
+            "push_p50_ms": round(_q(sorted(pushes), 0.5) * 1e3, 3),
+            "push_p99_ms": round(_q(sorted(pushes), 0.99) * 1e3, 3),
+        }
+
+    single = build(1, [0], dedupe=False)
+
+    def single_step(pulls, pushes):
+        t = time.perf_counter()
+        vec = single.pull("users", ids)
+        pulls.append(time.perf_counter() - t)
+        g = vec.reshape(-1, ET_DIM) * 0.1   # per-OCCURRENCE gradients
+        t = time.perf_counter()
+        single.push("users", ids, g, scale=-0.01)
+        pushes.append(time.perf_counter() - t)
+
+    res_single = timed(single_step, ET_STEPS)
+
+    sharded = build(ET_SHARDS, list(range(ET_OWNERS)), dedupe=True)
+    push_stats = {}
+
+    def sharded_step(pulls, pushes):
+        t = time.perf_counter()
+        rows, inverse, uniq = sharded.pull_unique("users", ids)
+        pulls.append(time.perf_counter() - t)
+        g = rows * 0.1                      # per-UNIQUE-row gradients
+        t = time.perf_counter()
+        push_stats.update(sharded.push("users", uniq, g, scale=-0.01))
+        pushes.append(time.perf_counter() - t)
+
+    res_sharded = timed(sharded_step, ET_STEPS)
+    # deduped push traffic: ids actually sent over the RAW batch ids —
+    # pull_unique deduped upstream, so the push's own ids are already
+    # unique and its internal ratio would read a vacuous 1.0
+    res_sharded["dedupe_ratio"] = round(
+        push_stats.get("ids_sent", n_ids) / n_ids, 4)
+    return {
+        "ids_per_batch": n_ids,
+        "unique_ratio": round(len(np.unique(ids)) / n_ids, 4),
+        "zipf_a": ET_ZIPF,
+        "single_host": res_single,
+        "sharded": res_sharded,
+        "sharded_speedup": round(
+            res_sharded["rows_per_sec"] / res_single["rows_per_sec"], 2),
+    }
+
+
+class _LostAckTransport:
+    """LocalTransport wrapper dropping ONE push ack (store applied, the
+    caller never hears) — the deterministic lost-ack the exactly-once
+    fence must absorb."""
+
+    def __init__(self, inner, lose_seq):
+        self._inner = inner
+        self._lose_seq = lose_seq
+        self.lost = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def push(self, owner, table, shard, local_ids, rows, *, client_id,
+             seq, map_version=None, scale=1.0):
+        applied = self._inner.push(
+            owner, table, shard, local_ids, rows, client_id=client_id,
+            seq=seq, map_version=map_version, scale=scale,
+        )
+        if seq == self._lose_seq and not self.lost:
+            self.lost += 1
+            from elasticdl_tpu.embedding.transport import (
+                OwnerUnavailableError,
+            )
+
+            raise OwnerUnavailableError("injected lost ack")
+        return applied
+
+
+def _et_reshard_scenario(np):
+    """Phase 3 (the acceptance scenario): kill an owning worker under a
+    REAL gRPC master; the death callback plans minimal moves (journaled
+    begin), survivors restore the victim's drained shards from the tier
+    checkpoint, confirm over the wire, the master commits (journaled) —
+    and every table shard is required to come back BIT-EXACT against an
+    unkilled control replica fed the identical push sequence (no lost,
+    no double-applied push; one lost ACK is injected on purpose), with
+    recovery riding the compile cache (device-mode stores; zero new
+    compiles during recovery)."""
+    import tempfile
+
+    from elasticdl_tpu.embedding import sharding, store, tier, transport
+    from elasticdl_tpu.master.journal import replay_lines
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import MasterStub, make_channel
+    from elasticdl_tpu.training import compile_cache as cc
+
+    vocab, dim = 65536, 16
+    owners_n = min(4, ET_OWNERS)
+    shards_n = ET_SHARDS
+    r = np.random.RandomState(11)
+    ids = (r.zipf(ET_ZIPF, (1024, 8)) % vocab).astype(np.int64)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        m = _et_master(tmp, shards_n)
+        spec = sharding.TableSpec("users", vocab=vocab, dim=dim, seed=5)
+        m["owner"].register_table(spec)
+        channel = make_channel(f"localhost:{m['port']}")
+        stub = MasterStub(channel)
+        worker_ids = []
+        for i in range(owners_n):
+            resp = stub.RegisterWorker(
+                pb.RegisterWorkerRequest(worker_name=f"et-{i}"))
+            worker_ids.append(resp.worker_id)
+        shared = transport.LocalTransport()
+        runtimes = {}
+        for wid in worker_ids:
+            # device mode: the jitted gather/scatter lane, so "rides the
+            # compile cache" is measurable (host mode has nothing to
+            # compile and would prove warmth vacuously)
+            os.environ["EDL_EMB_TIER_DEVICE"] = "1"
+            try:
+                runtimes[wid] = tier.WorkerTierRuntime(
+                    stub, wid, checkpoint_dir=tmp, transport=shared)
+            finally:
+                os.environ.pop("EDL_EMB_TIER_DEVICE", None)
+        view0 = runtimes[worker_ids[0]].client.view
+
+        # unkilled control replica: same map, same pushes, applied once
+        ctl_tr = transport.LocalTransport()
+        for wid in worker_ids:
+            st = store.EmbeddingShardStore(wid, device=True)
+            st.attach(view0)
+            ctl_tr.register(st)
+        ctl = tier.EmbeddingTierClient(
+            lambda: view0, ctl_tr, client_id="bench-et")
+
+        lossy = _LostAckTransport(shared, lose_seq=3)
+        client = tier.EmbeddingTierClient(
+            tier.stub_map_fetch(stub, worker_ids[0]), lossy,
+            client_id="bench-et",
+        )
+
+        def push_step(c, i):
+            g = np.random.RandomState(100 + i).rand(
+                len(np.unique(ids[ids >= 0])), dim).astype(np.float32)
+            uniq = np.unique(ids)
+            c.push("users", uniq, g, scale=-0.01)
+
+        # steady state: warm every jitted program (pull + push per shard)
+        for i in range(2):
+            client.pull_unique("users", ids)
+            push_step(client, i)
+            push_step(ctl, i)
+        cc_before = cc.global_cache().stats()
+        dup_before = _et_dup_pushes()
+
+        victim = worker_ids[-1]
+        survivors = [w for w in worker_ids if w != victim]
+        t_kill = time.perf_counter()
+        with tracing.span("embedding_tier.kill_worker", victim=victim):
+            runtimes[victim].drain()          # planned kill: SIGTERM drain
+            shared.deregister(victim)
+            m["membership"].mark_dead(victim, reason="bench kill")
+            # survivors react (the worker run loop's task-boundary
+            # refresh): install from the drain checkpoint, confirm
+            for wid in survivors:
+                runtimes[wid].on_world_change()
+            # the plan must be COMMITTED now (all moves confirmed)
+            final_view = m["owner"].view()
+            # post-recovery traffic proves the tier is serving again —
+            # including one injected lost ack, re-sent under the same
+            # seq and absorbed by the store's watermark
+            client.pull_unique("users", ids)
+            push_step(client, 2)              # seq 3: the lost-ack push
+            push_step(ctl, 2)
+            push_step(client, 3)
+            push_step(ctl, 3)
+        t_recover = time.perf_counter() - t_kill
+        cc_after = cc.global_cache().stats()
+        dup_after = _et_dup_pushes()
+
+        main_table = _et_full_table(spec, final_view, shared)
+        ctl_table = _et_full_table(spec, view0, ctl_tr)
+        bit_exact = bool(np.array_equal(main_table, ctl_table))
+
+        # the shard map must also be crash-consistent: replaying the
+        # journal file as a successor master would yields the final map
+        m["journal"].close()
+        with open(os.path.join(tmp, "control", "journal.jsonl")) as f:
+            replayed = replay_lines(f.readlines())
+        emb = replayed.embedding
+        journal_consistent = (
+            emb is not None
+            and list(emb.owners) == list(final_view.owners)
+            and emb.version == final_view.version
+            and not emb.reshard_interrupted
+        )
+        m["server"].stop(None)
+        for rt in runtimes.values():
+            rt.close()
+
+        return {
+            "owners": owners_n, "shards": shards_n,
+            "shards_moved": sum(
+                1 for s in range(shards_n)
+                if view0.owners[s] == victim
+                and final_view.owners[s] != victim
+            ),
+            "recovery_s": round(t_recover, 4),
+            "bit_exact": bit_exact,
+            "duplicate_pushes_absorbed": int(dup_after - dup_before),
+            "lost_acks_injected": lossy.lost,
+            "exactly_once": bool(
+                bit_exact and lossy.lost >= 1
+                and dup_after - dup_before >= 1
+            ),
+            "reshard_compile_misses": int(
+                cc_after["misses"] - cc_before["misses"]),
+            "warm_resharding": cc_after["misses"] == cc_before["misses"],
+            "journal_map_consistent": journal_consistent,
+            "final_map_version": final_view.version,
+        }
+
+
+def _et_dup_pushes() -> float:
+    from elasticdl_tpu.embedding import store as store_lib
+
+    return store_lib._DUP_PUSHES.value()
+
+
+def bench_embedding_tier(mesh=None, np=None):
+    """Elastic sharded embedding tier (ISSUE 10 acceptance): sharded
+    lookup+update rows/s vs the single-host tier path, deduped push
+    traffic (ids sent / ids in batch), pull/push p50/p99, and the
+    kill-worker resharding scenario (bit-exact shards, exactly-once
+    update accounting, compile-cache-warm recovery). `mesh` is ignored —
+    serving runs host-side; phase 3's stores run the jitted device lane
+    on whatever backend is up."""
+    if np is None:
+        import numpy as np
+    from elasticdl_tpu.observability import tracing
+
+    tracing.configure(role="bench-embedding-tier")
+    trace_id = tracing.new_trace_id()
+    with tracing.adopt(trace_id):
+        with tracing.span("embedding_tier", shards=ET_SHARDS):
+            serving = _et_serving_loops(np)
+            reshard = _et_reshard_scenario(np)
+    out = {
+        "shards": ET_SHARDS, "owners": ET_OWNERS, "vocab": ET_VOCAB,
+        "dim": ET_DIM, "steps": ET_STEPS,
+        **serving,
+        "reshard": reshard,
+        "trace_id": trace_id,
+    }
+    art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+    if art_dir:
+        os.makedirs(art_dir, exist_ok=True)
+        with open(os.path.join(art_dir, "bench-embedding-tier-trace.jsonl"),
+                  "w") as f:
+            for rec in tracing.get_tracer().records:
+                f.write(json.dumps(rec) + "\n")
+    return out
+
+
 def bench_host_pipeline(np):
     """Host half of the input path ONLY — disk → contiguous span read →
     binary decode — with no JAX backend touched anywhere (verified: the
@@ -1464,6 +1837,8 @@ def _run_leg(leg, mesh, np):
         return bench_rescale(mesh, np)
     if leg == "control_plane":
         return bench_control_plane(mesh, np)
+    if leg == "embedding_tier":
+        return bench_embedding_tier(mesh, np)
     if leg == "obs_overhead":
         return bench_observability_overhead(mesh, np)
     if leg == "transformer_lm":
@@ -1505,9 +1880,9 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "rescale", "control_plane", "obs_overhead", "embedding",
-    "transformer_lm", "time_to_auc", "mnist_cnn", "census_wide_deep",
-    "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
+    "rescale", "control_plane", "embedding_tier", "obs_overhead",
+    "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
+    "census_wide_deep", "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
@@ -1629,6 +2004,16 @@ def main():
         # line (CI uploads it as an artifact; tier-1 smoke asserts on it)
         mesh = build_mesh({"data": len(jax.devices())})
         print(json.dumps({"rescale": _run_leg("rescale", mesh, np)}))
+        return
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "embedding_tier":
+        # `python bench.py embedding_tier`: the tier scenario alone, one
+        # JSON line (CI uploads it + its trace; tier-1 smoke asserts on
+        # the record shape). Serving runs host-side; the reshard phase
+        # uses device-mode stores on whatever backend is up.
+        print(json.dumps(
+            {"embedding_tier": _run_leg("embedding_tier", None, np)}
+        ))
         return
 
     if len(sys.argv) >= 2 and sys.argv[1] == "obs_overhead":

@@ -41,13 +41,24 @@ EDL205 unkeyed-jit-in-rescale-path
     recovery keys XLA's cache on a new function object and pays the full
     re-trace the cache was built to avoid. Route it through the cache
     (the builder lambda handed to `get_or_build` is exempt).
+
+EDL206 per-row-embedding-rpc-in-hot-loop
+    an embedding-tier `.pull(...)`/`.push(...)` call issued PER ID —
+    lexically inside a nested loop (or comprehension) within a
+    step-dispatch hot loop (EDL201's definition). The tier client
+    dedupes the whole batch and issues ONE batched call per shard; a
+    per-row call re-creates the reference's per-key PS traffic, paying a
+    transport round trip per id instead of per shard. Receivers are
+    matched by name (tier/client/emb/transport/store) so unrelated
+    `.push` methods stay quiet; one batched call directly in the
+    dispatch loop body is the sanctioned shape.
 """
 
 from __future__ import annotations
 
 import ast
 import re
-from typing import Iterator, List, Set
+from typing import Iterator, List, Optional, Set
 
 from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
 
@@ -318,6 +329,93 @@ class UnkeyedJitInRescalePathRule(Rule):
                         "recovery recompiles; route it through "
                         "compile_cache.get_or_build",
                     )
+
+
+#: receiver names that mark a call as embedding-TIER traffic (the rule
+#: must not fire on unrelated `.push` methods — a stack's push, say)
+_TIER_RECEIVER = re.compile(r"tier|client|emb|transport|store", re.IGNORECASE)
+
+
+def _tier_call(node: ast.AST) -> Optional[str]:
+    """'pull'/'push' when `node` is an embedding-tier data-plane call."""
+    if not (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("pull", "push")):
+        return None
+    recv = node.func.value
+    names = []
+    while isinstance(recv, ast.Attribute):
+        names.append(recv.attr)
+        recv = recv.value
+    if isinstance(recv, ast.Name):
+        names.append(recv.id)
+    if any(_TIER_RECEIVER.search(n) for n in names):
+        return node.func.attr
+    return None
+
+
+@register
+class PerRowEmbeddingRpcRule(Rule):
+    id = "EDL206"
+    name = "per-row-embedding-rpc-in-hot-loop"
+    doc = (
+        "embedding-tier pull/push issued per id (nested loop or "
+        "comprehension) inside a step-dispatch hot loop — a transport "
+        "round trip per row; dedupe the batch and issue one call per shard"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        reported: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            called = set()
+            for stmt in body:
+                called |= _called_attr_names(stmt)
+            if not (called & _DISPATCH_METHODS):
+                # shares EDL201's hot-loop definition: only loops that
+                # dispatch device steps are in scope
+                continue
+            if any(
+                isinstance(n, (ast.For, ast.While))
+                and _called_attr_names(n) & _DISPATCH_METHODS
+                for stmt in body for n in ast.walk(stmt)
+            ):
+                # an INNER loop is the real dispatch loop (epoch loop
+                # around a step loop): scan at that depth, or a batched
+                # call in the step loop's own body would read as
+                # "nested" relative to the epoch loop
+                continue
+            for stmt in body:
+                yield from self._scan(ctx, stmt, reported)
+
+    def _scan(
+        self, ctx: ModuleContext, node: ast.AST, reported: Set[int]
+    ) -> Iterator[Finding]:
+        """Flag tier calls nested one loop (or comprehension) deeper than
+        the dispatch loop's own body — the per-id shape. A tier call
+        sitting directly in the dispatch body is the batched idiom."""
+        for sub in ast.walk(node):
+            inner: Iterator[ast.AST] = ()
+            if isinstance(sub, (ast.For, ast.While)):
+                inner = (n for s in (list(sub.body) + list(sub.orelse))
+                         for n in ast.walk(s))
+            elif isinstance(sub, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                  ast.GeneratorExp)):
+                inner = ast.walk(sub)
+            for cand in inner:
+                what = _tier_call(cand)
+                if what is None or id(cand) in reported:
+                    continue
+                reported.add(id(cand))
+                yield self.finding(
+                    ctx, cand,
+                    f"embedding tier .{what}() per id inside the "
+                    "step-dispatch hot loop pays a transport round trip "
+                    "per row; dedupe the batch and issue one batched "
+                    "call per shard (tier.EmbeddingTierClient does this)",
+                )
 
 
 def _is_set_expr(node: ast.AST) -> bool:

@@ -65,6 +65,60 @@ class Embedding(nn.Module):
         return emb_ops.combine(vectors, self.combiner, ids, weights)
 
 
+class TierEmbedding(nn.Module):
+    """Embedding served from the elastic sharded tier
+    (elasticdl_tpu/embedding/) instead of a mesh-sharded HBM param — the
+    routing for tables too large for any single host's memory.
+
+    The tier's pull happens OUTSIDE the jitted step (the table is not a
+    model param at all): the worker's EmbeddingTierSession dedupes the
+    batch's ids, pulls one batched call per owning shard, and feeds the
+    (B, ..., L, D) `vectors` in as a jit INPUT; this layer applies the
+    same combiner/padding semantics as `Embedding`. The gradient w.r.t.
+    `vectors` — which jax gives for free since they are an input — is
+    exactly the sparse per-row gradient the session pushes back (deduped
+    scatter-add on the owning shard, reference parity with
+    elasticdl.layers.Embedding's pull/push contract).
+
+    `Embedding.as_tier_spec()` converts an existing in-HBM Embedding's
+    geometry into the TableSpec the tier registers.
+    """
+
+    output_dim: int
+    combiner: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, vectors: jax.Array, ids: jax.Array,
+                 weights: Optional[jax.Array] = None,
+                 inverse: Optional[jax.Array] = None):
+        ids = jnp.asarray(ids, jnp.int32)
+        if inverse is not None:
+            # deduped-end-to-end shape (EmbeddingTierClient.pull_unique):
+            # `vectors` holds one row per UNIQUE id and `inverse` maps
+            # batch slots onto them — the expansion gather runs here, on
+            # device, and autodiff through it hands the session back
+            # per-unique-row gradients, already duplicate-summed
+            vectors = jnp.take(vectors, inverse, axis=0)
+        return emb_ops.combine(vectors, self.combiner, ids, weights)
+
+
+def tier_table_spec(name: str, input_dim: int, output_dim: int,
+                    seed: int = 0, init_scale: float = 0.05):
+    """The tier TableSpec matching an `Embedding(input_dim, output_dim)`
+    layer's geometry: rows padded by the SAME rule as the in-HBM path
+    (ops/embedding.padded_vocab), so a model can switch between HBM and
+    tier routing without changing its checkpointed geometry story."""
+    from elasticdl_tpu.embedding.sharding import TableSpec
+
+    return TableSpec(
+        name=name,
+        vocab=emb_ops.padded_vocab(input_dim),
+        dim=output_dim,
+        seed=seed,
+        init_scale=init_scale,
+    )
+
+
 class MoE(nn.Module):
     """Switch-style top-1 Mixture-of-Experts FFN with expert parallelism.
 

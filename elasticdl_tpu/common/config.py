@@ -212,6 +212,17 @@ class JobConfig:
     # well under task_timeout_s or tail leases expire while queued.
     task_lease_batch: int = 1
 
+    # --- elastic sharded embedding tier (elasticdl_tpu/embedding/) ---
+    # >0 enables the tier: embedding tables declared by the model are
+    # id-sharded (`shard = id % embedding_shards`) across owning workers,
+    # pulled/pushed per batch (deduped, per-shard batched), with the
+    # shard map owned by the master and journaled (survives master
+    # crash-restart); shards migrate on world change. Size it at 1-4x the
+    # expected worker count — see docs/performance.md "Embedding tier
+    # sizing". 0 = off (tables live in HBM inside the jitted step, the
+    # default single-host path).
+    embedding_shards: int = 0
+
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
     # Multi-slice: per-axis DCN (across-slice) factors, named form only
@@ -287,6 +298,8 @@ class JobConfig:
             )
         if self.task_lease_batch < 1:
             raise ValueError("task_lease_batch must be >= 1")
+        if self.embedding_shards < 0:
+            raise ValueError("embedding_shards must be >= 0 (0 = tier off)")
         if self.flight_ring < 16:
             # a ring too small to hold even one incident's records would
             # silently produce useless bundles; fail at submit time

@@ -1,0 +1,36 @@
+"""Elastic sharded embedding tier (ROADMAP 1).
+
+Reference parity: the reference ElasticDL's parameter-service embedding
+tier — tables sharded by id across PS pods (`id % ps_num`,
+elasticdl/python/worker/ps_client.py), per-minibatch
+pull_embedding_vectors / push_gradients round-trips, and the Go PS
+applying sparse gradients row by row (elasticdl/pkg/ps/optimizer.go).
+
+Rebuilt here as a TIER, not a sidecar process: tables are id-sharded
+across owning workers (`sharding.shard_of`), the shard map is owned by
+the master and committed through the control-plane journal
+(`sharding.ShardMapOwner` — it survives master crash-restart), and the
+per-batch protocol dedupes ids and batches per-shard calls
+(`tier.EmbeddingTierClient`) before the owning store
+(`store.EmbeddingShardStore`) hits the fused gather / deduped
+scatter-add kernels in ops/embedding.py + ops/pallas_scatter.py.
+Resharding on world change rides the same announce → quiesce → handoff
+shape as mesh rescale: shards migrate via `reshard.apply_moves`
+(device-to-device through parallel/elastic.reshard_state) with
+exactly-once update semantics fenced by shard-map version + master
+generation.
+
+See docs/architecture.md "Embedding tier" and docs/performance.md
+"Embedding tier sizing".
+"""
+
+from elasticdl_tpu.embedding.sharding import (  # noqa: F401
+    ShardMapOwner,
+    ShardMapView,
+    TableSpec,
+    plan_moves,
+    shard_of,
+)
+from elasticdl_tpu.embedding.store import EmbeddingShardStore  # noqa: F401
+from elasticdl_tpu.embedding.tier import EmbeddingTierClient  # noqa: F401
+from elasticdl_tpu.embedding.transport import LocalTransport  # noqa: F401

@@ -1,0 +1,436 @@
+"""Shard math + the master-owned, journal-durable embedding shard map.
+
+Id -> shard: `shard_of(id) = id % num_shards` on the hashed id space —
+the reference's `id % ps_num` (elasticdl/python/worker/ps_client.py).
+Vocab ids in this repo are already hash-bucketed at preprocessing time
+(api/preprocessing.hashing), so the modulo IS `hash(id) % num_shards`
+with the identity as the final mix, and it buys what a fresh hash could
+not: a dense per-shard row space (`local = id // num_shards`) that the
+fused gather / scatter-add kernels can address contiguously.
+
+Shard -> owner: the master assigns shards to workers round-robin and
+rebalances on world change with MINIMAL MOVEMENT (`plan_moves`): a shard
+whose owner survives stays put; only shards stranded on dead workers or
+pulled for balance migrate. Every map transition is committed through
+the control-plane journal (`emb_shard_map` / `emb_reshard_begin` /
+`emb_reshard_commit` records) so a master crash mid-resharding replays
+to a CONSISTENT map: a begun-but-uncommitted resharding rolls back to
+the pre-move assignment and flags `reshard_interrupted`, which clients
+treat as "conservatively requeue in-flight pushes" (exactly-once is
+preserved by the stores' per-client sequence fencing either way).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.registry import default_registry
+
+logger = default_logger(__name__)
+
+_reg = default_registry()
+_MAP_VERSION = _reg.gauge(
+    "edl_embedding_shard_map_version", "current embedding shard map version")
+_RESHARDS = _reg.counter(
+    "edl_embedding_reshards_total", "committed resharding transitions")
+_SHARDS_MOVED = _reg.counter(
+    "edl_embedding_shards_moved_total", "shard migrations committed")
+_RESHARD_ROLLBACKS = _reg.counter(
+    "edl_embedding_reshard_rollbacks_total",
+    "reshardings rolled back at journal replay (master died mid-move)")
+
+
+def shard_of(ids: Any, num_shards: int):
+    """Owning shard per id (vectorized). ids are hashed-vocab ints; the
+    modulo is the reference's `id % ps_num` placement."""
+    return np.asarray(ids) % num_shards
+
+
+def local_rows(ids: Any, num_shards: int):
+    """Row index inside the owning shard's dense local table."""
+    return np.asarray(ids) // num_shards
+
+
+def shard_row_count(padded_vocab: int, num_shards: int) -> int:
+    """Rows every shard allocates (uniform: shards are interchangeable
+    migration units; the ceil padding is dead rows on the tail shards)."""
+    return -(-padded_vocab // num_shards)
+
+
+@dataclass(frozen=True)
+class TableSpec:
+    """One tier table: geometry + deterministic init.
+
+    `vocab` is the PADDED row count (ops/embedding.padded_vocab — the
+    same geometry rule checkpoints bake). `seed` makes shard creation
+    reproducible on any owner: a shard materialized fresh is bit-identical
+    wherever it is built, so bootstrap needs no transfer."""
+
+    name: str
+    vocab: int
+    dim: int
+    seed: int = 0
+    init_scale: float = 0.05
+
+    def to_wire(self) -> Dict[str, Any]:
+        return {"name": self.name, "vocab": self.vocab, "dim": self.dim,
+                "seed": self.seed, "init_scale": self.init_scale}
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "TableSpec":
+        return TableSpec(
+            name=str(d["name"]), vocab=int(d["vocab"]), dim=int(d["dim"]),
+            seed=int(d.get("seed", 0)),
+            init_scale=float(d.get("init_scale", 0.05)),
+        )
+
+
+@dataclass(frozen=True)
+class ShardMapView:
+    """An immutable snapshot of the shard map a client operates under.
+
+    `version` fences the data plane: pulls/pushes carry it, and an owner
+    serving a different version rejects the call so a client can never
+    write through a stale map (the resharding exactly-once contract)."""
+
+    version: int
+    num_shards: int
+    owners: Tuple[int, ...]                 # shard id -> owner worker id
+    tables: Tuple[TableSpec, ...] = ()
+    resharding: bool = False                # a move plan is in flight
+
+    def owner_of(self, shard: int) -> int:
+        return self.owners[shard]
+
+    def shards_owned_by(self, owner: int) -> List[int]:
+        return [s for s, o in enumerate(self.owners) if o == owner]
+
+
+@dataclass(frozen=True)
+class ShardMove:
+    """One planned migration: shard `shard` leaves `src` for `dst`.
+    `src < 0` means the donor is DEAD — the recipient restores the shard
+    from the tier checkpoint (or re-materializes from the table seed if
+    no checkpoint exists) instead of a live transfer."""
+
+    shard: int
+    src: int
+    dst: int
+
+    def to_wire(self) -> Dict[str, int]:
+        return {"shard": self.shard, "src": self.src, "dst": self.dst}
+
+    @staticmethod
+    def from_wire(d: Dict[str, Any]) -> "ShardMove":
+        return ShardMove(int(d["shard"]), int(d["src"]), int(d["dst"]))
+
+
+def assign_round_robin(num_shards: int, owners: Sequence[int]) -> List[int]:
+    """Initial placement: shard s -> owners[s % len(owners)]."""
+    owners = sorted(owners)
+    if not owners:
+        raise ValueError("embedding tier needs at least one owner")
+    return [owners[s % len(owners)] for s in range(num_shards)]
+
+
+def plan_moves(
+    current: Sequence[int], new_owners: Sequence[int],
+    dead: Sequence[int] = (),
+) -> List[ShardMove]:
+    """Minimal-movement rebalance of `current` (shard -> owner) onto the
+    surviving/new owner set.
+
+    Invariants: (1) a shard whose owner survives moves only if the
+    balance demands it; (2) stranded shards (leaving owner) are assigned
+    first, to the least-loaded survivors; (3) the result is balanced to
+    within one shard per owner. Deterministic (sorted owner order) so
+    every process computing the same inputs plans the same moves.
+
+    `dead` names owners KNOWN dead (reaped by membership): their shards
+    get ``src = -1`` (restore-from-checkpoint moves). An owner merely
+    LEAVING the set (planned shrink) stays the live donor — its shards
+    transfer device-to-device before it goes; if it turns out
+    unreachable anyway, reshard.apply_moves falls back to the
+    checkpoint/seed restore path per shard."""
+    new_owners = sorted(set(new_owners))
+    if not new_owners:
+        raise ValueError("cannot rebalance onto an empty owner set")
+    dead = set(dead)
+    n = len(current)
+    target_cap = -(-n // len(new_owners))
+    load: Dict[int, int] = {o: 0 for o in new_owners}
+    keep: List[Tuple[int, int]] = []      # (shard, surviving owner)
+    stranded: List[Tuple[int, int]] = []  # (shard, donor or -1)
+    for s, o in enumerate(current):
+        if o in load:
+            keep.append((s, o))
+        else:
+            stranded.append((s, -1 if o in dead else o))
+    # survivors keep up to the balanced capacity; overflow shards move
+    moves: List[ShardMove] = []
+    overflow: List[Tuple[int, int]] = []
+    for s, o in keep:
+        if load[o] < target_cap:
+            load[o] += 1
+        else:
+            overflow.append((s, o))
+    def least_loaded() -> int:
+        return min(new_owners, key=lambda o: (load[o], o))
+    for s, src in stranded:
+        dst = least_loaded()
+        load[dst] += 1
+        moves.append(ShardMove(shard=s, src=src, dst=dst))
+    for s, src in overflow:
+        dst = least_loaded()
+        load[dst] += 1
+        moves.append(ShardMove(shard=s, src=src, dst=dst))
+    return moves
+
+
+def apply_moves_to_assignment(
+    current: Sequence[int], moves: Sequence[ShardMove],
+) -> List[int]:
+    out = list(current)
+    for m in moves:
+        out[m.shard] = m.dst
+    return out
+
+
+class ShardMapOwner:
+    """The master's authoritative shard map, durable through the journal.
+
+    Lifecycle: `bootstrap(owners)` assigns the initial map (journaled as
+    `emb_shard_map`); `begin_resharding(new_owners)` plans minimal moves
+    and journals `emb_reshard_begin` (the map version bumps and the view
+    flips `resharding=True` — clients hold pushes or carry the fence);
+    recipients confirm installed shards via `confirm_moves` (the servicer
+    RPC lands here) and when the plan is fully confirmed the owner
+    journals `emb_reshard_commit` and the new map becomes plain current.
+
+    Crash semantics: replay of a begin WITHOUT its commit rolls back to
+    the pre-move map (`restore_from_replay`) and marks the replayed state
+    `reshard_interrupted` — the successor master re-plans against the
+    live membership, and clients requeue unconfirmed pushes (store-side
+    sequence fencing dedupes any that actually landed).
+
+    Lock order: _lock -> journal queue (the journal never calls back).
+    The ack-after-fsync discipline matches dispatcher/membership: journal
+    commits are enqueued inside `_lock` and waited AFTER release, before
+    the transition is acknowledged to any caller.
+    """
+
+    def __init__(self, num_shards: int, journal=None):
+        if num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+        self.num_shards = num_shards
+        self._journal = journal
+        self._lock = threading.Lock()
+        # tables enter ONLY via register_table (journaled) or
+        # restore_from_replay — a ctor shortcut would silently skip the
+        # journal and lose the table specs at master takeover
+        self._tables: Dict[str, TableSpec] = {}  # guarded_by: _lock
+        self._owners: List[int] = []             # guarded_by: _lock
+        self._version = 0                        # guarded_by: _lock
+        self._pending: Optional[Dict[str, Any]] = None  # guarded_by: _lock
+        self._interrupted = False                # guarded_by: _lock
+        self._listeners: List[Callable[[ShardMapView], None]] = []
+
+    # -------------------------------------------------------------- #
+    # construction / recovery
+
+    def restore_from_replay(self, state) -> None:
+        """Adopt the journal's replayed map (master takeover; `state` is
+        a master/journal.py EmbeddingState). A mid-flight resharding was
+        already rolled back by the replay; the `reshard_interrupted`
+        flag survives so `view()` advertises it until the next committed
+        transition."""
+        with self._lock:
+            self.num_shards = state.num_shards or self.num_shards
+            self._owners = list(state.owners)
+            self._version = state.version
+            self._tables = {
+                t["name"]: TableSpec.from_wire(t) for t in state.tables
+            }
+            self._interrupted = state.reshard_interrupted
+            version = self._version
+        if state.reshard_interrupted:
+            _RESHARD_ROLLBACKS.inc()
+            logger.warning(
+                "embedding shard map recovered MID-RESHARDING: rolled back "
+                "to committed map v%d; clients must requeue in-flight "
+                "pushes (sequence fencing dedupes re-sends)", state.version,
+            )
+        _MAP_VERSION.set(version)
+
+    # -------------------------------------------------------------- #
+
+    def add_listener(self, fn: Callable[[ShardMapView], None]) -> None:
+        """Called with the new view after every committed transition
+        (exceptions swallowed — listeners are advisory)."""
+        self._listeners.append(fn)
+
+    def register_table(self, spec: TableSpec) -> None:
+        commit = None
+        with self._lock:
+            if spec.name in self._tables:
+                if self._tables[spec.name] != spec:
+                    raise ValueError(
+                        f"table {spec.name!r} already registered with a "
+                        "different spec"
+                    )
+                return
+            self._tables[spec.name] = spec
+            if self._journal is not None:
+                commit = self._journal.append("emb_table", **spec.to_wire())
+        if commit is not None:
+            commit.wait()
+
+    def bootstrap(self, owners: Sequence[int]) -> ShardMapView:
+        """First placement (idempotent: re-bootstrapping with a live map
+        is a no-op returning the current view)."""
+        commit = None
+        with self._lock:
+            if self._owners:
+                return self._view_locked()
+            self._owners = assign_round_robin(self.num_shards, owners)
+            self._version = 1
+            self._interrupted = False
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_shard_map", version=self._version,
+                    num_shards=self.num_shards, owners=list(self._owners),
+                )
+            view = self._view_locked()
+        if commit is not None:
+            # ack-after-fsync: the map is not served before it is durable
+            commit.wait()
+        _MAP_VERSION.set(view.version)
+        self._notify(view)
+        return view
+
+    # -------------------------------------------------------------- #
+    # resharding
+
+    def begin_resharding(
+        self, new_owners: Sequence[int], dead: Sequence[int] = (),
+    ) -> Tuple[ShardMapView, List[ShardMove]]:
+        """Plan minimal moves onto `new_owners` and journal the intent.
+        Returns (pending view, moves). No-op (current view, []) when the
+        assignment is already exactly servable by `new_owners`."""
+        commit = None
+        with self._lock:
+            if not self._owners:
+                raise RuntimeError("begin_resharding before bootstrap")
+            if self._pending is not None:
+                raise RuntimeError(
+                    "resharding already in flight (version "
+                    f"{self._pending['version']})"
+                )
+            moves = plan_moves(self._owners, new_owners, dead)
+            if not moves:
+                return self._view_locked(), []
+            version = self._version + 1
+            self._pending = {
+                "version": version,
+                "moves": moves,
+                "confirmed": set(),
+                "prior_owners": list(self._owners),
+            }
+            self._owners = apply_moves_to_assignment(self._owners, moves)
+            self._version = version
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_reshard_begin", version=version,
+                    owners=list(self._owners),
+                    moves=[m.to_wire() for m in moves],
+                )
+            view = self._view_locked()
+        if commit is not None:
+            commit.wait()
+        tracing.event(
+            "embedding.reshard_begin", version=view.version,
+            moves=len(moves),
+        )
+        logger.warning(
+            "embedding resharding v%d: %d shard move(s) planned",
+            view.version, len(moves),
+        )
+        self._notify(view)
+        return view, moves
+
+    def confirm_moves(
+        self, version: int, shard_ids: Sequence[int],
+    ) -> bool:
+        """A recipient installed these shards (servicer RPC). Returns
+        True when accepted (version matches the in-flight plan; an
+        already-confirmed shard is idempotent). The plan commits — one
+        `emb_reshard_commit` journal record, acked after fsync — when
+        every planned move is confirmed."""
+        commit = None
+        committed_view = None
+        with self._lock:
+            p = self._pending
+            if p is None or p["version"] != version:
+                # a stale confirm (pre-crash, or re-sent after commit):
+                # harmless if the map already moved past it
+                return p is None and version <= self._version
+            p["confirmed"].update(int(s) for s in shard_ids)
+            planned = {m.shard for m in p["moves"]}
+            if planned <= p["confirmed"]:
+                self._pending = None
+                self._interrupted = False
+                if self._journal is not None:
+                    commit = self._journal.append(
+                        "emb_reshard_commit", version=version,
+                    )
+                committed_view = self._view_locked()
+                moved = len(planned)
+        if commit is not None:
+            commit.wait()
+        if committed_view is not None:
+            _RESHARDS.inc()
+            _SHARDS_MOVED.inc(moved)
+            _MAP_VERSION.set(committed_view.version)
+            tracing.event(
+                "embedding.reshard_commit", version=version, moves=moved,
+            )
+            logger.warning(
+                "embedding resharding v%d COMMITTED (%d shard(s) moved)",
+                version, moved,
+            )
+            self._notify(committed_view)
+        return True
+
+    def pending_moves(self) -> List[ShardMove]:
+        with self._lock:
+            return list(self._pending["moves"]) if self._pending else []
+
+    # -------------------------------------------------------------- #
+
+    def view(self) -> ShardMapView:
+        with self._lock:
+            return self._view_locked()
+
+    def _view_locked(self) -> ShardMapView:  # holds: _lock
+        return ShardMapView(
+            version=self._version,
+            num_shards=self.num_shards,
+            owners=tuple(self._owners),
+            tables=tuple(self._tables.values()),
+            resharding=self._pending is not None or self._interrupted,
+        )
+
+    def _notify(self, view: ShardMapView) -> None:
+        for fn in self._listeners:
+            try:
+                fn(view)
+            except Exception:
+                logger.exception("shard-map listener failed (ignored)")
+
+

@@ -1,0 +1,479 @@
+"""The owner side of the embedding tier: dense per-shard tables served
+with one fused gather per pull and one deduped scatter-add per push.
+
+Reference parity: the Go PS's per-pod embedding hash map + row-by-row
+sparse optimizer (elasticdl/pkg/ps/embedding.go, optimizer.go). Rebuilt
+dense: shard s of table T is ONE (rows, dim) array addressed by
+`local = id // num_shards`, so a pull is a single take and a push is one
+scatter-add routed through the SAME strategy menu as the training
+backward (ops/embedding.scatter_add_dense — pallas placement kernel with
+the skew-dedupe middle path, tiled fast-zone scan, ...). Per-shard
+outputs are `vocab/num_shards` rows, which is what keeps the scatter
+inside the measured fast zone at production vocab sizes — the sharding
+is itself the perf fix, not just capacity (BASELINE.md round-5 scatter
+cliff).
+
+Two serving modes, selected once per store (EDL_EMB_TIER_DEVICE
+overrides; default = device on TPU backends, host elsewhere):
+
+- **device**: shard rows live as jax Arrays; pull is the jitted fused
+  gather (ops/embedding.gather_rows) and push routes the dense delta
+  through `scatter_add_dense` — the pallas placement kernel's lane on
+  real chips, where the dense-blocked formulation IS the fast path
+  (BASELINE.md round-5). Request shapes are POW2-PADDED by the client
+  (tier.py) so the jitted programs stay in a handful of compile-cache
+  entries per table; the cache is the process-global one
+  (training/compile_cache), so a shard migrating onto a new owner in
+  the same process class finds its programs already compiled — warm
+  resharding rides the compile cache.
+- **host**: shard rows live as numpy; pull is one `take`, push is one
+  in-place deduped scatter-add (sorted segment reduce, then a unique-
+  index fancy add) — cost scales with TOUCHED rows, not shard size,
+  which is what host-memory serving needs (a functional device update
+  would copy the whole shard per push).
+
+Exactly-once pushes: every push carries ``(client_id, seq)`` with seq
+strictly increasing per client; the store keeps the last applied seq per
+(table, shard, client) and re-sends (client retries after a lost ack, or
+requeues after an interrupted resharding) come back ``applied=False``
+without touching the table. The seq watermarks TRAVEL with the shard
+(`extract_shard` / `install_shard` / checkpoint files), so migration and
+restore preserve the fence.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.embedding import sharding
+from elasticdl_tpu.observability.registry import default_registry
+
+logger = default_logger(__name__)
+
+_reg = default_registry()
+_PULLED = _reg.counter(
+    "edl_embedding_store_pulled_rows_total",
+    "rows served by owner stores", labels=("table",))
+_PUSHED = _reg.counter(
+    "edl_embedding_store_pushed_rows_total",
+    "deduped update rows applied by owner stores", labels=("table",))
+_DUP_PUSHES = _reg.counter(
+    "edl_embedding_store_duplicate_pushes_total",
+    "pushes deduplicated by the exactly-once sequence fence")
+_STALE = _reg.counter(
+    "edl_embedding_store_stale_map_rejects_total",
+    "pulls/pushes rejected for a stale shard-map version")
+_SHARDS = _reg.gauge(
+    "edl_embedding_store_shards", "shards resident in this process's store")
+
+
+class StaleShardMapError(RuntimeError):
+    """The caller's shard-map version does not match the store's (or the
+    shard is not resident here) — refresh the map and re-route."""
+
+
+class _Shard:
+    """One resident shard: the dense local table + the exactly-once
+    per-client sequence watermarks (mutations guarded by the store lock
+    at the serving layer; the apply itself runs outside it)."""
+
+    __slots__ = ("rows", "applied", "lock")
+
+    def __init__(self, rows, applied: Optional[Dict[str, int]] = None):
+        self.rows = rows                      # jax.Array (num_rows, dim)
+        self.applied: Dict[str, int] = dict(applied or {})
+        # per-shard leaf lock: pull/push on DIFFERENT shards never
+        # serialize behind each other (the store lock only guards the
+        # shard directory)
+        self.lock = threading.Lock()
+
+
+def _init_shard_rows(spec: sharding.TableSpec, shard: int,
+                     num_shards: int) -> np.ndarray:
+    """Deterministic shard materialization: bit-identical wherever it is
+    built (fresh bootstrap needs no transfer; a dead owner's shard can be
+    re-materialized only if it was never pushed to — otherwise the
+    checkpoint is the source of truth)."""
+    rows = sharding.shard_row_count(spec.vocab, num_shards)
+    # crc32, NOT hash(): Python's str hash is salted per process
+    # (PYTHONHASHSEED), and shard materialization must be bit-identical
+    # ACROSS processes — the same pitfall EDL204 documents for set order
+    rng = np.random.default_rng(
+        np.random.SeedSequence(
+            [spec.seed, zlib.crc32(spec.name.encode()), shard]))
+    out = rng.uniform(-spec.init_scale, spec.init_scale,
+                      (rows, spec.dim)).astype(np.float32)
+    # rows past the padded vocab's tail never map to a real id but are
+    # part of the dense shard; zero them so accounting sums stay honest
+    first_dead = -(-max(0, spec.vocab - shard) // num_shards)
+    out[first_dead:] = 0.0
+    return out
+
+
+def _default_device_mode() -> Optional[bool]:
+    env = os.environ.get("EDL_EMB_TIER_DEVICE", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return None
+
+
+class EmbeddingShardStore:
+    """Shards this worker owns, served to tier clients via a transport."""
+
+    def __init__(self, owner: int, compile_cache=None,
+                 device: Optional[bool] = None):
+        self.owner = owner
+        self._lock = threading.Lock()
+        self._tables: Dict[str, sharding.TableSpec] = {}  # guarded_by: _lock
+        self._num_shards = 0                              # guarded_by: _lock
+        self._map_version = 0                             # guarded_by: _lock
+        self._shards: Dict[Tuple[str, int], _Shard] = {}  # guarded_by: _lock
+        if device is None:
+            device = _default_device_mode()
+        # None = decide lazily at the first shard materialization (the
+        # jax import / backend probe must not be paid by stores that are
+        # constructed but never used)
+        self._device_mode = device
+        if compile_cache is None:
+            from elasticdl_tpu.training import compile_cache as cc
+
+            compile_cache = cc.global_cache()
+        self._cache = compile_cache
+
+    def _use_device(self) -> bool:
+        if self._device_mode is None:
+            import jax
+
+            self._device_mode = jax.default_backend() == "tpu"
+        return self._device_mode
+
+    def _place(self, rows: np.ndarray):
+        """Host array -> the store's serving format: a device-resident
+        jax.Array in device mode, a mutable owned numpy array in host
+        mode (the in-place scatter must never write a caller's buffer)."""
+        if self._use_device():
+            import jax
+
+            return jax.device_put(rows)
+        return np.array(rows, np.float32, copy=True)
+
+    # -------------------------------------------------------------- #
+    # map adoption / shard lifecycle
+
+    def attach(self, view: sharding.ShardMapView,
+               checkpoint_dir: str = "") -> List[int]:
+        """Adopt a shard-map view: register its tables, materialize every
+        owned-but-missing shard (from the tier checkpoint when present,
+        else deterministically from the table seed), and adopt the map
+        version. Shards this view assigns elsewhere are NOT dropped here —
+        the donor keeps them until the migration commits (reshard.py
+        releases them). Returns the shard ids freshly materialized."""
+        created: List[int] = []
+        with self._lock:
+            self._num_shards = view.num_shards
+            self._map_version = view.version
+            for spec in view.tables:
+                self._tables[spec.name] = spec
+            owned = [s for s, o in enumerate(view.owners)
+                     if o == self.owner]
+            for spec in view.tables:
+                for s in owned:
+                    if (spec.name, s) in self._shards:
+                        continue
+                    rows = None
+                    if checkpoint_dir:
+                        payload = load_shard_file(
+                            checkpoint_dir, spec.name, s)
+                        if payload is not None:
+                            self._shards[(spec.name, s)] = _Shard(
+                                self._place(payload["rows"]),
+                                payload["applied"],
+                            )
+                            created.append(s)
+                            continue
+                    rows = _init_shard_rows(spec, s, view.num_shards)
+                    self._shards[(spec.name, s)] = _Shard(self._place(rows))
+                    created.append(s)
+            _SHARDS.set(len(self._shards))
+        return created
+
+    def adopt_version(self, version: int) -> None:
+        with self._lock:
+            self._map_version = version
+
+    @property
+    def map_version(self) -> int:
+        with self._lock:
+            return self._map_version
+
+    def resident_shards(self, table: Optional[str] = None) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [k for k in self._shards
+                    if table is None or k[0] == table]
+
+    def _get_shard(self, table: str, shard: int,
+                   map_version: Optional[int]) -> _Shard:
+        with self._lock:
+            if (map_version is not None
+                    and map_version != self._map_version):
+                _STALE.inc()
+                raise StaleShardMapError(
+                    f"shard map v{map_version} (store at "
+                    f"v{self._map_version})"
+                )
+            sh = self._shards.get((table, shard))
+        if sh is None:
+            _STALE.inc()
+            raise StaleShardMapError(
+                f"shard {table}/{shard} not resident on owner {self.owner}"
+            )
+        return sh
+
+    # -------------------------------------------------------------- #
+    # data plane
+
+    def pull(self, table: str, shard: int, local_ids: np.ndarray,
+             map_version: Optional[int] = None) -> np.ndarray:
+        """One fused gather: (n,) local row ids -> (n, dim) rows.
+        Out-of-range ids (the client's pow2 padding sentinels) return
+        zero rows."""
+        sh = self._get_shard(table, shard, map_version)
+        ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
+        with sh.lock:
+            rows = sh.rows
+        if self._use_device():
+            out = np.asarray(
+                self._pull_fn(rows.shape, ids.shape[0])(rows, ids))
+        else:
+            in_range = (ids >= 0) & (ids < rows.shape[0])
+            out = rows.take(np.where(in_range, ids, 0), axis=0)
+            out[~in_range] = 0.0
+        # REAL rows only: the request is pow2-padded with -1 sentinels
+        # (min bucket 256), and counting the padding would inflate the
+        # traffic counters operators size capacity from
+        _PULLED.inc(int((ids >= 0).sum()), table=table)
+        return out
+
+    def push(self, table: str, shard: int, local_ids: np.ndarray,
+             rows: np.ndarray, *, client_id: str, seq: int,
+             map_version: Optional[int] = None,
+             scale: float = 1.0) -> bool:
+        """One deduped scatter-add: ``shard_table += scale * sum(rows at
+        local_ids)``. Returns False (without touching the table) when the
+        exactly-once fence says ``(client_id, seq)`` was already applied
+        — the ack a retried/requeued push gets."""
+        sh = self._get_shard(table, shard, map_version)
+        ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
+        vals = np.ascontiguousarray(np.asarray(rows, np.float32))
+        with sh.lock:
+            last = sh.applied.get(client_id, -1)
+            if seq <= last:
+                _DUP_PUSHES.inc()
+                return False
+            if self._use_device():
+                sh.rows = self._apply_fn(sh.rows.shape, ids.shape[0])(
+                    sh.rows, ids, vals, np.float32(scale))
+            else:
+                self._host_apply(sh.rows, ids, vals, scale)
+            sh.applied[client_id] = seq
+        # real (non-sentinel) rows only — see the pull counter note
+        _PUSHED.inc(int((ids >= 0).sum()), table=table)
+        return True
+
+    @staticmethod
+    def _host_apply(tab: np.ndarray, ids: np.ndarray, vals: np.ndarray,
+                    scale: float) -> None:
+        """In-place scatter-add, O(touched rows). Out-of-range ids
+        (padding sentinels) drop. Two regimes:
+
+        - UNIQUE ids (a deduping client — tier.py sums duplicates before
+          sending): one vectorized fancy-index add. This is the fast
+          path the client-side dedupe exists to unlock.
+        - duplicate ids (a non-deduping client): ``np.add.at`` — the
+          row-serial accumulate that is numpy's honest general primitive
+          for colliding indices, and the faithful stand-in for the
+          reference PS's per-row hash-map apply
+          (elasticdl/pkg/ps/optimizer.go). Its cost IS the per-row
+          traffic the deduped protocol removes; the bench's single-host
+          baseline measures it on purpose.
+        """
+        keep = (ids >= 0) & (ids < tab.shape[0])
+        ids, vals = ids[keep], vals[keep]
+        if not ids.shape[0]:
+            return
+        # sorted-unique probe without a full unique(): the deduping
+        # client sends SORTED unique ids, so one vectorized monotonicity
+        # check identifies the fast path
+        sorted_unique = bool(np.all(ids[1:] > ids[:-1]))
+        if sorted_unique:
+            tab[ids] += scale * vals
+        else:
+            np.add.at(tab, ids, scale * vals)
+
+    # -------------------------------------------------------------- #
+    # jitted programs (compile-cache keyed: warm resharding finds them)
+
+    def _pull_fn(self, table_shape, n):
+        key = ("emb_tier_pull", table_shape, int(n))
+
+        def build():
+            import jax
+            import jax.numpy as jnp
+
+            from elasticdl_tpu.ops import embedding as emb_ops
+
+            def f(tab, ids):
+                in_range = (ids >= 0) & (ids < tab.shape[0])
+                safe = jnp.where(in_range, ids, 0)
+                out = emb_ops.gather_rows(tab, safe)
+                return jnp.where(in_range[:, None], out, 0.0)
+
+            return jax.jit(f)
+
+        return self._cache.get_or_build(key, build)
+
+    def _apply_fn(self, table_shape, n):
+        key = ("emb_tier_apply", table_shape, int(n))
+
+        def build():
+            import jax
+
+            from elasticdl_tpu.ops import embedding as emb_ops
+
+            def f(tab, ids, vals, scale):
+                delta = emb_ops.scatter_add_dense(
+                    ids, vals, tab.shape[0], dtype=tab.dtype)
+                return tab + scale * delta
+
+            # NOT donated: a concurrent pull on the same shard may still
+            # hold the old rows array (the per-shard lock scopes the
+            # rows SWAP, not the gather's execution) — donation would
+            # invalidate the buffer under it
+            return jax.jit(f)
+
+        return self._cache.get_or_build(key, build)
+
+    # -------------------------------------------------------------- #
+    # migration / checkpoint payloads
+
+    def extract_shard(self, table: str, shard: int) -> Dict[str, Any]:
+        """The migration payload: rows + exactly-once watermarks. The
+        shard stays resident (the donor serves reads until the move
+        commits); `release_shard` drops it afterwards."""
+        sh = self._get_shard(table, shard, None)
+        with sh.lock:
+            return {
+                # copy, not a view: in host mode the live array mutates
+                # in place under later pushes — a payload must be a
+                # point-in-time snapshot
+                "rows": np.array(sh.rows, np.float32, copy=True),
+                "applied": dict(sh.applied),
+            }
+
+    def install_shard(self, table: str, shard: int,
+                      payload: Dict[str, Any]) -> None:
+        with self._lock:
+            self._shards[(table, shard)] = _Shard(
+                self._place(np.asarray(payload["rows"], np.float32)),
+                {str(k): int(v) for k, v in payload["applied"].items()},
+            )
+            _SHARDS.set(len(self._shards))
+
+    def release_shard(self, table: str, shard: int) -> None:
+        with self._lock:
+            self._shards.pop((table, shard), None)
+            _SHARDS.set(len(self._shards))
+
+    # -------------------------------------------------------------- #
+    # sharded save/restore (training/checkpoint.py delegates here)
+
+    def save(self, directory: str, tables: Optional[List[str]] = None) -> int:
+        """Write every resident shard (of `tables`, default all) as one
+        atomic file each; returns how many were written. Layout:
+        ``<dir>/emb/<table>-shard<id>.npz`` with the rows and the
+        exactly-once watermarks — a restore resumes the fence, so a push
+        replayed from before the save still dedupes."""
+        written = 0
+        for table, shard in self.resident_shards():
+            if tables is not None and table not in tables:
+                continue
+            payload = self.extract_shard(table, shard)
+            save_shard_file(directory, table, shard, payload)
+            written += 1
+        return written
+
+    def restore_missing(self, directory: str) -> int:
+        """Install any checkpointed shard for this owner's current map
+        that is not yet resident (kill-worker recovery path); returns how
+        many were restored. Shards with no file stay absent — attach()
+        decides whether to re-materialize from seed."""
+        restored = 0
+        with self._lock:
+            tables = dict(self._tables)
+            num_shards = self._num_shards
+        for table in tables:
+            for shard in range(num_shards):
+                with self._lock:
+                    if (table, shard) in self._shards:
+                        continue
+                payload = load_shard_file(directory, table, shard)
+                if payload is not None:
+                    self.install_shard(table, shard, payload)
+                    restored += 1
+        return restored
+
+
+
+
+# ------------------------------------------------------------------ #
+# shard files (atomic tmp+replace; EDL305 discipline)
+
+
+def _shard_path(directory: str, table: str, shard: int) -> str:
+    return os.path.join(directory, "emb", f"{table}-shard{shard:05d}.npz")
+
+
+def save_shard_file(directory: str, table: str, shard: int,
+                    payload: Dict[str, Any]) -> str:
+    path = _shard_path(directory, table, shard)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    buf = io.BytesIO()
+    np.savez(
+        buf, rows=np.asarray(payload["rows"], np.float32),
+        applied=np.frombuffer(
+            json.dumps(payload["applied"]).encode(), np.uint8),
+    )
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+        f.flush()
+        # a torn shard file would restore silently-wrong rows; fsync +
+        # atomic replace, same contract as the control-plane journal:
+        # edl-lint: disable=EDL403
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def load_shard_file(directory: str, table: str,
+                    shard: int) -> Optional[Dict[str, Any]]:
+    path = _shard_path(directory, table, shard)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            rows = z["rows"]
+            applied = json.loads(bytes(z["applied"]).decode())
+    except (OSError, ValueError, KeyError):
+        logger.exception("embedding shard file %s unreadable; ignored", path)
+        return None
+    return {"rows": rows, "applied": {str(k): int(v)
+                                      for k, v in applied.items()}}

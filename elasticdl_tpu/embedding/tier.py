@@ -1,0 +1,649 @@
+"""The worker side of the embedding tier: per-batch deduped, per-shard
+batched pull -> compute -> push.
+
+Reference parity: elasticdl/python/worker/worker.py's
+pull_embedding_vectors / push_gradients — but where the reference paid
+one RPC pair per PS pod per minibatch with the FULL id stream, this
+client (1) DEDUPES the batch's ids once (`np.unique`), (2) groups the
+unique ids by owning shard with vectorized modulo math, (3) issues ONE
+batched call per shard (never per row — edl-lint EDL206 polices the
+per-row anti-pattern), and (4) sums duplicate gradients client-side
+(sorted segment reduce) so the owner applies one deduped scatter-add.
+On skewed (production recsys) id distributions the deduped stream is a
+fraction of the raw batch — `edl_embedding_dedupe_ratio` measures it.
+
+Request lengths are padded to power-of-two buckets (sentinel id -1) so
+the owner's jitted pull/apply programs stay in a handful of
+compile-cache entries per table instead of recompiling per batch shape.
+
+Exactly-once pushes: every `push()` call takes one sequence number and
+sends it to every touched shard; any retry — lost ack, stale shard map
+mid-resharding, owner handoff — re-sends the SAME seq, and the store's
+per-(shard, client) watermark turns duplicates into acked no-ops. A
+push returns only when every shard acked, so a client that returns from
+`push()` KNOWS the update landed exactly once.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.embedding import sharding
+from elasticdl_tpu.embedding.store import StaleShardMapError
+from elasticdl_tpu.embedding.transport import OwnerUnavailableError
+from elasticdl_tpu.observability.registry import default_registry
+
+logger = default_logger(__name__)
+
+_reg = default_registry()
+_PULL_S = _reg.histogram(
+    "edl_embedding_pull_seconds", "client pull wall time per batch")
+_PUSH_S = _reg.histogram(
+    "edl_embedding_push_seconds", "client push wall time per batch")
+_PULL_IDS = _reg.counter(
+    "edl_embedding_pull_ids_total", "raw ids in pulled batches")
+_PULL_UNIQUE = _reg.counter(
+    "edl_embedding_pull_unique_ids_total", "deduped ids actually requested")
+_PUSH_IDS = _reg.counter(
+    "edl_embedding_push_ids_total", "raw ids in pushed batches")
+_PUSH_SENT = _reg.counter(
+    "edl_embedding_push_ids_sent_total", "deduped ids actually sent")
+_DEDUPE_RATIO = _reg.gauge(
+    "edl_embedding_dedupe_ratio",
+    "ids sent / ids in batch, most recent push (1.0 = no duplicates)")
+_REFRESHES = _reg.counter(
+    "edl_embedding_map_refreshes_total",
+    "shard-map refreshes forced by stale-map/owner errors")
+_RETRIES = _reg.counter(
+    "edl_embedding_push_retries_total",
+    "push rounds re-sent after an error (seq fence dedupes)")
+_SHARD_CALLS = _reg.histogram(
+    "edl_embedding_shard_batch_ids",
+    "deduped ids per per-shard call (batching effectiveness)")
+
+#: smallest pow2 padding bucket — below this, padding overhead dominates
+MIN_BUCKET = 256
+
+
+def pad_pow2(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+def _dedupe_sum(ids: np.ndarray, rows: np.ndarray):
+    """(sorted unique ids, per-unique summed rows): ONE argsort + one
+    gather + one segment reduce — the client half of the deduped push
+    (duplicate ids ADD, matching sparse-gradient semantics). Sorted
+    output is part of the protocol: the store's fast path is a
+    vectorized unique-index add gated on sorted-unique input."""
+    order = np.argsort(ids, kind="stable")
+    sids = ids[order]
+    is_start = np.empty(sids.shape[0], bool)
+    is_start[0] = True
+    np.not_equal(sids[1:], sids[:-1], out=is_start[1:])
+    starts = np.flatnonzero(is_start)
+    if starts.shape[0] == sids.shape[0]:
+        return sids, rows[order]
+    return sids[starts], np.add.reduceat(rows[order], starts, axis=0)
+
+
+class EmbeddingTierClient:
+    """Per-worker handle on the tier: a shard-map view + a transport.
+
+    `map_fetch` returns the CURRENT ShardMapView (workers wire the
+    master's GetEmbeddingShardMap RPC; tests/bench hand a closure over a
+    ShardMapOwner). The client refreshes on any stale-map or dead-owner
+    error and replays the affected call — pushes under the same seq, so
+    resharding mid-push is exactly-once by construction."""
+
+    def __init__(
+        self,
+        map_fetch: Callable[[], sharding.ShardMapView],
+        transport,
+        client_id: str,
+        dedupe: bool = True,
+        max_retries: int = 8,
+        retry_backoff_s: float = 0.05,
+        fanout_workers: int = 0,
+    ):
+        self._map_fetch = map_fetch
+        self._transport = transport
+        # incarnation-scoped identity: the stores' seq watermarks OUTLIVE
+        # this client (they ride drain checkpoints and shard migrations),
+        # so a relaunched worker reusing a bare worker-id client_id would
+        # restart seq at 1 and have its first pushes silently swallowed
+        # as duplicates. The nonce makes every client incarnation its own
+        # watermark namespace; exactly-once across a relaunch boundary is
+        # the task-accounting layer's job (a re-run task re-pushes on
+        # purpose — its pre-crash work was never reported done).
+        self.client_id = f"{client_id}:{uuid.uuid4().hex[:8]}"
+        self.dedupe = dedupe
+        self._max_retries = max_retries
+        self._backoff_s = retry_backoff_s
+        self._lock = threading.Lock()
+        self._view: Optional[sharding.ShardMapView] = None  # guarded_by: _lock
+        self._seq = 0                                        # guarded_by: _lock
+        self.refresh()
+        # fanout_workers > 0: per-shard calls to distinct owners run
+        # concurrently — right for REMOTE transports, where the calls
+        # are network-bound and genuinely overlap. The in-process
+        # LocalTransport default stays inline: measured on this box,
+        # thread fan-in over GIL-holding numpy work on small deduped
+        # arrays is a net LOSS (~2x) over inline dispatch.
+        self._pool: Optional[ThreadPoolExecutor] = None
+        if fanout_workers > 0 and self.view.num_shards > 1:
+            self._pool = ThreadPoolExecutor(
+                max_workers=min(fanout_workers, self.view.num_shards),
+                thread_name_prefix=f"emb-{client_id}",
+            )
+
+    def _fanout(self, calls) -> None:
+        """Run the per-shard thunks, concurrently when a pool exists.
+        Thunks handle their own errors (they record failures for the
+        caller's retry round) — every shard's attempt completes before
+        this returns."""
+        if self._pool is None or len(calls) <= 1:
+            for c in calls:
+                c()
+            return
+        for f in [self._pool.submit(c) for c in calls]:
+            f.result()
+
+    # -------------------------------------------------------------- #
+
+    def refresh(self) -> sharding.ShardMapView:
+        view = self._map_fetch()
+        with self._lock:
+            self._view = view
+        return view
+
+    @property
+    def view(self) -> sharding.ShardMapView:
+        with self._lock:
+            return self._view
+
+    def table(self, name: str) -> sharding.TableSpec:
+        for t in self.view.tables:
+            if t.name == name:
+                return t
+        raise KeyError(f"table {name!r} not registered with the tier")
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    # -------------------------------------------------------------- #
+    # pull
+
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        """Batch lookup: int ids of any shape -> vectors of shape
+        ``ids.shape + (dim,)``. Negative ids (bag padding sentinels)
+        return zero vectors. One deduped, pow2-padded call per shard."""
+        t0 = time.perf_counter()
+        spec = self.table(table)
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        valid = (flat >= 0) & (flat < spec.vocab)
+        all_valid = bool(valid.all())
+        vids = flat if all_valid else flat[valid]
+        _PULL_IDS.inc(int(flat.shape[0]))
+        if not vids.shape[0]:
+            out = np.zeros((flat.shape[0], spec.dim), np.float32)
+        else:
+            if self.dedupe:
+                uniq, inverse = np.unique(vids, return_inverse=True)
+            else:
+                uniq, inverse = vids, None
+            _PULL_UNIQUE.inc(int(uniq.shape[0]))
+            vectors = self._pull_unique(table, spec, uniq)
+            expanded = vectors if inverse is None else vectors[inverse]
+            if all_valid:
+                out = expanded
+            else:
+                out = np.zeros((flat.shape[0], spec.dim), np.float32)
+                out[valid] = expanded
+        _PULL_S.observe(time.perf_counter() - t0)
+        return out.reshape(*np.asarray(ids).shape, spec.dim)
+
+    def _pull_unique(self, table: str, spec, uniq: np.ndarray) -> np.ndarray:
+        """One call per owning shard over the deduped stream; retried
+        whole against a refreshed map on stale/dead-owner errors (reads
+        are idempotent)."""
+        for attempt in range(self._max_retries + 1):
+            view = self.view
+            try:
+                return self._pull_once(view, table, uniq)
+            except (StaleShardMapError, OwnerUnavailableError,
+                    faults.FaultInjected) as e:
+                self._note_retry("pull", attempt, e)
+        raise OwnerUnavailableError(
+            f"embedding pull for {table!r} failed after "
+            f"{self._max_retries} retries"
+        )
+
+    def pull_unique(self, table: str, ids: np.ndarray):
+        """The deduped-end-to-end lookup: returns ``(unique_rows,
+        inverse, unique_ids)`` where ``unique_rows[inverse].reshape(
+        ids.shape + (dim,))`` are the full vectors. The expansion is the
+        CALLER'S gather — done inside the jitted step (TierEmbedding's
+        `inverse` input), it runs on device memory bandwidth and, more
+        importantly, autodiff through it hands back gradients PER UNIQUE
+        ROW, already duplicate-summed — so the matching push needs no
+        client-side re-dedupe at all. Negative/out-of-range ids map to
+        the LAST unique slot, which is a zero row (a reserved padding
+        slot), so combiner masking semantics match `pull`."""
+        t0 = time.perf_counter()
+        spec = self.table(table)
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        valid = (flat >= 0) & (flat < spec.vocab)
+        _PULL_IDS.inc(int(flat.shape[0]))
+        uniq, inverse = np.unique(
+            np.where(valid, flat, np.int64(-1)), return_inverse=True)
+        has_pad = bool(uniq.shape[0]) and uniq[0] < 0
+        if has_pad:
+            # rotate the sentinel slot to the END: unique ids stay a
+            # sorted in-range stream for the per-shard calls, and slot
+            # U-1 is the reserved zero row
+            uniq = np.concatenate([uniq[1:], uniq[:1]])
+            inverse = np.where(
+                inverse == 0, uniq.shape[0] - 1, inverse - 1)
+        _PULL_UNIQUE.inc(int(uniq.shape[0]) - int(has_pad))
+        rows = np.zeros((uniq.shape[0], spec.dim), np.float32)
+        real = uniq.shape[0] - int(has_pad)
+        if real:
+            rows[:real] = self._pull_unique(table, spec, uniq[:real])
+        _PULL_S.observe(time.perf_counter() - t0)
+        return rows, inverse.reshape(np.asarray(ids).shape), uniq
+
+    def _pull_once(self, view, table: str, uniq: np.ndarray) -> np.ndarray:
+        shards = sharding.shard_of(uniq, view.num_shards)
+        local = sharding.local_rows(uniq, view.num_shards)
+        out = np.empty((uniq.shape[0], self.table(table).dim), np.float32)
+        errs = []
+        errs_lock = threading.Lock()
+
+        def one(shard: int, sel):
+            ids_s = local[sel].astype(np.int32)
+            _SHARD_CALLS.observe(float(ids_s.shape[0]))
+            n = pad_pow2(ids_s.shape[0])
+            padded = np.full((n,), -1, np.int32)
+            padded[: ids_s.shape[0]] = ids_s
+            try:
+                rows = self._transport.pull(
+                    view.owner_of(shard), table, shard, padded,
+                    map_version=view.version,
+                )
+            except (StaleShardMapError, OwnerUnavailableError,
+                    faults.FaultInjected) as e:
+                with errs_lock:
+                    errs.append(e)
+                return
+            out[sel] = rows[: ids_s.shape[0]]
+
+        self._fanout([
+            (lambda s=int(shard): one(s, shards == s))
+            for shard in np.unique(shards)
+        ])
+        if errs:
+            raise errs[0]
+        return out
+
+    # -------------------------------------------------------------- #
+    # push
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+             scale: float = 1.0) -> Dict[str, float]:
+        """Batch sparse update: ``table[id] += scale * grad`` with
+        duplicate ids summed client-side. Returns push stats (the
+        dedupe ratio the bench records). Blocks until every touched
+        shard acked — exactly once, across retries and resharding."""
+        t0 = time.perf_counter()
+        spec = self.table(table)
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        rows = np.asarray(grads, np.float32).reshape(-1, spec.dim)
+        valid = (flat >= 0) & (flat < spec.vocab)
+        if bool(valid.all()):
+            vids, vrows = flat, rows   # no-sentinel fast path: no copies
+        else:
+            vids, vrows = flat[valid], rows[valid]
+        n_batch = int(flat.shape[0])
+        _PUSH_IDS.inc(n_batch)
+        if not vids.shape[0]:
+            _PUSH_S.observe(time.perf_counter() - t0)
+            return {"ids_in_batch": n_batch, "ids_sent": 0,
+                    "dedupe_ratio": 0.0}
+        if self.dedupe:
+            uniq, sums = _dedupe_sum(vids, vrows)
+        else:
+            order = np.argsort(vids, kind="stable")
+            uniq, sums = vids[order], vrows[order]
+        seq = self._next_seq()
+        self._push_unique(table, uniq, sums, seq, scale)
+        sent = int(uniq.shape[0])
+        _PUSH_SENT.inc(sent)
+        ratio = sent / max(1, n_batch)
+        _DEDUPE_RATIO.set(ratio)
+        _PUSH_S.observe(time.perf_counter() - t0)
+        return {"ids_in_batch": n_batch, "ids_sent": sent,
+                "dedupe_ratio": round(ratio, 4)}
+
+    def _push_unique(self, table: str, uniq, sums, seq: int,
+                     scale: float) -> None:
+        """Send the deduped stream, one call per shard, ALL under one
+        seq. Unacked shards are conservatively re-sent whole against a
+        refreshed map (interrupted resharding, lost acks); the store's
+        watermark makes re-applied shards no-ops, so the update lands
+        exactly once no matter how many rounds this takes."""
+        pending = None   # shard ids still unacked (None = all)
+        for attempt in range(self._max_retries + 1):
+            view = self.view
+            shards = sharding.shard_of(uniq, view.num_shards)
+            local = sharding.local_rows(uniq, view.num_shards)
+            todo = np.unique(shards) if pending is None else pending
+            failed = []
+            errbox = []
+            flock = threading.Lock()
+
+            def one(shard: int, sel):
+                ids_s = local[sel].astype(np.int32)
+                _SHARD_CALLS.observe(float(ids_s.shape[0]))
+                n = pad_pow2(ids_s.shape[0])
+                padded_ids = np.full((n,), -1, np.int32)
+                padded_ids[: ids_s.shape[0]] = ids_s
+                padded_rows = np.zeros((n, sums.shape[1]), np.float32)
+                padded_rows[: ids_s.shape[0]] = sums[sel]
+                try:
+                    self._transport.push(
+                        view.owner_of(shard), table, shard,
+                        padded_ids, padded_rows, client_id=self.client_id,
+                        seq=seq, map_version=view.version, scale=scale,
+                    )
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected) as e:
+                    with flock:
+                        failed.append(shard)
+                        errbox.append(e)
+
+            self._fanout([
+                (lambda s=int(shard): one(s, shards == s))
+                for shard in todo
+            ])
+            err = errbox[0] if errbox else None
+            if not failed:
+                return
+            # NOTE: after a map refresh the ids of a failed shard may hash
+            # to the same shard id but a NEW owner — recomputing shards
+            # from the refreshed view each round handles moves; num_shards
+            # itself never changes within a map's lifetime.
+            pending = np.asarray(failed)
+            self._note_retry("push", attempt, err)
+        raise OwnerUnavailableError(
+            f"embedding push for {table!r} (seq {seq}) has "
+            f"{len(pending)} unacked shard(s) after {self._max_retries} "
+            "retries"
+        )
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def _note_retry(self, what: str, attempt: int, err) -> None:
+        _RETRIES.inc()
+        _REFRESHES.inc()
+        logger.warning(
+            "embedding %s retry %d (%s: %s); refreshing shard map",
+            what, attempt + 1, type(err).__name__, err,
+        )
+        time.sleep(self._backoff_s * min(4, attempt + 1))
+        self.refresh()
+
+
+def view_from_response(resp) -> Optional[sharding.ShardMapView]:
+    """GetEmbeddingShardMapResponse -> ShardMapView (None when the
+    master has no map yet — version 0)."""
+    if not resp.version:
+        return None
+    return sharding.ShardMapView(
+        version=int(resp.version),
+        num_shards=int(resp.num_shards),
+        owners=tuple(int(o) for o in resp.shard_owners),
+        tables=tuple(
+            sharding.TableSpec(
+                name=t.name, vocab=int(t.vocab), dim=int(t.dim),
+                seed=int(t.seed), init_scale=float(t.init_scale),
+            )
+            for t in resp.tables
+        ),
+        resharding=bool(resp.resharding),
+    )
+
+
+def stub_map_fetch(stub, worker_id: int,
+                   poll_s: float = 0.5, max_polls: int = 20):
+    """A `map_fetch` closure over the master's GetEmbeddingShardMap RPC
+    (workers wire this into EmbeddingTierClient). Polls while the master
+    has no map yet (version 0 — e.g. before the first worker registered);
+    raises OwnerUnavailableError once the poll budget is gone."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    def fetch() -> sharding.ShardMapView:
+        for _ in range(max_polls):
+            view = view_from_response(
+                stub.GetEmbeddingShardMap(
+                    pb.GetEmbeddingShardMapRequest(worker_id=worker_id)
+                )
+            )
+            if view is not None:
+                return view
+            time.sleep(poll_s)
+        raise OwnerUnavailableError(
+            "master served no embedding shard map (tier disabled, or no "
+            "workers alive to own shards)"
+        )
+
+    return fetch
+
+
+def confirm_reshard(stub, worker_id: int, version: int,
+                    shard_ids) -> bool:
+    """The recipient half of a shard migration: report installed shards
+    so the master can commit the plan (idempotent — safe to retry)."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    resp = stub.ReportEmbeddingReshard(
+        pb.ReportEmbeddingReshardRequest(
+            worker_id=worker_id, version=version,
+            shard_ids=[int(s) for s in shard_ids],
+        )
+    )
+    return bool(resp.accepted)
+
+
+#: process-local default transport: single-process jobs (and the thread
+#: cohorts tests/bench run) share one registry, so every worker-side
+#: store in this process is reachable without a wire
+_default_transport = None
+_default_transport_lock = threading.Lock()
+
+
+def default_transport():
+    from elasticdl_tpu.embedding.transport import LocalTransport
+
+    global _default_transport
+    with _default_transport_lock:
+        if _default_transport is None:
+            _default_transport = LocalTransport()
+        return _default_transport
+
+
+class WorkerTierRuntime:
+    """Everything one worker process runs for the tier: its owning store
+    (registered in the transport), the pull/push client, and the
+    reshard reaction — fetch newly-owned shards (live donor first, then
+    checkpoint, then seed) and confirm them to the master so the plan
+    can commit.
+
+    The worker wires this at boot (worker/worker.py `_init_embedding_
+    tier`, cohort leaders in cohort.py run()); `on_world_change()` runs
+    at task boundaries after a membership bump (never on the heartbeat
+    thread — shard installs can take a while), and `drain()` rides the
+    preemption/forced-checkpoint path so a planned kill loses no acked
+    push."""
+
+    def __init__(self, stub, worker_id: int, checkpoint_dir: str = "",
+                 transport=None):
+        from elasticdl_tpu.embedding.store import EmbeddingShardStore
+
+        self._stub = stub
+        self.worker_id = worker_id
+        self.checkpoint_dir = checkpoint_dir
+        self.transport = transport if transport is not None \
+            else default_transport()
+        self.store = EmbeddingShardStore(worker_id)
+        self.transport.register(self.store)
+        self.client = EmbeddingTierClient(
+            stub_map_fetch(stub, worker_id), self.transport,
+            client_id=f"worker-{worker_id}",
+        )
+        created = self.store.attach(self.client.view, checkpoint_dir)
+        if created and self.client.view.resharding:
+            confirm_reshard(
+                stub, worker_id, self.client.view.version, created)
+
+    def on_world_change(self) -> int:
+        """Re-fetch the map; install shards newly assigned here (live
+        donor -> checkpoint -> seed, through reshard.apply_moves so the
+        migration is spanned and exactly-once), confirm them. Returns
+        how many shards moved in."""
+        from elasticdl_tpu.embedding import reshard, sharding as sh
+
+        old = self.client.view
+        view = self.client.refresh()
+        # residency, not version delta, decides what to install: the
+        # client may have refreshed mid-push-retry already, so an equal
+        # version can still mean shards are missing here
+        resident = set(self.store.resident_shards())
+        mine = [
+            s for s, o in enumerate(view.owners)
+            if o == self.worker_id and any(
+                (t.name, s) not in resident for t in view.tables
+            )
+        ]
+        if not mine:
+            self.store.adopt_version(view.version)
+            return 0
+        moves = [
+            sh.ShardMove(
+                shard=s,
+                src=(old.owners[s]
+                     if s < len(old.owners)
+                     and old.owners[s] != self.worker_id else -1),
+                dst=self.worker_id,
+            )
+            for s in mine
+        ]
+        reshard.apply_moves(
+            view, moves, self.transport,
+            checkpoint_dir=self.checkpoint_dir,
+            confirm=lambda v, shards: confirm_reshard(
+                self._stub, self.worker_id, v, shards),
+        )
+        return len(moves)
+
+    def drain(self) -> int:
+        """Persist this worker's resident shards (rows + seq watermarks)
+        beside the checkpoints — the tier half of the preemption drain."""
+        if not self.checkpoint_dir:
+            return 0
+        from elasticdl_tpu.embedding import reshard
+
+        return reshard.drain_to_checkpoint(self.store, self.checkpoint_dir)
+
+    def close(self) -> None:
+        self.transport.deregister(self.worker_id)
+        self.client.close()
+
+
+class EmbeddingTierSession:
+    """Training integration: pull -> jitted compute (grads w.r.t. the
+    pulled vectors) -> push, per batch.
+
+    `tables` maps table name -> the batch feature key holding its ids.
+    The jitted step is compile-cache keyed (training/compile_cache) on
+    the vector/batch avals, so rescale/resharding reuses the executable.
+    The model consumes vectors through api/layers.TierEmbedding (the
+    vectors are a jit INPUT — the tier pull happens outside the trace,
+    which is what lets the table exceed one host's memory)."""
+
+    def __init__(self, client: EmbeddingTierClient,
+                 tables: Dict[str, str], compile_cache=None):
+        self.client = client
+        self.tables = dict(tables)
+        if compile_cache is None:
+            from elasticdl_tpu.training import compile_cache as cc
+
+            compile_cache = cc.global_cache()
+        self._cache = compile_cache
+
+    def pull_batch(self, batch: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            name: self.client.pull(name, np.asarray(batch[key]))
+            for name, key in self.tables.items()
+        }
+
+    def step(self, loss_fn, batch: Dict[str, Any],
+             lr: float = 0.0) -> Tuple[float, Dict[str, Dict[str, float]]]:
+        """One tier step, deduped END TO END: pull one row per unique id
+        (`pull_unique`), run ``loss_fn(vectors, inverses, batch)`` jitted
+        with grads w.r.t. the unique vectors (the in-step `inverse`
+        gather — TierEmbedding — makes autodiff hand back per-unique-row
+        gradients, duplicate-summed for free), push ``-lr * grad``
+        straight back (tier-side SGD — the reference's PS-resident
+        optimizer, minus its per-row apply). Returns (loss, per-table
+        push stats)."""
+        vectors: Dict[str, Any] = {}
+        inverses: Dict[str, Any] = {}
+        uniq_ids: Dict[str, Any] = {}
+        for name, key in self.tables.items():
+            rows, inverse, uniq = self.client.pull_unique(
+                name, np.asarray(batch[key]))
+            vectors[name], inverses[name], uniq_ids[name] = (
+                rows, inverse, uniq)
+        loss, grads = self._grad_fn(loss_fn, vectors, batch)(
+            vectors, inverses, batch)
+        stats = {}
+        if lr:
+            for name in self.tables:
+                stats[name] = self.client.push(
+                    name, uniq_ids[name], np.asarray(grads[name]),
+                    scale=-lr,
+                )
+        return float(loss), stats
+
+    def _grad_fn(self, loss_fn, vectors, batch):
+        import jax
+
+        key = (
+            "emb_tier_step", id(loss_fn),
+            tuple(sorted(
+                (k, np.asarray(v).shape) for k, v in vectors.items())),
+            tuple(sorted(
+                (k, np.asarray(v).shape) for k, v in batch.items()
+                if hasattr(v, "shape") or isinstance(v, np.ndarray))),
+        )
+
+        def build():
+            return jax.jit(jax.value_and_grad(loss_fn, argnums=0))
+
+        return self._cache.get_or_build(key, build)

@@ -1,0 +1,93 @@
+"""Transport between tier clients and owning stores.
+
+The data plane is pluggable: `LocalTransport` serves the in-process
+deployments this repo can actually run (single-process workers, the
+thread-cohort bench swarm) and is the reference implementation of the
+call contract; a cross-host gRPC transport slots in behind the same
+three methods without touching client or store (the wire schema is the
+shard-map RPCs' sibling — see docs/architecture.md "Embedding tier").
+
+Every call crosses a REAL boundary even in-process: requests and
+responses are numpy arrays (never shared jax buffers), and the
+fault-injection sites ``emb.pull`` / ``emb.push`` / ``emb.fetch_shard``
+(common/faults.py) wrap each call so chaos schedules can drop or delay
+tier traffic deterministically — the exactly-once tests ride these.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from elasticdl_tpu.common import faults
+from elasticdl_tpu.common.log_utils import default_logger
+
+logger = default_logger(__name__)
+
+
+class OwnerUnavailableError(ConnectionError):
+    """The owner is not reachable (dead worker / not yet registered)."""
+
+
+class LocalTransport:
+    """In-process owner registry: owner id -> EmbeddingShardStore.
+
+    Thread-safe; `deregister` models worker death (subsequent calls to
+    that owner raise OwnerUnavailableError, exactly what a dead remote
+    peer looks like to the client's retry path)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stores: Dict[int, Any] = {}     # guarded_by: _lock
+
+    def register(self, store) -> None:
+        with self._lock:
+            self._stores[store.owner] = store
+
+    def deregister(self, owner: int) -> None:
+        with self._lock:
+            self._stores.pop(owner, None)
+
+    def owners(self):
+        with self._lock:
+            return sorted(self._stores)
+
+    def store_of(self, owner: int):
+        """The live store (reshard.py uses this for local migrations)."""
+        with self._lock:
+            store = self._stores.get(owner)
+        if store is None:
+            raise OwnerUnavailableError(f"embedding owner {owner} is gone")
+        return store
+
+    # -------------------------------------------------------------- #
+    # the call contract (a remote transport implements exactly these)
+
+    def pull(self, owner: int, table: str, shard: int,
+             local_ids: np.ndarray,
+             map_version: Optional[int] = None) -> np.ndarray:
+        faults.fire("emb.pull")
+        store = self.store_of(owner)
+        return store.pull(table, shard, local_ids, map_version=map_version)
+
+    def push(self, owner: int, table: str, shard: int,
+             local_ids: np.ndarray, rows: np.ndarray, *, client_id: str,
+             seq: int, map_version: Optional[int] = None,
+             scale: float = 1.0) -> bool:
+        faults.fire("emb.push")
+        store = self.store_of(owner)
+        applied = store.push(
+            table, shard, local_ids, rows, client_id=client_id, seq=seq,
+            map_version=map_version, scale=scale,
+        )
+        # lost-ack injection: the store DID apply; the caller never hears
+        # back and must re-send — the store's seq fence absorbs the dup
+        faults.fire("emb.push.recv")
+        return applied
+
+    def fetch_shard(self, owner: int, table: str,
+                    shard: int) -> Dict[str, Any]:
+        faults.fire("emb.fetch_shard")
+        return self.store_of(owner).extract_shard(table, shard)

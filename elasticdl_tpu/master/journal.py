@@ -25,6 +25,13 @@ mutation order):
     stop_training                      — dispatcher task lifecycle
     member_join / member_death         — membership transitions
     world_version                      — cohort world-version bumps
+    emb_table / emb_shard_map /
+    emb_reshard_begin / emb_reshard_commit
+                                       — embedding tier shard-map
+                                         transitions (embedding/sharding.py;
+                                         a begin without its commit rolls
+                                         back at replay — see
+                                         EmbeddingState.reshard_interrupted)
 
 Durability contract: a transition the master *acted on* (a lease granted,
 a report accepted) is on disk before the effect is observable — a crash
@@ -138,6 +145,25 @@ class MembershipState:
 
 
 @dataclass
+class EmbeddingState:
+    """Replayed embedding-tier shard map (ShardMapOwner restores from
+    this — embedding/sharding.py). The invariant the replay enforces:
+    `owners`/`version` are always the last COMMITTED map. A master
+    killed between `emb_reshard_begin` and `emb_reshard_commit` replays
+    with the pre-move assignment and `reshard_interrupted=True` — the
+    successor re-plans against live membership, and clients
+    conservatively requeue in-flight pushes (the stores' per-client
+    sequence watermarks dedupe any that actually landed, so exactly-once
+    holds across the rollback)."""
+
+    version: int = 0
+    num_shards: int = 0
+    owners: List[int] = field(default_factory=list)
+    tables: List[Dict[str, Any]] = field(default_factory=list)
+    reshard_interrupted: bool = False
+
+
+@dataclass
 class ReplayResult:
     prior_generation: int = 0
     records: int = 0
@@ -145,6 +171,7 @@ class ReplayResult:
     dispatcher: Optional[DispatcherState] = None
     membership: Optional[MembershipState] = None
     world_version: int = 0
+    embedding: Optional[EmbeddingState] = None
 
 
 def _replay_dispatcher(
@@ -223,11 +250,22 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     result = ReplayResult()
     dispatcher: Optional[DispatcherState] = None
     membership: Optional[MembershipState] = None
+    embedding: Optional[EmbeddingState] = None
+    # an emb_reshard_begin whose commit has not replayed yet:
+    # {"version": v, "owners": [...]} — promoted to the committed map by
+    # emb_reshard_commit, rolled back (reshard_interrupted) at the end
+    pending_reshard: Optional[Dict[str, Any]] = None
     doing: Dict[int, Dict[str, Any]] = {}
     lease_order: List[int] = []
 
+    def emb() -> EmbeddingState:
+        nonlocal embedding
+        if embedding is None:
+            embedding = EmbeddingState()
+        return embedding
+
     def apply(rec: Dict[str, Any]) -> None:
-        nonlocal dispatcher, membership
+        nonlocal dispatcher, membership, embedding, pending_reshard
         rtype = rec["t"]
         result.records += 1
         if rtype == "header":
@@ -237,6 +275,8 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                 dispatcher = DispatcherState(**rec["dispatcher"])
             if rec.get("membership") is not None:
                 membership = MembershipState(**rec["membership"])
+            if rec.get("embedding") is not None:
+                embedding = EmbeddingState(**rec["embedding"])
             result.world_version = int(rec.get("world_version", 0))
         elif rtype in _DISPATCHER_RECORDS:
             if dispatcher is None:
@@ -267,6 +307,39 @@ def replay_lines(lines: List[str]) -> ReplayResult:
             membership.version = max(membership.version, int(rec.get("version", 0)))
         elif rtype == "world_version":
             result.world_version = max(result.world_version, int(rec["version"]))
+        elif rtype == "emb_table":
+            e = emb()
+            if not any(t["name"] == rec["name"] for t in e.tables):
+                e.tables.append({
+                    k: rec[k] for k in
+                    ("name", "vocab", "dim", "seed", "init_scale")
+                    if k in rec
+                })
+        elif rtype == "emb_shard_map":
+            e = emb()
+            e.version = int(rec["version"])
+            e.num_shards = int(rec["num_shards"])
+            e.owners = [int(o) for o in rec["owners"]]
+            e.reshard_interrupted = False
+            pending_reshard = None
+        elif rtype == "emb_reshard_begin":
+            pending_reshard = {
+                "version": int(rec["version"]),
+                "owners": [int(o) for o in rec["owners"]],
+            }
+        elif rtype == "emb_reshard_commit":
+            e = emb()
+            if (pending_reshard is not None
+                    and pending_reshard["version"] == int(rec["version"])):
+                e.version = pending_reshard["version"]
+                e.owners = pending_reshard["owners"]
+                e.reshard_interrupted = False
+                pending_reshard = None
+            else:
+                logger.warning(
+                    "emb_reshard_commit v%s without a matching begin; "
+                    "ignored", rec.get("version"),
+                )
         else:
             logger.warning("unknown journal record type %r ignored", rtype)
 
@@ -326,8 +399,22 @@ def replay_lines(lines: List[str]) -> ReplayResult:
         ]
         dispatcher.todo = requeued + dispatcher.todo
         dispatcher.requeued_leases = len(requeued)
+    if pending_reshard is not None:
+        # master died mid-resharding: the moves may be partially executed
+        # but were never committed — roll back to the committed map (the
+        # donors still hold every uncommitted shard by protocol) and flag
+        # the interruption so the successor re-plans and clients requeue
+        # in-flight pushes (store seq fencing dedupes re-sends)
+        e = emb()
+        e.reshard_interrupted = True
+        logger.warning(
+            "journal replay: resharding v%d was begun but never committed; "
+            "rolled back to shard map v%d", pending_reshard["version"],
+            e.version,
+        )
     result.dispatcher = dispatcher
     result.membership = membership
+    result.embedding = embedding
     return result
 
 
@@ -551,6 +638,7 @@ class ControlPlaneJournal:
             if self.replay is not None and (
                 self.replay.dispatcher is not None
                 or self.replay.membership is not None
+                or self.replay.embedding is not None
                 or self.replay.world_version
             ):
                 f.write(json.dumps({
@@ -562,6 +650,10 @@ class ControlPlaneJournal:
                     "membership": (
                         asdict(self.replay.membership)
                         if self.replay.membership is not None else None
+                    ),
+                    "embedding": (
+                        asdict(self.replay.embedding)
+                        if self.replay.embedding is not None else None
                     ),
                     "world_version": self.replay.world_version,
                 }) + "\n")
@@ -586,6 +678,11 @@ class ControlPlaneJournal:
         if self.replay is None:
             return None
         return self.replay.membership
+
+    def embedding_snapshot(self) -> Optional[EmbeddingState]:
+        if self.replay is None:
+            return None
+        return self.replay.embedding
 
     @property
     def world_version(self) -> int:

@@ -442,21 +442,43 @@ class ProcessManager:
                     spawn_span.set(outcome="skipped_manager_stopping")
                     logger.info("re-formation skipped: manager stopping")
                     return
-                self._procs.clear()
                 self._world_version += 1
                 world_version = self._world_version
-                if self._journal is not None:
-                    # committed inside the lock, like every other journaled
-                    # transition — and made DURABLE before the version
-                    # becomes observable below (spawned worker envs, the
-                    # membership-signal announcement): in group-commit
-                    # mode a crash inside the window must not let workers
-                    # see a world version the successor's replay lacks
-                    # (the reform path is rare, so waiting out the bounded
-                    # window under the manager lock is acceptable)
+                # ENQUEUED inside the lock (disk order = mutation order,
+                # like every journaled transition) but awaited OUTSIDE it:
+                # in group-commit mode the wait is a bounded window the
+                # manager lock must not serialize behind (PR 7 boundary).
+                commit = (
                     self._journal.append(
                         "world_version", version=world_version
-                    ).wait()
+                    )
+                    if self._journal is not None else None
+                )
+            if commit is not None:
+                # ack-after-fsync: the version must be DURABLE before it
+                # becomes observable (spawned worker envs, the membership-
+                # signal announcement) — a crash here must never let
+                # workers see a world version the successor's replay
+                # lacks. A failed/poisoned commit raises: the reform
+                # aborts un-announced, exactly like a master crash at
+                # this instant (the in-memory bump was never observable).
+                commit.wait()
+            with self._lock:
+                if self._stop.is_set():
+                    spawn_span.set(outcome="skipped_manager_stopping")
+                    logger.info("re-formation skipped: manager stopping")
+                    return
+                if self._world_version != world_version:
+                    # a concurrent reform superseded us while we awaited
+                    # durability; its spawn/announce carries the newer
+                    # version — ours must not resurrect an older cohort
+                    spawn_span.set(outcome="superseded")
+                    logger.warning(
+                        "re-formation superseded (world v%d -> v%d)",
+                        world_version, self._world_version,
+                    )
+                    return
+                self._procs.clear()
                 if new_size != old_size:
                     # a deliberate resize opens a fresh in-place relaunch
                     # budget

@@ -45,12 +45,16 @@ class MasterServicer:
         wait_backoff_s: float = 2.0,
         summary_service=None,
         generation: int = 0,
+        embedding=None,
     ):
         self._dispatcher = dispatcher
         self._membership = membership
         self._evaluation = evaluation_service
         self._summary = summary_service
         self._wait_backoff_s = wait_backoff_s
+        # embedding tier shard-map owner (embedding/sharding.ShardMapOwner;
+        # None = tier off — the RPCs answer empty)
+        self._embedding = embedding
         # Master generation (master/journal.py header; 0 = fencing off).
         # Workers claim the generation they registered under on every call;
         # a claim from before the last master restart is fenced below so a
@@ -256,6 +260,49 @@ class MasterServicer:
         """Master-side LR override, delivered to every worker on its next
         heartbeat (job callbacks — ReduceLROnPlateau — call this)."""
         self._lr_override = float(lr)
+
+    def GetEmbeddingShardMap(self, request, context):
+        """The tier's control-plane read: the current (journal-durable)
+        shard map. Bootstraps lazily on the first fetch once workers are
+        alive — the map's owner set is the live logical-worker set."""
+        self._fence_generation("GetEmbeddingShardMap", context)
+        if self._embedding is None:
+            return pb.GetEmbeddingShardMapResponse()
+        view = self._embedding.view()
+        if not view.owners:
+            alive = [
+                w.worker_id for w in self._membership.alive_workers()
+                if w.led_by is None
+            ]
+            if not alive:
+                # nobody to own shards yet: the caller backs off and
+                # re-fetches (version 0 = no map)
+                return pb.GetEmbeddingShardMapResponse()
+            view = self._embedding.bootstrap(alive)
+        resp = pb.GetEmbeddingShardMapResponse(
+            version=view.version,
+            num_shards=view.num_shards,
+            shard_owners=list(view.owners),
+            resharding=view.resharding,
+        )
+        for t in view.tables:
+            resp.tables.add(
+                name=t.name, vocab=t.vocab, dim=t.dim, seed=t.seed,
+                init_scale=t.init_scale,
+            )
+        return resp
+
+    def ReportEmbeddingReshard(self, request, context):
+        """A recipient confirms installed shard migrations; the plan
+        commits (one journal record, acked after fsync inside
+        confirm_moves) when every planned move is confirmed."""
+        self._fence_generation("ReportEmbeddingReshard", context)
+        if self._embedding is None:
+            return pb.ReportEmbeddingReshardResponse(accepted=False)
+        accepted = self._embedding.confirm_moves(
+            request.version, list(request.shard_ids)
+        )
+        return pb.ReportEmbeddingReshardResponse(accepted=accepted)
 
     def GetJobStatus(self, request, context):
         counts = self._dispatcher.counts()

@@ -400,6 +400,39 @@ def _gather_rows_bwd(res, ct):
 gather_rows.defvjp(_gather_rows_fwd, _gather_rows_bwd)
 
 
+def scatter_add_dense(
+    ids: jax.Array, rows: jax.Array, num_rows: int,
+    dtype=jnp.float32,
+) -> jax.Array:
+    """Dense (num_rows, D) sum of `rows` placed at `ids` — the embedding
+    tier's push hot path, routed through the SAME strategy menu as the
+    training backward (`EDL_EMB_SCATTER`: pallas placement kernel with the
+    dedupe middle path, tiled fast-zone scan, sorted segment-sum, unique
+    compaction, flat XLA scatter).
+
+    ids: int32 (N,) — out-of-range ids (negative padding sentinels,
+    anything >= num_rows) are dropped, contributing nothing. rows: (N, D)
+    contribution rows. The duplicates-ADD semantics match a sparse
+    gradient push: duplicate ids accumulate. Empty N is a static no-op
+    (zeros). This is exactly `gather_rows`'s VJP applied to an explicit
+    cotangent, so every kernel-path guarantee (window guards, skew dedupe,
+    bf16 split accuracy) documented there applies here unchanged."""
+    ids = jnp.asarray(ids, jnp.int32).reshape(-1)
+    rows = jnp.asarray(rows)
+    rows = rows.reshape(-1, rows.shape[-1])
+    # same routing as embedding_lookup: out-of-range ids (padding
+    # sentinels) go to a LARGE value so the sorted paths never pile them
+    # into tile 0's window (see the lookup's oob note)
+    oob = jnp.iinfo(jnp.int32).max // 2
+    in_range = (ids >= 0) & (ids < num_rows)
+    safe_ids = jnp.where(in_range, ids, oob)
+    rows = jnp.where(in_range[:, None], rows, 0)
+    d_table, _ = _gather_rows_bwd(
+        (safe_ids, jnp.empty((0,), dtype), num_rows), rows
+    )
+    return d_table
+
+
 def _take(table: jax.Array, ids: jax.Array) -> jax.Array:
     if os.environ.get("EDL_EMB_SCATTER", "pallas") == "xla":
         return jnp.take(table, ids, axis=0)

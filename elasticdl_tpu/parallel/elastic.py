@@ -246,6 +246,20 @@ def _leaf_spec(x):
     return spec if spec is not None else P()
 
 
+def stage_leaf(x, spec=None) -> _HostStaged:
+    """Wrap one array for the staged half of a handoff: the embedding
+    tier's shard migrations (embedding/reshard.py) ride the same
+    stage-then-reshard lane a TrainState leaf takes when its owner
+    devices vanish. `spec` defaults to the array's own PartitionSpec
+    (P() for host numpy arrays)."""
+    import numpy as _np
+
+    if isinstance(x, jax.Array):
+        return _HostStaged(_np.asarray(jax.device_get(x)),
+                           spec if spec is not None else _leaf_spec(x))
+    return _HostStaged(_np.asarray(x), spec if spec is not None else _leaf_spec(x))
+
+
 def reshard_state(state: Any, new_mesh) -> Any:
     """Reshard a TrainState (or any pytree of jax arrays) onto `new_mesh`,
     preserving each leaf's PartitionSpec (pruned to the new mesh's axes).

@@ -37,6 +37,8 @@ _PB2_PATH = os.path.join(_HERE, "elasticdl_tpu_pb2.py")
 _SCALAR = {
     "int32": descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
     "string": descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
+    "float": descriptor_pb2.FieldDescriptorProto.TYPE_FLOAT,
+    "bool": descriptor_pb2.FieldDescriptorProto.TYPE_BOOL,
 }
 
 
@@ -105,6 +107,56 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
         msgs["RegisterWorkerResponse"], "member_ids", 4, "int32",
         repeated=True,
     )
+
+    # Elastic sharded embedding tier (embedding/): the master owns the
+    # id-sharded table map; workers fetch it (GetEmbeddingShardMap) and
+    # confirm installed shard migrations (ReportEmbeddingReshard). The
+    # tier's DATA plane (pull/push) is worker-to-worker and does not
+    # cross the master — only the map does.
+    def _new_msg(name, fields):
+        if name in msgs:
+            return 0
+        m = fd.message_type.add()
+        m.name = name
+        for fname, num, ftype, kw in fields:
+            _add_field(m, fname, num, ftype, **kw)
+        msgs[name] = m
+        return 1
+
+    changed += _new_msg("EmbeddingTableSpec", [
+        ("name", 1, "string", {}),
+        # PADDED vocab rows (ops/embedding.padded_vocab — the checkpoint
+        # geometry rule) and the deterministic init params that let any
+        # owner materialize a fresh shard bit-identically
+        ("vocab", 2, "int32", {}),
+        ("dim", 3, "int32", {}),
+        ("seed", 4, "int32", {}),
+        ("init_scale", 5, "float", {}),
+    ])
+    changed += _new_msg("GetEmbeddingShardMapRequest", [
+        ("worker_id", 1, "int32", {}),
+    ])
+    changed += _new_msg("GetEmbeddingShardMapResponse", [
+        ("version", 1, "int32", {}),
+        ("num_shards", 2, "int32", {}),
+        # shard id -> owning worker id, dense
+        ("shard_owners", 3, "int32", {"repeated": True}),
+        ("tables", 4, "", {
+            "repeated": True,
+            "type_name": ".elasticdl_tpu.EmbeddingTableSpec",
+        }),
+        # a move plan is in flight (or was interrupted by a master
+        # crash): clients conservatively requeue unacked pushes
+        ("resharding", 5, "bool", {}),
+    ])
+    changed += _new_msg("ReportEmbeddingReshardRequest", [
+        ("worker_id", 1, "int32", {}),
+        ("version", 2, "int32", {}),
+        ("shard_ids", 3, "int32", {"repeated": True}),
+    ])
+    changed += _new_msg("ReportEmbeddingReshardResponse", [
+        ("accepted", 1, "bool", {}),
+    ])
     return changed
 
 

@@ -87,6 +87,14 @@ _RPCS = {
     ),
     "Heartbeat": (pb.HeartbeatRequest, pb.HeartbeatResponse),
     "GetJobStatus": (pb.Empty, pb.JobStatusResponse),
+    "GetEmbeddingShardMap": (
+        pb.GetEmbeddingShardMapRequest,
+        pb.GetEmbeddingShardMapResponse,
+    ),
+    "ReportEmbeddingReshard": (
+        pb.ReportEmbeddingReshardRequest,
+        pb.ReportEmbeddingReshardResponse,
+    ),
 }
 
 #: methods whose server-side handling opens a span when the client sent a
@@ -144,6 +152,12 @@ DEFAULT_POLICIES: Dict[str, RpcPolicy] = {
     "ReportEvaluationMetrics": RpcPolicy(timeout_s=30.0, idempotent=True),
     "Heartbeat": RpcPolicy(timeout_s=10.0, idempotent=False),
     "GetJobStatus": RpcPolicy(timeout_s=10.0, idempotent=True),
+    # embedding tier control plane: the map read is a pure read; the
+    # reshard confirm is idempotent at the ShardMapOwner (re-confirming
+    # an already-confirmed shard — or a whole already-committed plan —
+    # changes nothing), so both retry safely
+    "GetEmbeddingShardMap": RpcPolicy(timeout_s=10.0, idempotent=True),
+    "ReportEmbeddingReshard": RpcPolicy(timeout_s=30.0, idempotent=True),
 }
 
 

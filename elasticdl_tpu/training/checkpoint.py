@@ -377,6 +377,41 @@ class CheckpointManager:
             f"(tried {candidates})"
         ) from last_err
 
+    # ------------------------------------------------------------------ #
+    # embedding tier shards (elasticdl_tpu/embedding/store.py)
+    #
+    # Tier tables are NOT TrainState leaves (they live outside the jitted
+    # step, on their owning workers), so orbax never sees them; they ride
+    # the same checkpoint directory as per-shard files with their
+    # exactly-once sequence watermarks. The per-shard write is atomic
+    # (tmp + fsync + replace), so a crash mid-save leaves every shard
+    # either whole-old or whole-new — restore never sees a torn shard.
+
+    def save_embedding_tier(self, store, tables=None) -> int:
+        """Persist every tier shard resident in `store` beside the orbax
+        steps; returns shards written. Called by the worker's drain path
+        (a planned kill must lose no acked push) and by checkpoint-step
+        cadence when the tier is live."""
+        with tracing.span("ckpt.embedding_tier_save") as sp:
+            n = store.save(self._dir, tables)
+            sp.set(shards=n)
+        return n
+
+    def restore_embedding_tier(self, store) -> int:
+        """Install any checkpointed shard the store's current map assigns
+        here but that is not yet resident (kill-worker recovery); returns
+        shards restored."""
+        with tracing.span("ckpt.embedding_tier_restore") as sp:
+            n = store.restore_missing(self._dir)
+            sp.set(shards=n)
+        return n
+
+    @property
+    def directory(self) -> str:
+        """The root the tier's shard files live under (embedding/store
+        resolves <dir>/emb/)."""
+        return self._dir
+
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 

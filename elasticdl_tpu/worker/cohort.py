@@ -145,6 +145,10 @@ class CohortWorker:
         # (written by the task loop at the post-task exchange, read by the
         # heartbeat thread — whole-dict swaps only, so no lock needed)
         self._member_stats: Dict[int, Dict[str, Any]] = {}
+        # elastic embedding tier (cfg.embedding_shards > 0): leader-owned
+        # store + client; shards drain to checkpoint at teardown and the
+        # next generation's leader restores them (_init_embedding_tier)
+        self._tier = None
 
     # ------------------------------------------------------------------ #
     # setup (identical on every process)
@@ -306,6 +310,43 @@ class CohortWorker:
 
     def _note_master_ok(self) -> None:
         self._last_master_ok = time.monotonic()
+
+    def _init_embedding_tier(self) -> None:
+        """Leader-only tier membership (cfg.embedding_shards > 0): the
+        cohort is ONE logical worker, so the leader owns its shard set.
+        Unlike the single-process worker there is no in-place refresh
+        path — a cohort rides every world change through teardown +
+        re-form (process_manager), and each generation's leader re-joins
+        here, restoring its shards from the drain checkpoint."""
+        if self.cfg.embedding_shards <= 0 or self._tier is not None:
+            return
+        try:
+            from elasticdl_tpu.embedding.tier import WorkerTierRuntime
+
+            self._tier = WorkerTierRuntime(
+                self._stub, self.worker_id,
+                checkpoint_dir=self.cfg.checkpoint_dir,
+            )
+            logger.info(
+                "cohort leader joined embedding tier: map v%d, %d "
+                "shard(s) resident", self._tier.client.view.version,
+                len(self._tier.store.resident_shards()),
+            )
+        except Exception:
+            logger.exception(
+                "embedding tier init failed; tier disabled for this cohort"
+            )
+
+    def _drain_embedding_tier(self) -> None:
+        """The tier half of the cohort's drain: persist resident shards
+        (rows + exactly-once watermarks) so the next generation's leader
+        restores them bit-exactly."""
+        if self._tier is None:
+            return
+        try:
+            self._tier.drain()
+        except Exception:
+            logger.exception("embedding tier drain failed")
 
     def _reregister(self) -> None:
         """Leader-only reconnect handshake after a master restart (shared
@@ -1136,6 +1177,7 @@ class CohortWorker:
                 # cross-role join point of the resize timeline
                 with tracing.span("cohort.register", trace_id=reform_tid):
                     self._connect()
+                self._init_embedding_tier()
                 threading.Thread(
                     target=self._heartbeat_loop, daemon=True
                 ).start()
@@ -1195,6 +1237,10 @@ class CohortWorker:
                             "grpc channel close failed at exit", exc_info=True
                         )
 
+            # the tier's shards drain on EVERY teardown path (the next
+            # leader generation restores them bit-exactly, watermarks
+            # included) — cheap, atomic per shard, leader-only
+            self._drain_embedding_tier()
             if op == OP_ABORT and ctrl[6] & FLAG_CHECKPOINT:
                 # preemption drain: one final collective save so the
                 # relaunched cohort resumes at the pre-kill step. The write

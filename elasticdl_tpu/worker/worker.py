@@ -117,6 +117,12 @@ class Worker:
         # leases whole, so running a local copy would be wasted work (its
         # report comes back accepted=False either way).
         self._lease_queue: "deque[pb.Task]" = deque()
+        # Elastic sharded embedding tier (cfg.embedding_shards > 0): this
+        # worker's owning store + pull/push client. Membership bumps set
+        # the refresh flag (heartbeat thread); the run loop reacts at the
+        # next task boundary — shard installs must not stall heartbeats.
+        self._tier = None
+        self._tier_refresh_pending = False
 
     # ------------------------------------------------------------------ #
     # setup
@@ -376,6 +382,15 @@ class Worker:
         SAVE_MODEL tasks (exclusive lease) may be served by any worker.
         force=True also drains any in-flight async save, so a preemption
         exit never abandons a half-written checkpoint."""
+        if force and self._tier is not None:
+            # the tier half of a forced save: every worker persists ITS
+            # resident shards (one owner per shard — no write races),
+            # seq watermarks included, so a planned kill loses no acked
+            # push (the kill-worker resharding acceptance)
+            try:
+                self._tier.drain()
+            except Exception:
+                logger.exception("embedding tier drain failed")
         mngr = self._checkpoint_manager()
         if mngr is None or self._state is None or self.worker_id != 0:
             return
@@ -504,6 +519,10 @@ class Worker:
             "membership v%d -> v%d", self._membership_version, new_version
         )
         self._membership_version = new_version
+        if self._tier is not None:
+            # shards may have been re-planned onto (or off) this worker;
+            # the run loop executes the refresh at a task boundary
+            self._tier_refresh_pending = True
         if (
             self.cfg.scale_lr_with_workers and self._base_lr and num_workers
             and not self._pushed_lr
@@ -1012,8 +1031,35 @@ class Worker:
 
     # ------------------------------------------------------------------ #
 
+    def _init_embedding_tier(self) -> None:
+        """Join the elastic embedding tier (cfg.embedding_shards > 0):
+        register this worker's owning store, build the pull/push client
+        off the master's shard map, install any shards the map (or a
+        checkpoint) assigns here. Best-effort at boot — a worker that
+        cannot join the tier can still train dense models; models that
+        NEED tier tables fail loudly at pull time instead."""
+        if self.cfg.embedding_shards <= 0 or self._tier is not None:
+            return
+        try:
+            from elasticdl_tpu.embedding.tier import WorkerTierRuntime
+
+            self._tier = WorkerTierRuntime(
+                self._stub, self.worker_id,
+                checkpoint_dir=self.cfg.checkpoint_dir,
+            )
+            logger.info(
+                "joined embedding tier: map v%d, %d shard(s) resident",
+                self._tier.client.view.version,
+                len(self._tier.store.resident_shards()),
+            )
+        except Exception:
+            logger.exception(
+                "embedding tier init failed; tier disabled for this worker"
+            )
+
     def run(self) -> int:
         self._connect()
+        self._init_embedding_tier()
         # /metrics + /healthz for this worker (best-effort, off the hot
         # path; a set EDL_METRICS_PORT overrides cfg.metrics_port either
         # way, -1/off in either disables)
@@ -1097,6 +1143,14 @@ class Worker:
                         self._rescale_in_place()
                 except Exception:
                     logger.exception("in-place rescale failed; mesh kept")
+            if self._tier is not None and self._tier_refresh_pending:
+                # resharding reaction at a clean task boundary: refetch
+                # the map, install newly-owned shards, confirm the moves
+                self._tier_refresh_pending = False
+                try:
+                    self._tier.on_world_change()
+                except Exception:
+                    logger.exception("embedding tier refresh failed")
             if task.type == pb.WAIT:
                 # jittered so an idle swarm does not re-poll in phase
                 # (epoch boundaries unblock every worker at once)

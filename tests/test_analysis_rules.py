@@ -328,6 +328,83 @@ def test_rescale_rule_covers_nested_functions():
 
 
 # ------------------------------------------------------------------ #
+# EDL206 per-row-embedding-rpc-in-hot-loop
+
+
+def test_per_row_tier_rpc_fires_on_nested_loop_and_comprehension():
+    bad = """
+        def run(trainer, tier_client, batches, grads):
+            for batch in batches:
+                rows = [tier_client.pull("users", i) for i in batch["cat"]]
+                state, m = trainer.train_step(state, batch)
+                for i, g in zip(batch["cat"], grads):
+                    tier_client.push("users", i, g)       # BAD: per id
+    """
+    fs = findings_for(bad, select={"EDL206"})
+    assert len(fs) == 2
+    assert all(f.rule == "EDL206" for f in fs)
+    assert "per shard" in fs[0].message
+
+
+def test_batched_tier_call_in_dispatch_loop_is_quiet():
+    good = """
+        def run(trainer, tier_client, batches, grads):
+            for batch in batches:
+                vecs = tier_client.pull("users", batch["cat"])  # batched: OK
+                state, m = trainer.train_step(state, batch)
+                tier_client.push("users", batch["cat"], grads)  # batched: OK
+    """
+    assert findings_for(good, select={"EDL206"}) == []
+
+
+def test_epoch_loop_around_dispatch_loop_scans_inner_depth():
+    """A batched call in the STEP loop must not read as 'nested' merely
+    because an epoch loop wraps it; a per-id call one level deeper than
+    the step loop still fires."""
+    good = """
+        def run(trainer, tier_client, batches):
+            for epoch in range(3):
+                for batch in batches:
+                    vecs = tier_client.pull("users", batch["cat"])
+                    state, m = trainer.train_step(state, batch)
+    """
+    assert findings_for(good, select={"EDL206"}) == []
+    bad = """
+        def run(trainer, tier_client, batches):
+            for epoch in range(3):
+                for batch in batches:
+                    state, m = trainer.train_step(state, batch)
+                    for i in batch["cat"]:
+                        tier_client.push("users", i, g)   # BAD
+    """
+    assert len(findings_for(bad, select={"EDL206"})) == 1
+
+
+def test_unrelated_push_methods_and_cold_loops_are_quiet():
+    good = """
+        def run(trainer, stack, batches, tier_client, all_ids):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for x in batch["items"]:
+                    stack.push(x)              # not tier traffic
+            for i in all_ids:
+                tier_client.pull("users", i)   # cold loop: no dispatch
+    """
+    assert findings_for(good, select={"EDL206"}) == []
+
+
+def test_per_row_tier_rpc_suppressible():
+    bad = """
+        def run(trainer, tier_client, batches):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for i in batch["cat"]:
+                    tier_client.push("users", i, g)  # edl-lint: disable=EDL206
+    """
+    assert findings_for(bad, select={"EDL206"}) == []
+
+
+# ------------------------------------------------------------------ #
 # EDL301 / EDL302 bare stub + deadlines
 
 
@@ -1067,7 +1144,7 @@ def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
-                "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
+                "EDL206", "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
                 "EDL401", "EDL402", "EDL403", "EDL404"):
         assert rid in out
 

@@ -141,6 +141,38 @@ def test_control_plane_leg_smoke(bench, monkeypatch):
         assert rc[mode]["stranded_lease_requeued"] is True, rc
 
 
+def test_embedding_tier_leg_smoke(bench, monkeypatch):
+    """The elastic embedding tier scenario (ISSUE 10): tiny sizes must
+    still run the full shape — sharded vs single-host serving loops with
+    measured dedupe (< 1 on the skewed distribution), pull/push
+    latencies, and the kill-worker resharding scenario with bit-exact
+    shards, exactly-once accounting (one injected lost ack absorbed),
+    compile-cache-warm recovery, and a crash-consistent journaled map.
+    The >= 3x throughput claim itself is sized for the full bench run,
+    not this smoke."""
+    monkeypatch.setattr(bench, "ET_VOCAB", 8192)
+    monkeypatch.setattr(bench, "ET_BATCH", 256)
+    monkeypatch.setattr(bench, "ET_LEN", 8)
+    monkeypatch.setattr(bench, "ET_STEPS", 3)
+    res = bench.bench_embedding_tier(None, np)
+    s = res["sharded"]
+    assert s["rows_per_sec"] > 0 and res["single_host"]["rows_per_sec"] > 0
+    assert 0 < s["dedupe_ratio"] < 1.0, s
+    for key in ("pull_p50_ms", "pull_p99_ms", "push_p50_ms", "push_p99_ms"):
+        assert s[key] >= 0
+    assert res["sharded_speedup"] > 0
+    rs = res["reshard"]
+    assert rs["bit_exact"] is True, rs
+    assert rs["exactly_once"] is True, rs
+    assert rs["lost_acks_injected"] == 1
+    assert rs["duplicate_pushes_absorbed"] >= 1
+    assert rs["shards_moved"] >= 1
+    assert rs["warm_resharding"] is True, rs
+    assert rs["reshard_compile_misses"] == 0, rs
+    assert rs["journal_map_consistent"] is True, rs
+    assert rs["recovery_s"] > 0
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)

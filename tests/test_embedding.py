@@ -518,3 +518,127 @@ def test_nondivisible_table_falls_back_to_auto_with_parity(mesh8):
         for l in range(3):
             expected[ids_np[b, l]] += w_np[b, l]
     np.testing.assert_allclose(np.asarray(g), expected, rtol=1e-5, atol=1e-6)
+
+
+# ---------------------------------------------------------------------- #
+# scatter_add_dense — the embedding TIER's push hot path (ISSUE 10).
+# The tier's owner stores route every deduped push through this entry,
+# which shares gather_rows' backward strategy menu — including the
+# pallas-dedupe skew path — so its edges get pinned here: empty batch,
+# all-duplicate ids, vocab-boundary ids, bf16 accumulation, and
+# cross-strategy parity.
+
+
+def _scatter_ref(ids_np, rows_np, num_rows):
+    out = np.zeros((num_rows, rows_np.shape[-1]), np.float32)
+    m = (ids_np >= 0) & (ids_np < num_rows)
+    np.add.at(out, ids_np[m], rows_np[m])
+    return out
+
+
+@pytest.mark.parametrize(
+    "mode", ["pallas", "tiled", "sorted", "unique", "xla"])
+def test_scatter_add_dense_empty_batch(monkeypatch, mode):
+    """A statically-empty push is a zero table on every strategy (the
+    tier's empty-batch call: a batch whose every id was a padding
+    sentinel filtered client-side)."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", mode)
+    out = emb_ops.scatter_add_dense(
+        jnp.zeros((0,), jnp.int32), jnp.zeros((0, 8), jnp.float32), 256)
+    assert out.shape == (256, 8)
+    assert np.all(np.asarray(out) == 0)
+
+
+def test_scatter_add_dense_all_duplicate_ids_pallas_dedupe(monkeypatch):
+    """Every id identical — the hardest skew: the pallas window guard
+    must overflow into the dedupe middle path (adjacent-duplicate
+    compaction), which collapses the stream to ONE row before placement.
+    Real Mosaic kernel in interpret mode; exactness vs the host
+    reference within the two-term bf16 split's ~4e-6 rel."""
+    from elasticdl_tpu.ops.pallas_attention import interpret_mode
+
+    monkeypatch.setenv("EDL_EMB_SCATTER", "pallas")
+    monkeypatch.setenv("EDL_EMB_PALLAS_BS", "256")
+    V, n, d = 2048, 4096, 16
+    r = np.random.RandomState(0)
+    ids_np = np.full((n,), 513, np.int32)       # one hot id, mid-vocab
+    rows_np = r.randn(n, d).astype(np.float32)
+    with interpret_mode():
+        out = jax.jit(
+            emb_ops.scatter_add_dense, static_argnums=(2,)
+        )(jnp.asarray(ids_np), jnp.asarray(rows_np), V)
+    ref = _scatter_ref(ids_np, rows_np, V)
+    scale = np.abs(ref).max()
+    np.testing.assert_allclose(
+        np.asarray(out) / scale, ref / scale, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "mode", ["pallas", "tiled", "sorted", "unique", "xla"])
+def test_scatter_add_dense_vocab_boundary_ids(monkeypatch, mode):
+    """Boundary ids — 0, V-1 — must land; V, V+1, negatives (padding
+    sentinels, the tier's pow2 padding) must drop on EVERY strategy.
+    Off-TPU the pallas mode reroutes to tiled; the boundary semantics
+    must be identical either way."""
+    monkeypatch.setenv("EDL_EMB_SCATTER", mode)
+    V, d = 512, 8
+    ids_np = np.array([0, 0, V - 1, V, V + 7, -1, -5, 3], np.int32)
+    rows_np = np.arange(8 * d, dtype=np.float32).reshape(8, d) + 1.0
+    out = np.asarray(emb_ops.scatter_add_dense(
+        jnp.asarray(ids_np), jnp.asarray(rows_np), V))
+    ref = _scatter_ref(ids_np, rows_np, V)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # the dropped rows contributed NOTHING anywhere
+    assert out.sum() == pytest.approx(ref.sum(), rel=1e-5)
+
+
+def test_scatter_add_dense_strategy_parity_skewed(monkeypatch):
+    """All five strategies agree on a skewed (30%-hot) stream — the
+    cross-strategy parity the tier depends on when EDL_EMB_SCATTER
+    changes between owner processes."""
+    V, n, d = 2048, 4096, 16
+    r = np.random.RandomState(1)
+    ids_np = r.randint(0, V, n).astype(np.int32)
+    ids_np[: n // 3] = 77                       # 30% hot id
+    rows_np = r.randn(n, d).astype(np.float32)
+    results = {}
+    for mode in ("tiled", "sorted", "unique", "xla"):
+        monkeypatch.setenv("EDL_EMB_SCATTER", mode)
+        results[mode] = np.asarray(emb_ops.scatter_add_dense(
+            jnp.asarray(ids_np), jnp.asarray(rows_np), V))
+    ref = _scatter_ref(ids_np, rows_np, V)
+    for mode, out in results.items():
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4,
+                                   err_msg=mode)
+
+
+def test_scatter_add_dense_bf16_accumulation_vs_split(monkeypatch):
+    """EDL_EMB_PALLAS_PRECISION=bf16 drops the two-term split's second
+    matmul: the single-pass bf16 result must stay within bf16 rounding
+    (~0.5% rel) of the host reference, while the default split pass
+    holds ~4e-6 — both on the REAL Mosaic kernel in interpret mode."""
+    from elasticdl_tpu.ops.pallas_attention import interpret_mode
+
+    monkeypatch.setenv("EDL_EMB_SCATTER", "pallas")
+    monkeypatch.setenv("EDL_EMB_PALLAS_BS", "256")
+    V, n, d = 2048, 4096, 16
+    r = np.random.RandomState(2)
+    ids_np = r.randint(0, V, n).astype(np.int32)
+    rows_np = r.randn(n, d).astype(np.float32)
+    ref = _scatter_ref(ids_np, rows_np, V)
+    scale = np.abs(ref).max()
+
+    with interpret_mode():
+        split = np.asarray(jax.jit(
+            emb_ops.scatter_add_dense, static_argnums=(2,)
+        )(jnp.asarray(ids_np), jnp.asarray(rows_np), V))
+    np.testing.assert_allclose(split / scale, ref / scale, atol=2e-5)
+
+    monkeypatch.setenv("EDL_EMB_PALLAS_PRECISION", "bf16")
+    with interpret_mode():
+        bf16 = np.asarray(jax.jit(
+            emb_ops.scatter_add_dense, static_argnums=(2,)
+        )(jnp.asarray(ids_np), jnp.asarray(rows_np), V))
+    np.testing.assert_allclose(bf16 / scale, ref / scale, atol=1e-2)
+    # and the split pass is measurably tighter than the bf16 one
+    assert (np.abs(split - ref).max() <= np.abs(bf16 - ref).max())

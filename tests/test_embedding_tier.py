@@ -1,0 +1,756 @@
+"""Elastic sharded embedding tier (elasticdl_tpu/embedding/): shard
+math, the deduped batched pull/push protocol, exactly-once pushes across
+retries and resharding, the journal-durable shard map, migration
+bit-exactness, checkpoint round trips, and the master RPC surface over
+real gRPC."""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding import reshard as reshard_lib
+from elasticdl_tpu.embedding import sharding, tier, transport
+from elasticdl_tpu.embedding.store import (
+    EmbeddingShardStore,
+    StaleShardMapError,
+    load_shard_file,
+    save_shard_file,
+)
+from elasticdl_tpu.embedding.transport import (
+    LocalTransport,
+    OwnerUnavailableError,
+)
+
+SPEC = sharding.TableSpec("users", vocab=4096, dim=8, seed=3)
+
+
+def make_tier(num_shards, owners, dedupe=True, tables=(SPEC,), device=False):
+    assignment = sharding.assign_round_robin(num_shards, owners)
+    view = sharding.ShardMapView(
+        version=1, num_shards=num_shards, owners=tuple(assignment),
+        tables=tuple(tables),
+    )
+    tr = LocalTransport()
+    stores = {}
+    for o in owners:
+        st = EmbeddingShardStore(o, device=device)
+        st.attach(view)
+        tr.register(st)
+        stores[o] = st
+    client = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="t0", dedupe=dedupe,
+        retry_backoff_s=0.001,
+    )
+    return view, tr, stores, client
+
+
+def full_table(view, tr, spec=SPEC):
+    out = np.zeros((spec.vocab, spec.dim), np.float32)
+    for s in range(view.num_shards):
+        rows = tr.store_of(view.owners[s]).extract_shard(spec.name, s)["rows"]
+        idx = np.arange(s, spec.vocab, view.num_shards)
+        out[idx] = rows[: len(idx)]
+    return out
+
+
+# ------------------------------------------------------------------ #
+# shard math
+
+
+def test_shard_math_round_trip():
+    ids = np.arange(0, 4096, 7)
+    s = sharding.shard_of(ids, 8)
+    l = sharding.local_rows(ids, 8)
+    np.testing.assert_array_equal(l * 8 + s, ids)
+    assert sharding.shard_row_count(4096, 8) == 512
+    assert sharding.shard_row_count(4097, 8) == 513
+
+
+def test_round_robin_balanced():
+    owners = sharding.assign_round_robin(8, [5, 3, 9])
+    counts = {o: owners.count(o) for o in (3, 5, 9)}
+    assert max(counts.values()) - min(counts.values()) <= 1
+
+
+def test_plan_moves_minimal_and_balanced():
+    current = sharding.assign_round_robin(8, [0, 1, 2, 3])
+    # nothing to do when the owner set is unchanged
+    assert sharding.plan_moves(current, [0, 1, 2, 3]) == []
+    # owner 3 leaves (alive): only ITS shards move, src stays the donor
+    moves = sharding.plan_moves(current, [0, 1, 2])
+    assert {m.shard for m in moves} == {
+        s for s, o in enumerate(current) if o == 3}
+    assert all(m.src == 3 for m in moves)
+    new = sharding.apply_moves_to_assignment(current, moves)
+    counts = [new.count(o) for o in (0, 1, 2)]
+    assert max(counts) - min(counts) <= 1
+    # a DEAD owner's shards carry src=-1 (restore moves)
+    dead_moves = sharding.plan_moves(current, [0, 1, 2], dead=[3])
+    assert all(m.src == -1 for m in dead_moves)
+    # deterministic: same inputs, same plan
+    assert sharding.plan_moves(current, [0, 1, 2]) == moves
+
+
+def test_plan_moves_grow_rebalances_within_one():
+    current = [0] * 8          # everything piled on worker 0
+    moves = sharding.plan_moves(current, [0, 1])
+    new = sharding.apply_moves_to_assignment(current, moves)
+    assert abs(new.count(0) - new.count(1)) <= 1
+    # the shards that stayed put did not move
+    assert all(m.src == 0 for m in moves)
+
+
+# ------------------------------------------------------------------ #
+# store
+
+
+@pytest.mark.parametrize("device", [False, True])
+def test_store_pull_push_matches_reference(device):
+    view, tr, stores, client = make_tier(4, [0, 1], device=device)
+    r = np.random.RandomState(0)
+    ids = r.randint(0, SPEC.vocab, (64, 3))
+    before = full_table(view, tr)
+    vecs = client.pull("users", ids)
+    np.testing.assert_allclose(
+        vecs.reshape(-1, 8), before[ids.reshape(-1)], rtol=1e-6)
+    grads = r.rand(64, 3, 8).astype(np.float32)
+    client.push("users", ids, grads, scale=-0.5)
+    expected = before.copy()
+    np.add.at(expected, ids.reshape(-1), -0.5 * grads.reshape(-1, 8))
+    np.testing.assert_allclose(
+        full_table(view, tr), expected, rtol=1e-5, atol=1e-6)
+
+
+def test_store_exactly_once_sequence_fence():
+    view, tr, stores, _ = make_tier(1, [0])
+    st = stores[0]
+    ids = np.array([1, 2], np.int32)
+    rows = np.ones((2, 8), np.float32)
+    assert st.push("users", 0, ids, rows, client_id="c", seq=1) is True
+    before = st.extract_shard("users", 0)["rows"].copy()
+    # duplicate and stale seqs are acked but never applied
+    assert st.push("users", 0, ids, rows, client_id="c", seq=1) is False
+    assert st.push("users", 0, ids, rows, client_id="c", seq=0) is False
+    np.testing.assert_array_equal(
+        st.extract_shard("users", 0)["rows"], before)
+    # a DIFFERENT client's seq 1 is its own fence
+    assert st.push("users", 0, ids, rows, client_id="c2", seq=1) is True
+
+
+def test_store_stale_map_and_missing_shard_reject():
+    view, tr, stores, _ = make_tier(2, [0])
+    st = stores[0]
+    with pytest.raises(StaleShardMapError):
+        st.pull("users", 0, np.array([0], np.int32), map_version=99)
+    with pytest.raises(StaleShardMapError):
+        st.pull("users", 77, np.array([0], np.int32), map_version=1)
+
+
+def test_store_padding_sentinels_drop():
+    view, tr, stores, _ = make_tier(1, [0])
+    st = stores[0]
+    before = st.extract_shard("users", 0)["rows"].copy()
+    rows = st.pull(
+        "users", 0, np.array([-1, 0, 10 ** 6], np.int32), map_version=1)
+    assert np.all(rows[0] == 0) and np.all(rows[2] == 0)
+    np.testing.assert_allclose(rows[1], before[0])
+    st.push(
+        "users", 0, np.array([-1, 3, 10 ** 6], np.int32),
+        np.ones((3, 8), np.float32), client_id="c", seq=1,
+    )
+    after = st.extract_shard("users", 0)["rows"]
+    np.testing.assert_allclose(after[3], before[3] + 1.0)
+    changed = np.abs(after - before).sum(axis=1) > 0
+    assert changed.sum() == 1     # ONLY row 3 moved
+
+
+def test_deterministic_shard_init():
+    a = EmbeddingShardStore(0, device=False)
+    b = EmbeddingShardStore(7, device=False)
+    view = sharding.ShardMapView(
+        version=1, num_shards=4,
+        owners=(0, 0, 0, 0), tables=(SPEC,))
+    view_b = sharding.ShardMapView(
+        version=1, num_shards=4,
+        owners=(7, 7, 7, 7), tables=(SPEC,))
+    a.attach(view)
+    b.attach(view_b)
+    for s in range(4):
+        np.testing.assert_array_equal(
+            a.extract_shard("users", s)["rows"],
+            b.extract_shard("users", s)["rows"],
+        )
+
+
+# ------------------------------------------------------------------ #
+# client protocol
+
+
+def test_client_pull_unique_inverse_expansion():
+    view, tr, stores, client = make_tier(4, [0, 1])
+    ids = np.array([[5, 5, -1], [9, 5, 4096]])   # dups + padding + OOB
+    rows, inverse, uniq = client.pull_unique("users", ids)
+    assert rows.shape[0] == uniq.shape[0]
+    # sentinel slot is the LAST unique row and is zero
+    assert uniq[-1] == -1 and np.all(rows[-1] == 0)
+    full = rows[inverse.reshape(-1)].reshape(2, 3, 8)
+    np.testing.assert_allclose(full, client.pull("users", ids))
+
+
+def test_client_push_dedupe_ratio_and_traffic():
+    view, tr, stores, client = make_tier(4, [0, 1])
+    before = full_table(view, tr)
+    ids = np.full((32,), 7, np.int64)            # all-duplicate batch
+    stats = client.push(
+        "users", ids, np.ones((32, 8), np.float32), scale=1.0)
+    assert stats["ids_sent"] == 1
+    assert stats["dedupe_ratio"] == pytest.approx(1 / 32, abs=1e-4)
+    # duplicate grads SUMMED (sparse-gradient semantics): ONE wire row
+    # carrying the 32-fold sum
+    np.testing.assert_allclose(
+        full_table(view, tr)[7], before[7] + 32.0, rtol=1e-6)
+
+
+def test_client_push_duplicates_sum():
+    view, tr, stores, client = make_tier(2, [0])
+    before = full_table(view, tr)
+    ids = np.array([7, 7, 7, 9], np.int64)
+    grads = np.stack([np.full((8,), g, np.float32) for g in (1, 2, 3, 4)])
+    client.push("users", ids, grads, scale=1.0)
+    after = full_table(view, tr)
+    np.testing.assert_allclose(after[7], before[7] + 6.0, rtol=1e-6)
+    np.testing.assert_allclose(after[9], before[9] + 4.0, rtol=1e-6)
+
+
+class _LostAckOnce:
+    """Transport wrapper: ONE push applies but its ack is lost."""
+
+    def __init__(self, inner, lose_seq):
+        self._inner = inner
+        self._lose_seq = lose_seq
+        self.lost = 0
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def push(self, *args, **kwargs):
+        applied = self._inner.push(*args, **kwargs)
+        if kwargs.get("seq") == self._lose_seq and not self.lost:
+            self.lost += 1
+            raise OwnerUnavailableError("injected lost ack")
+        return applied
+
+
+def test_client_push_exactly_once_across_lost_ack():
+    view, tr, stores, _ = make_tier(4, [0, 1])
+    lossy = _LostAckOnce(tr, lose_seq=1)
+    client = tier.EmbeddingTierClient(
+        lambda: view, lossy, client_id="t0", retry_backoff_s=0.001)
+    before = full_table(view, tr)
+    ids = np.arange(0, 64, dtype=np.int64)
+    grads = np.ones((64, 8), np.float32)
+    stats = client.push("users", ids, grads, scale=1.0)
+    assert lossy.lost == 1
+    assert stats["ids_sent"] == 64
+    # applied EXACTLY once despite the retried shard round
+    np.testing.assert_allclose(
+        full_table(view, tr)[:64], before[:64] + 1.0, rtol=1e-6)
+
+
+def test_client_push_gives_up_after_retries():
+    view, tr, stores, client = make_tier(2, [0])
+    tr.deregister(0)
+    with pytest.raises(OwnerUnavailableError):
+        client.push(
+            "users", np.array([1]), np.ones((1, 8), np.float32))
+
+
+# ------------------------------------------------------------------ #
+# ShardMapOwner + journal durability
+
+
+def test_owner_bootstrap_begin_confirm_commit(tmp_path):
+    from elasticdl_tpu.master.journal import ControlPlaneJournal, replay_lines
+
+    j = ControlPlaneJournal(str(tmp_path))
+    owner = sharding.ShardMapOwner(8, journal=j)
+    owner.register_table(SPEC)
+    owner.register_table(SPEC)      # idempotent re-register
+    view = owner.bootstrap([10, 11, 12])
+    assert view.version == 1 and not view.resharding
+    view2, moves = owner.begin_resharding([10, 11], dead=[12])
+    assert view2.version == 2 and view2.resharding
+    assert all(m.src == -1 for m in moves)
+    # partial confirm: still in flight
+    owner.confirm_moves(2, [moves[0].shard])
+    assert owner.view().resharding
+    owner.confirm_moves(2, [m.shard for m in moves[1:]])
+    final = owner.view()
+    assert final.version == 2 and not final.resharding
+    j.close()
+    with open(j.path) as f:
+        replayed = replay_lines(f.readlines())
+    emb = replayed.embedding
+    assert emb is not None
+    assert emb.version == 2
+    assert list(emb.owners) == list(final.owners)
+    assert not emb.reshard_interrupted
+    assert any(t["name"] == "users" for t in emb.tables)
+
+
+def test_owner_interrupted_resharding_rolls_back(tmp_path):
+    """Master killed mid-resharding: replay lands on the last COMMITTED
+    map with the interruption flagged (clients requeue in-flight
+    pushes), and a successor owner restores that state."""
+    from elasticdl_tpu.master.journal import ControlPlaneJournal, replay_lines
+
+    j = ControlPlaneJournal(str(tmp_path))
+    owner = sharding.ShardMapOwner(8, journal=j)
+    committed = owner.bootstrap([10, 11, 12])
+    owner.begin_resharding([10, 11], dead=[12])
+    j.abort()                       # SIGKILL-shaped: no commit record
+    with open(j.path) as f:
+        replayed = replay_lines(f.readlines())
+    emb = replayed.embedding
+    assert emb.reshard_interrupted is True
+    assert emb.version == committed.version
+    assert list(emb.owners) == list(committed.owners)
+    # successor master adopts the rolled-back map and advertises the
+    # interruption until its next committed transition
+    successor = sharding.ShardMapOwner(8)
+    successor.restore_from_replay(emb)
+    view = successor.view()
+    assert view.version == committed.version
+    assert view.owners == committed.owners
+    assert view.resharding is True  # conservative requeue signal
+    # and the successor can re-plan cleanly
+    view2, moves = successor.begin_resharding([10, 11], dead=[12])
+    assert view2.version == committed.version + 1 and moves
+
+
+def test_owner_stale_and_duplicate_confirms():
+    owner = sharding.ShardMapOwner(4)
+    owner.bootstrap([1, 2])
+    view, moves = owner.begin_resharding([1], dead=[2])
+    shards = [m.shard for m in moves]
+    assert owner.confirm_moves(view.version, shards) is True
+    # re-confirm after commit: idempotent accept
+    assert owner.confirm_moves(view.version, shards) is True
+    # a claim for a FUTURE version is rejected
+    assert owner.confirm_moves(view.version + 5, [0]) is False
+
+
+# ------------------------------------------------------------------ #
+# resharding execution
+
+
+def test_apply_moves_live_donor_bit_exact_and_release():
+    view, tr, stores, client = make_tier(8, [0, 1, 2])
+    r = np.random.RandomState(1)
+    ids = r.randint(0, SPEC.vocab, 256)
+    client.push("users", ids, r.rand(256, 8).astype(np.float32), scale=-0.1)
+    before = full_table(view, tr)
+    moves = sharding.plan_moves(list(view.owners), [0, 1])
+    new_owners = sharding.apply_moves_to_assignment(view.owners, moves)
+    view2 = sharding.ShardMapView(
+        version=2, num_shards=8, owners=tuple(new_owners), tables=(SPEC,))
+    confirmed = []
+    stats = reshard_lib.apply_moves(
+        view2, moves, tr, confirm=lambda v, s: confirmed.append((v, list(s))))
+    assert stats["payloads_transferred"] == len(moves)
+    assert confirmed == [(2, [m.shard for m in moves])]
+    np.testing.assert_array_equal(full_table(view2, tr), before)
+    assert stores[2].resident_shards() == []      # donor released
+    # every surviving store adopted the new map version
+    assert stores[0].map_version == 2 and stores[1].map_version == 2
+
+
+def test_apply_moves_dead_donor_checkpoint_restore(tmp_path):
+    view, tr, stores, client = make_tier(4, [0, 1])
+    r = np.random.RandomState(2)
+    ids = r.randint(0, SPEC.vocab, 128)
+    client.push("users", ids, r.rand(128, 8).astype(np.float32), scale=-0.1)
+    before = full_table(view, tr)
+    # planned kill: owner 1 drains, then disappears
+    assert stores[1].save(str(tmp_path)) == len(stores[1].resident_shards())
+    tr.deregister(1)
+    moves = sharding.plan_moves(list(view.owners), [0], dead=[1])
+    view2 = sharding.ShardMapView(
+        version=2, num_shards=4,
+        owners=tuple(sharding.apply_moves_to_assignment(view.owners, moves)),
+        tables=(SPEC,))
+    stats = reshard_lib.apply_moves(
+        view2, moves, tr, checkpoint_dir=str(tmp_path))
+    assert stats["payloads_restored"] == len(moves)
+    np.testing.assert_array_equal(full_table(view2, tr), before)
+
+
+def test_apply_moves_seed_fallback_warns():
+    """No checkpoint, donor dead: the shard re-materializes from seed —
+    bit-exact against a never-pushed twin."""
+    view, tr, stores, _ = make_tier(4, [0, 1])
+    pristine = full_table(view, tr)
+    tr.deregister(1)
+    moves = sharding.plan_moves(list(view.owners), [0], dead=[1])
+    view2 = sharding.ShardMapView(
+        version=2, num_shards=4,
+        owners=tuple(sharding.apply_moves_to_assignment(view.owners, moves)),
+        tables=(SPEC,))
+    reshard_lib.apply_moves(view2, moves, tr)
+    np.testing.assert_array_equal(full_table(view2, tr), pristine)
+
+
+def test_exactly_once_watermarks_travel_with_shard(tmp_path):
+    """A push acked by the OLD owner must dedupe at the NEW owner after
+    the shard migrates (the seq watermark is part of the payload)."""
+    view, tr, stores, _ = make_tier(2, [0, 1])
+    st_src = tr.store_of(view.owners[0])
+    ids = np.array([0, 1], np.int32)
+    rows = np.ones((2, 8), np.float32)
+    assert st_src.push("users", 0, ids, rows, client_id="c", seq=5)
+    moves = [sharding.ShardMove(shard=0, src=view.owners[0],
+                                dst=view.owners[1])]
+    view2 = sharding.ShardMapView(
+        version=2, num_shards=2,
+        owners=(view.owners[1], view.owners[1]), tables=(SPEC,))
+    reshard_lib.apply_moves(view2, moves, tr)
+    st_dst = tr.store_of(view.owners[1])
+    # the re-sent (requeued) push is a no-op at the new owner
+    assert st_dst.push("users", 0, ids, rows, client_id="c", seq=5) is False
+    assert st_dst.push("users", 0, ids, rows, client_id="c", seq=6) is True
+
+
+# ------------------------------------------------------------------ #
+# shard files / checkpoint round trip
+
+
+def test_shard_file_round_trip(tmp_path):
+    payload = {
+        "rows": np.random.RandomState(3).rand(16, 8).astype(np.float32),
+        "applied": {"w1": 12, "w2": 7},
+    }
+    save_shard_file(str(tmp_path), "users", 3, payload)
+    loaded = load_shard_file(str(tmp_path), "users", 3)
+    np.testing.assert_array_equal(loaded["rows"], payload["rows"])
+    assert loaded["applied"] == payload["applied"]
+    assert load_shard_file(str(tmp_path), "users", 4) is None
+
+
+def test_shard_file_torn_write_ignored(tmp_path):
+    path = save_shard_file(
+        str(tmp_path), "users", 0,
+        {"rows": np.zeros((4, 8), np.float32), "applied": {}})
+    with open(path, "wb") as f:
+        f.write(b"torn")
+    assert load_shard_file(str(tmp_path), "users", 0) is None
+
+
+def test_checkpoint_manager_tier_round_trip(tmp_path):
+    from elasticdl_tpu.training.checkpoint import CheckpointManager
+
+    view, tr, stores, client = make_tier(4, [0, 1])
+    ids = np.arange(64, dtype=np.int64)
+    client.push("users", ids, np.ones((64, 8), np.float32), scale=0.25)
+    before = full_table(view, tr)
+    mngr = CheckpointManager(str(tmp_path))
+    saved = sum(mngr.save_embedding_tier(st) for st in stores.values())
+    assert saved == 4
+    # a fresh owner restores every checkpointed shard it now owns
+    fresh = EmbeddingShardStore(0, device=False)
+    solo = sharding.ShardMapView(
+        version=2, num_shards=4, owners=(0, 0, 0, 0), tables=(SPEC,))
+    fresh.attach(solo, checkpoint_dir=str(tmp_path))
+    tr2 = LocalTransport()
+    tr2.register(fresh)
+    np.testing.assert_array_equal(full_table(solo, tr2), before)
+    mngr.close()
+
+
+# ------------------------------------------------------------------ #
+# master RPC surface (real gRPC) + WorkerTierRuntime
+
+
+@pytest.fixture()
+def tier_master(tmp_path):
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench as bench_mod  # reuse the leg's master harness
+
+    m = bench_mod._et_master(str(tmp_path), 8)
+    yield m
+    try:
+        m["server"].stop(None)
+    finally:
+        if m["journal"]._fh is not None:
+            m["journal"].close()
+
+
+def test_shard_map_rpcs_and_runtime_reshard(tier_master, tmp_path):
+    """End to end over real gRPC: register owners, fetch the map
+    (lazy bootstrap), kill one, survivors install + confirm via
+    ReportEmbeddingReshard, the map commits, and a previously-acked
+    push dedupes at the new owner."""
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import MasterStub, make_channel
+
+    m = tier_master
+    m["owner"].register_table(SPEC)
+    channel = make_channel(f"localhost:{m['port']}")
+    stub = MasterStub(channel)
+    # no workers yet: no map to serve
+    assert stub.GetEmbeddingShardMap(
+        pb.GetEmbeddingShardMapRequest(worker_id=0)).version == 0
+    wids = [
+        stub.RegisterWorker(
+            pb.RegisterWorkerRequest(worker_name=f"w{i}")).worker_id
+        for i in range(3)
+    ]
+    shared = LocalTransport()
+    runtimes = {
+        w: tier.WorkerTierRuntime(
+            stub, w, checkpoint_dir=str(tmp_path), transport=shared)
+        for w in wids
+    }
+    view = runtimes[wids[0]].client.view
+    assert view.version == 1 and view.num_shards == 8
+    assert {t.name for t in view.tables} == {"users"}
+    client = runtimes[wids[0]].client
+    ids = np.arange(128, dtype=np.int64)
+    client.push("users", ids, np.ones((128, 8), np.float32), scale=0.5)
+    before = full_table(view, shared)
+
+    victim = wids[-1]
+    runtimes[victim].drain()
+    shared.deregister(victim)
+    m["membership"].mark_dead(victim, reason="test")
+    # the Master wiring reacts via the death callback (bench harness
+    # wires the same shape as master/main.py)
+    assert m["owner"].view().resharding
+    for w in wids[:-1]:
+        runtimes[w].on_world_change()
+    final = m["owner"].view()
+    assert not final.resharding and final.version == 2
+    assert victim not in set(final.owners)
+    np.testing.assert_array_equal(full_table(final, shared), before)
+    # the tier serves again under the committed map: a fresh push lands
+    post = client.push(
+        "users", ids, np.ones((128, 8), np.float32), scale=0.5)
+    assert post["ids_sent"] == 128
+    for rt in runtimes.values():
+        rt.close()
+
+
+def test_runtime_concurrent_pulls_during_push():
+    """The per-shard leaf locks: concurrent pulls against a store being
+    pushed to never tear (each pull sees some complete pre/post state)."""
+    view, tr, stores, client = make_tier(2, [0])
+    ids = np.arange(32, dtype=np.int64)
+    stop = threading.Event()
+    errs = []
+
+    def puller():
+        while not stop.is_set():
+            try:
+                v = client.pull("users", ids)
+                assert v.shape == (32, 8)
+            except Exception as e:  # pragma: no cover - fails the test
+                errs.append(e)
+                return
+
+    t = threading.Thread(target=puller)
+    t.start()
+    try:
+        for seq in range(20):
+            client.push(
+                "users", ids, np.ones((32, 8), np.float32), scale=0.01)
+    finally:
+        stop.set()
+        t.join()
+    assert not errs
+
+
+# ------------------------------------------------------------------ #
+# session + TierEmbedding (the training integration)
+
+
+def test_session_step_grads_match_dense_reference(mesh8):
+    """The deduped end-to-end training step: grads w.r.t. the UNIQUE
+    pulled rows, expanded in-step via TierEmbedding's `inverse` input,
+    pushed back as tier-side SGD — must equal a dense reference update
+    (same ids may repeat in the batch; autodiff sums their grads)."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.api.layers import TierEmbedding
+
+    view, tr, stores, client = make_tier(4, [0, 1])
+    session = tier.EmbeddingTierSession(client, {"users": "cat"})
+    ids = np.array([[1, 1, 5], [9, 5, 2]], np.int64)
+    batch = {"cat": ids, "y": np.ones((2,), np.float32)}
+    before = full_table(view, tr)
+
+    layer = TierEmbedding(output_dim=8, combiner="sum")
+
+    def loss_fn(vectors, inverses, batch):
+        pooled = layer.apply(
+            {}, vectors["users"], jnp.asarray(batch["cat"], jnp.int32),
+            inverse=inverses["users"],
+        )
+        return jnp.sum(pooled ** 2)
+
+    loss, stats = session.step(loss_fn, batch, lr=0.1)
+    assert loss > 0
+    assert stats["users"]["ids_sent"] == 4    # uniq {1,2,5,9}
+
+    # dense reference: d/dtable sum(combine(table[ids])**2)
+    import jax
+
+    tab = jnp.asarray(before)
+
+    def dense_loss(t):
+        vec = jnp.take(t, jnp.asarray(ids, jnp.int32), axis=0)
+        return jnp.sum(jnp.sum(vec, axis=1) ** 2)
+
+    g = jax.grad(dense_loss)(tab)
+    expected = before - 0.1 * np.asarray(g)
+    np.testing.assert_allclose(
+        full_table(view, tr), expected, rtol=1e-4, atol=1e-5)
+
+
+def test_tier_embedding_layer_matches_embedding_combiners(mesh8):
+    """TierEmbedding(vectors, ids) must reproduce Embedding's combiner
+    semantics (padding slots masked) given the same vectors."""
+    import jax.numpy as jnp
+
+    from elasticdl_tpu.api.layers import TierEmbedding
+    from elasticdl_tpu.ops import embedding as emb_ops
+
+    r = np.random.RandomState(0)
+    ids = np.array([[1, 2, -1], [3, -1, -1]], np.int32)
+    vecs = r.rand(2, 3, 8).astype(np.float32)
+    for combiner in (None, "sum", "mean", "sqrtn"):
+        layer = TierEmbedding(output_dim=8, combiner=combiner)
+        got = layer.apply({}, jnp.asarray(vecs), jnp.asarray(ids))
+        want = emb_ops.combine(
+            jnp.asarray(vecs), combiner, jnp.asarray(ids))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_tier_table_spec_matches_hbm_geometry():
+    from elasticdl_tpu.api.layers import tier_table_spec
+    from elasticdl_tpu.ops import embedding as emb_ops
+
+    spec = tier_table_spec("users", 1000, 16)
+    assert spec.vocab == emb_ops.padded_vocab(1000)
+    assert spec.dim == 16
+
+
+# ------------------------------------------------------------------ #
+# process-local default transport wiring
+
+
+def test_default_transport_is_shared(monkeypatch):
+    monkeypatch.setattr(tier, "_default_transport", None)
+    a = tier.default_transport()
+    b = tier.default_transport()
+    assert a is b and isinstance(a, transport.LocalTransport)
+
+
+def test_config_flag_validates():
+    from elasticdl_tpu.common.config import JobConfig
+
+    cfg = JobConfig(model_def="mnist.mnist_cnn.custom_model",
+                    embedding_shards=8)
+    cfg.validate()
+    bad = JobConfig(model_def="mnist.mnist_cnn.custom_model",
+                    embedding_shards=-1)
+    with pytest.raises(ValueError, match="embedding_shards"):
+        bad.validate()
+
+
+# ------------------------------------------------------------------ #
+# review-hardening regressions (PR 10 code review)
+
+
+def test_relaunched_client_incarnation_escapes_old_watermarks():
+    """A relaunched worker's client must NOT have its first pushes
+    swallowed by watermarks a previous incarnation left behind (they
+    survive drains and migrations): client ids are incarnation-scoped."""
+    view, tr, stores, client1 = make_tier(2, [0])
+    ids = np.arange(8, dtype=np.int64)
+    grads = np.ones((8, 8), np.float32)
+    for _ in range(3):                      # watermark reaches seq 3
+        client1.push("users", ids, grads, scale=0.1)
+    before = full_table(view, tr)
+    # "relaunch": a fresh client with the SAME base identity
+    client2 = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="t0", retry_backoff_s=0.001)
+    assert client2.client_id != client1.client_id
+    client2.push("users", ids, grads, scale=0.1)   # its seq 1 must LAND
+    np.testing.assert_allclose(
+        full_table(view, tr)[:8], before[:8] + 0.1, rtol=1e-5)
+
+
+
+
+def test_shard_init_uses_stable_digest_not_salted_hash():
+    """Shard materialization must not depend on Python's per-process
+    salted str hash (the determinism claim is CROSS-process)."""
+    import zlib
+
+    from elasticdl_tpu.embedding.store import _init_shard_rows
+
+    rows = _init_shard_rows(SPEC, 2, 4)
+    seq = np.random.SeedSequence(
+        [SPEC.seed, zlib.crc32(SPEC.name.encode()), 2])
+    expect = np.random.default_rng(seq).uniform(
+        -SPEC.init_scale, SPEC.init_scale,
+        (sharding.shard_row_count(SPEC.vocab, 4), SPEC.dim),
+    ).astype(np.float32)
+    first_dead = -(-max(0, SPEC.vocab - 2) // 4)
+    expect[first_dead:] = 0.0
+    np.testing.assert_array_equal(rows, expect)
+
+
+def test_apply_moves_never_clobbers_resident_shard(tmp_path):
+    """A recovery install where one table's shard is LIVE (has absorbed
+    pushes) and another's is missing must only install the missing one —
+    re-running a plan must not roll a live shard back to checkpoint."""
+    spec_b = sharding.TableSpec("items", vocab=4096, dim=8, seed=9)
+    view, tr, stores, client = make_tier(2, [0], tables=(SPEC, spec_b))
+    # both tables drained at T0
+    stores[0].save(str(tmp_path))
+    # then table "users" absorbs a push the checkpoint does NOT hold
+    ids = np.arange(16, dtype=np.int64)
+    client.push("users", ids, np.ones((16, 8), np.float32), scale=1.0)
+    live = full_table(view, tr, SPEC)
+    # drop ONLY table "items"'s shard 0 (simulates a partially-installed
+    # recovery) and re-run the whole move against the checkpoint
+    stores[0].release_shard("items", 0)
+    moves = [sharding.ShardMove(shard=0, src=-1, dst=0)]
+    reshard_lib.apply_moves(
+        view, moves, tr, checkpoint_dir=str(tmp_path))
+    # "items" came back from the checkpoint; "users" kept its live rows
+    assert ("items", 0) in stores[0].resident_shards()
+    np.testing.assert_array_equal(full_table(view, tr, SPEC), live)
+
+
+def test_store_counters_exclude_padding_sentinels():
+    from elasticdl_tpu.embedding import store as store_lib
+
+    view, tr, stores, _ = make_tier(1, [0])
+    st = stores[0]
+    base_pull = store_lib._PULLED.value(table="users")
+    base_push = store_lib._PUSHED.value(table="users")
+    padded = np.full((256,), -1, np.int32)
+    padded[:10] = np.arange(10)
+    st.pull("users", 0, padded, map_version=1)
+    st.push("users", 0, padded, np.ones((256, 8), np.float32),
+            client_id="c", seq=1)
+    assert store_lib._PULLED.value(table="users") - base_pull == 10
+    assert store_lib._PUSHED.value(table="users") - base_push == 10

@@ -789,3 +789,185 @@ def test_process_manager_stop_flushes_newest_world_version(tmp_path):
         assert replay_lines(lines).world_version == 41
     finally:
         j.close()
+
+
+# ---------------------------------------------------------------------- #
+# embedding tier shard-map records (ISSUE 10): begin-without-commit
+# rolls back; commit promotes; snapshot rotation carries the map
+
+
+def test_emb_records_replay_committed_map():
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "emb_table", "name": "users", "vocab": 1024,
+                    "dim": 8, "seed": 3, "init_scale": 0.05}),
+        json.dumps({"t": "emb_shard_map", "version": 1, "num_shards": 4,
+                    "owners": [0, 1, 0, 1]}),
+        json.dumps({"t": "emb_reshard_begin", "version": 2,
+                    "owners": [0, 0, 0, 0],
+                    "moves": [{"shard": 1, "src": 1, "dst": 0},
+                              {"shard": 3, "src": 1, "dst": 0}]}),
+        json.dumps({"t": "emb_reshard_commit", "version": 2}),
+    ]
+    emb = replay_lines(lines).embedding
+    assert emb.version == 2
+    assert emb.owners == [0, 0, 0, 0]
+    assert emb.num_shards == 4
+    assert not emb.reshard_interrupted
+    assert emb.tables[0]["name"] == "users"
+
+
+def test_emb_reshard_begin_without_commit_rolls_back():
+    """Master killed mid-resharding: the replayed map is the last
+    COMMITTED one, flagged interrupted so clients conservatively requeue
+    in-flight pushes (store seq fencing dedupes the re-sends)."""
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "emb_shard_map", "version": 1, "num_shards": 4,
+                    "owners": [0, 1, 0, 1]}),
+        json.dumps({"t": "emb_reshard_begin", "version": 2,
+                    "owners": [0, 0, 0, 0],
+                    "moves": [{"shard": 1, "src": 1, "dst": 0}]}),
+    ]
+    emb = replay_lines(lines).embedding
+    assert emb.version == 1
+    assert emb.owners == [0, 1, 0, 1]
+    assert emb.reshard_interrupted is True
+
+
+def test_emb_commit_without_begin_is_ignored():
+    lines = [
+        json.dumps({"t": "header", "v": 1, "generation": 1}),
+        json.dumps({"t": "emb_shard_map", "version": 1, "num_shards": 2,
+                    "owners": [0, 0]}),
+        json.dumps({"t": "emb_reshard_commit", "version": 9}),
+    ]
+    emb = replay_lines(lines).embedding
+    assert emb.version == 1 and emb.owners == [0, 0]
+
+
+def test_emb_snapshot_rotation_round_trip(tmp_path):
+    """A second takeover restores the map from the FIRST takeover's
+    compacted snapshot (no raw records left), interrupted flag included."""
+    j1 = ControlPlaneJournal(str(tmp_path))
+    j1.append("emb_table", name="users", vocab=1024, dim=8, seed=0,
+              init_scale=0.05)
+    j1.append("emb_shard_map", version=1, num_shards=4,
+              owners=[0, 1, 0, 1])
+    j1.append("emb_reshard_begin", version=2, owners=[0, 0, 0, 0],
+              moves=[{"shard": 1, "src": 1, "dst": 0}])
+    j1.abort()                                  # crash mid-resharding
+    j2 = ControlPlaneJournal(str(tmp_path))     # takeover 1: replays
+    emb = j2.embedding_snapshot()
+    assert emb.reshard_interrupted and emb.version == 1
+    j2.close()
+    j3 = ControlPlaneJournal(str(tmp_path))     # takeover 2: snapshot only
+    emb2 = j3.embedding_snapshot()
+    assert emb2.version == 1
+    assert emb2.owners == [0, 1, 0, 1]
+    assert emb2.reshard_interrupted is True
+    assert emb2.tables[0]["name"] == "users"
+    j3.close()
+
+
+def test_emb_torn_begin_line_drops_whole(tmp_path):
+    """A torn emb_reshard_begin tail is dropped whole — the replay sees
+    only the committed map, with no interruption to flag."""
+    j = ControlPlaneJournal(str(tmp_path))
+    j.append("emb_shard_map", version=1, num_shards=2, owners=[0, 0])
+    j.close()
+    with open(j.path, "a", encoding="utf-8") as f:
+        f.write('{"t": "emb_reshard_begin", "version": 2, "own')
+    with open(j.path, encoding="utf-8") as f:
+        res = replay_lines(f.readlines())
+    assert res.dropped_lines == 1
+    assert res.embedding.version == 1
+    assert res.embedding.reshard_interrupted is False
+
+
+# ---------------------------------------------------------------------- #
+# ProcessManager world_version crash consistency (ISSUE 10 satellite:
+# the PR 7 known boundary closed for real — commit awaited outside the
+# lock, BEFORE the version becomes observable)
+
+
+class _FakeProc:
+    pid = 4242
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        pass
+
+    def wait(self, timeout=None):
+        return 0
+
+
+def _reform_manager(tmp_path, journal, monkeypatch):
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.master import process_manager as pm
+
+    monkeypatch.setattr(
+        pm.ProcessManager, "_spawn",
+        lambda self, worker_id, relaunches=0, process_id=0: pm._WorkerProc(
+            worker_id=worker_id, proc=_FakeProc(), relaunches=relaunches,
+        ),
+    )
+    cfg = JobConfig(model_def="mnist.mnist_cnn.custom_model",
+                    master_addr="localhost:1", num_processes=2)
+    sig = str(tmp_path / "membership_signal.json")
+    return pm.ProcessManager(
+        cfg, journal=journal, membership_signal_path=sig), sig
+
+
+def test_reform_world_version_durable_before_announce(
+    tmp_path, monkeypatch
+):
+    """Group-commit mode: _reform_cohort must fsync the world_version
+    record BEFORE the announcement (or any spawned env) can carry it —
+    after the reform returns, a successor's replay of the journal file
+    as-is must already hold the announced version."""
+    j = ControlPlaneJournal(
+        str(tmp_path / "ckpt"), group_commit_ms=5.0)
+    manager, sig = _reform_manager(tmp_path, j, monkeypatch)
+    try:
+        manager._reform_cohort(2, 2, "test")
+        announced = membership_signal.read_signal(sig)["world_version"]
+        assert announced == 1
+        # the journal FILE (not a flushed/closed copy) already carries it
+        with open(j.path, encoding="utf-8") as f:
+            assert replay_lines(f.readlines()).world_version == announced
+    finally:
+        j.close()
+
+
+def test_reform_never_announces_undurable_world_version(
+    tmp_path, monkeypatch
+):
+    """The crash-consistency pin: when the commit CANNOT be made durable
+    (committer finds the journal wedged/closed), the reform aborts
+    un-announced — an announced world version can never be one a
+    successor's replay lacks."""
+    import pytest as _pytest
+
+    from elasticdl_tpu.master.journal import JournalCommitError
+
+    j = ControlPlaneJournal(
+        str(tmp_path / "ckpt"), group_commit_ms=5.0)
+    manager, sig = _reform_manager(tmp_path, j, monkeypatch)
+    before = membership_signal.read_signal(sig)
+    # wedge the journal under the committer: flush fails -> poisoned ->
+    # the parked commit's wait() raises
+    with j._lock:
+        j._fh.close()
+        j._fh = None
+    with _pytest.raises(JournalCommitError):
+        manager._reform_cohort(2, 2, "test")
+    after = membership_signal.read_signal(sig)
+    # nothing announced, nothing spawned
+    assert (after or {}).get("world_version") == (
+        (before or {}).get("world_version")
+    )
+    with manager._lock:
+        assert not manager._procs
