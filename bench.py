@@ -808,10 +808,10 @@ def bench_rescale(mesh, np):
 
 
 def bench_observability_overhead(mesh, np):
-    """Recorder+profiler overhead gate (ISSUE 9): the same jitted train
-    step measured per-step with the always-on observability hot-path
-    instrumentation OFF vs ON. The ON leg mirrors (and slightly
-    over-states) what a real worker step pays:
+    """Recorder+profiler overhead gate (ISSUE 9, extended by ISSUE 11):
+    the same jitted train step measured per-step with the always-on
+    observability hot-path instrumentation OFF vs ON. The ON leg mirrors
+    (and slightly over-states) what a real worker step pays:
 
     - step profiler: a data_wait attribution + the compute add +
       step_done() rolling-window update (observability/profile.py);
@@ -819,7 +819,14 @@ def bench_observability_overhead(mesh, np):
     - flight ring: the tracer sink attached AND one explicit ring record
       per step (the real worker records nothing per step — spans stay at
       task granularity per EDL404 — so this bounds the ring cost from
-      above).
+      above);
+    - time-series ring (ISSUE 11): a maybe_sample() per step against a
+      short interval, so real registry snapshots land during the run
+      (the real worker samples from its heartbeat thread — per-step
+      polling over-states the cost on purpose);
+    - skew sketch (ISSUE 11): a Space-Saving update_batch over a
+      pre-deduped zipf id chunk per step — the per-pull cost a tier
+      worker pays (embedding/sketch.py).
 
     Emits median/p90 per-step wall time for both modes and
     `overhead_pct` = (on - off) / off over the medians; acceptance: <= 2%.
@@ -827,9 +834,11 @@ def bench_observability_overhead(mesh, np):
     cost is the measurand — amortizing through train_many would hide it.
     """
     from elasticdl_tpu.common.model_utils import load_module
+    from elasticdl_tpu.embedding.sketch import SpaceSaving
     from elasticdl_tpu.observability import flight as flight_lib
     from elasticdl_tpu.observability import profile as profile_lib
     from elasticdl_tpu.observability.health import WorkerStepStats
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
     from elasticdl_tpu.training.model_spec import ModelSpec
     from elasticdl_tpu.training.trainer import Trainer
 
@@ -857,11 +866,27 @@ def bench_observability_overhead(mesh, np):
         state, logs = trainer.train_step(state, batch)
     float(logs["loss"])
 
+    # the skew sketch's per-step diet: pre-deduped (unique ids, counts)
+    # chunks from a zipf stream — the exact shapes the tier's pull path
+    # feeds it (dedupe happens there anyway; the sketch update is the
+    # marginal cost under test)
+    zipf_ids = (r.zipf(1.3, (steps, 256)) % 65536).astype(np.int64)
+    sketch_chunks = [
+        np.unique(zipf_ids[i], return_counts=True) for i in range(steps)
+    ]
+
     def run(instrumented: bool):
         nonlocal state
         prof = profile_lib.StepProfiler()
         stats = WorkerStepStats()
         rec = flight_lib.FlightRecorder(ring=4096, role="bench")
+        # per-step maybe_sample against a 0.5 s interval: real registry
+        # snapshots land mid-run, at ~10x the production cadence (a real
+        # worker samples every 5 s from its heartbeat thread, and polls
+        # from there too — per-STEP polling here already over-states the
+        # clock-read cost)
+        tstore = TimeSeriesStore(capacity=256, interval_s=0.5)
+        sketch = SpaceSaving(128)
         if instrumented:
             rec.attach_tracing()
         times = []
@@ -886,6 +911,8 @@ def bench_observability_overhead(mesh, np):
                     prof.step_done()
                     stats.observe_step(compute_s, batch_size)
                     rec.record("step", "bench.step", i=i, loss=loss)
+                    sketch.update_batch(*sketch_chunks[i])
+                    tstore.maybe_sample()
                 else:
                     state, logs = trainer.train_step(state, batch)
                     # same barrier, uninstrumented twin:
@@ -897,26 +924,35 @@ def bench_observability_overhead(mesh, np):
         times.sort()
         return times
 
-    # interleave off/on/off to cancel drift (CPU boxes throttle); keep the
-    # faster OFF sample as the honest baseline
+    # interleave off/on/off/on to cancel drift (CPU boxes throttle), and
+    # take the MIN of medians for BOTH modes — each mode gets its
+    # quietest window, so box noise subtracts out instead of landing on
+    # whichever mode drew the throttled slot (measured 3-14% run-to-run
+    # swing on a 1-core sandbox vs the ~1.6% structural cost under test)
     off_a = run(False)
-    on = run(True)
+    on_a = run(True)
     off_b = run(False)
+    on_b = run(True)
 
     def med(ts):
         return ts[len(ts) // 2]
 
     off = min(med(off_a), med(off_b))
+    on = min(med(on_a), med(on_b))
     out = {
         "steps_per_mode": steps,
         "median_step_s_off": round(off, 6),
-        "median_step_s_on": round(med(on), 6),
+        "median_step_s_on": round(on, 6),
         "p90_step_s_off": round(min(off_a[int(0.9 * steps)],
                                     off_b[int(0.9 * steps)]), 6),
-        "p90_step_s_on": round(on[int(0.9 * steps)], 6),
+        "p90_step_s_on": round(min(on_a[int(0.9 * steps)],
+                                   on_b[int(0.9 * steps)]), 6),
     }
-    out["overhead_pct"] = round(100.0 * (med(on) - off) / off, 3) if off else 0.0
-    out["gate"] = "<= 2% median step time (ISSUE 9 acceptance)"
+    out["overhead_pct"] = round(100.0 * (on - off) / off, 3) if off else 0.0
+    out["gate"] = (
+        "<= 2% median step time (ISSUE 9 acceptance; ISSUE 11 adds the "
+        "time-series ring + skew sketch to the ON leg)"
+    )
     return out
 
 
@@ -1449,10 +1485,18 @@ def _et_serving_loops(np):
     # unique and its internal ratio would read a vacuous 1.0
     res_sharded["dedupe_ratio"] = round(
         push_stats.get("ids_sent", n_ids) / n_ids, 4)
+    # skew telemetry (ISSUE 11): the sharded client's Space-Saving
+    # sketch + per-shard load counters measured over the same zipf
+    # stream the dedupe ratio comes from — hot_id_share is a GUARANTEED
+    # lower bound on the top-K traffic share (the hot-row cache's sizing
+    # input; a 0.11 dedupe ratio should read as a large hot share)
+    skew = sharded.tier_stats()
     return {
         "ids_per_batch": n_ids,
         "unique_ratio": round(len(np.unique(ids)) / n_ids, 4),
         "zipf_a": ET_ZIPF,
+        "hot_id_share": skew.get("emb_hot_id_share", 0.0),
+        "shard_load_imbalance": skew.get("emb_shard_imbalance", 0.0),
         "single_host": res_single,
         "sharded": res_sharded,
         "sharded_speedup": round(
@@ -1568,17 +1612,88 @@ def _et_reshard_scenario(np):
         cc_before = cc.global_cache().stats()
         dup_before = _et_dup_pushes()
 
+        # --- observe->decide sensor (ISSUE 11 acceptance): the kill
+        # must RAISE an alert, edge-triggered once. The engine runs the
+        # shipped rule shapes over the client's OWN measured tier stats
+        # (fed through timeseries.fleet_series as one synthetic health
+        # record per sample — the same aggregation path the master
+        # runs); the clock is warped so the burn-rate windows fill in
+        # milliseconds, the VALUES are real measurements. The pull-p99
+        # page threshold is declared relative to the measured healthy
+        # baseline (5x, floor 25 ms) — the bench's tuning of the
+        # declarative knob, not a different sensor.
+        import threading as _threading
+
+        from elasticdl_tpu.observability.alerts import (
+            AlertEngine,
+            default_rules,
+        )
+        from elasticdl_tpu.observability.registry import MetricsRegistry
+        from elasticdl_tpu.observability.timeseries import (
+            TimeSeriesStore,
+            fleet_series,
+        )
+
+        art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+        # the healthy baseline must be the WARM serving p99: the steady-
+        # state pulls above paid one-time jit compiles, and a threshold
+        # declared relative to compile-laden latencies would be
+        # unreachable. Drop them, then measure a few warm pulls.
+        client._pull_times.clear()
+        for _ in range(4):
+            client.pull_unique("users", ids)
+        base_stats = client.tier_stats()
+        base_p99 = float(base_stats.get("emb_pull_p99_ms", 1.0))
+        rules = default_rules()
+        for r in rules:
+            if r.name == "embedding_pull_p99":
+                r.threshold = max(5.0 * base_p99, 25.0)
+        alert_store = TimeSeriesStore(
+            capacity=512, interval_s=0.0, registry=MetricsRegistry(),
+            history_path=(os.path.join(art_dir, "metrics_history.jsonl")
+                          if art_dir else None),
+        )
+        engine = AlertEngine(
+            alert_store, rules=rules,
+            json_path=(os.path.join(art_dir, "alerts.json")
+                       if art_dir else None),
+            flight_dump=lambda reason: None,   # the bench has no flight dir
+        )
+
+        def sense(stats, t):
+            alert_store.sample(now=t, extra=fleet_series(
+                [dict(stats, updated_at=t)], now=t))
+            engine.evaluate(now=t)
+
+        t_base = time.time()
+        for i in range(48):                    # 240 s of healthy history
+            sense(base_stats, t_base + 5 * i)
+        assert not engine.active(), engine.active()
+
         victim = worker_ids[-1]
         survivors = [w for w in worker_ids if w != victim]
+        kill_pull = {}
+
+        def _kill_window_pull():
+            # a pull issued INTO the dead window: retries (stale map,
+            # not-yet-resident shards) until the survivors finish
+            # installing — its wall time is the outage as a client saw it
+            t = time.perf_counter()
+            client.pull_unique("users", ids)
+            kill_pull["s"] = time.perf_counter() - t
+
         t_kill = time.perf_counter()
         with tracing.span("embedding_tier.kill_worker", victim=victim):
             runtimes[victim].drain()          # planned kill: SIGTERM drain
             shared.deregister(victim)
             m["membership"].mark_dead(victim, reason="bench kill")
+            puller = _threading.Thread(target=_kill_window_pull)
+            puller.start()
             # survivors react (the worker run loop's task-boundary
             # refresh): install from the drain checkpoint, confirm
             for wid in survivors:
                 runtimes[wid].on_world_change()
+            puller.join(timeout=30)
             # the plan must be COMMITTED now (all moves confirmed)
             final_view = m["owner"].view()
             # post-recovery traffic proves the tier is serving again —
@@ -1590,6 +1705,19 @@ def _et_reshard_scenario(np):
             push_step(client, 3)
             push_step(ctl, 3)
         t_recover = time.perf_counter() - t_kill
+
+        # post-kill sensing: the client's recent pull window now carries
+        # the outage pull; feed it until the burn-rate long window is
+        # saturated, then keep evaluating — the onset must not repeat
+        post_stats = client.tier_stats()
+        t_post = t_base + 48 * 5
+        for i in range(48):
+            sense(post_stats, t_post + 5 * i)
+        alert_onsets = [
+            h for h in engine.snapshot()["history"]
+            if h["transition"] == "firing"
+        ]
+        engine.write_json()
         cc_after = cc.global_cache().stats()
         dup_after = _et_dup_pushes()
 
@@ -1633,6 +1761,18 @@ def _et_reshard_scenario(np):
             "warm_resharding": cc_after["misses"] == cc_before["misses"],
             "journal_map_consistent": journal_consistent,
             "final_map_version": final_view.version,
+            "alert": {
+                "raised": (alert_onsets[0]["rule"] if alert_onsets
+                           else None),
+                "onsets": len(alert_onsets),
+                "active": [a["rule"] for a in engine.active()],
+                "baseline_pull_p99_ms": round(base_p99, 3),
+                "killwindow_pull_p99_ms": post_stats.get(
+                    "emb_pull_p99_ms", 0.0),
+                "killwindow_pull_s": round(kill_pull.get("s", 0.0), 4),
+                "pull_p99_threshold_ms": round(
+                    max(5.0 * base_p99, 25.0), 3),
+            },
         }
 
 
@@ -1655,11 +1795,25 @@ def bench_embedding_tier(mesh=None, np=None):
     from elasticdl_tpu.observability import tracing
 
     tracing.configure(role="bench-embedding-tier")
+    # the artifact must carry THIS leg's records only: the tracer's
+    # in-memory buffer is process-global (an in-process harness may have
+    # buffered earlier records) AND bounded, so an index slice would
+    # break once the deque wraps — subscribe a sink for the leg's
+    # duration instead (the flight recorder's mechanism)
+    leg_records = []
+
+    def _collect(rec):
+        leg_records.append(dict(rec))
+
+    tracing.get_tracer().add_sink(_collect)
     trace_id = tracing.new_trace_id()
-    with tracing.adopt(trace_id):
-        with tracing.span("embedding_tier", shards=ET_SHARDS):
-            serving = _et_serving_loops(np)
-            reshard = _et_reshard_scenario(np)
+    try:
+        with tracing.adopt(trace_id):
+            with tracing.span("embedding_tier", shards=ET_SHARDS):
+                serving = _et_serving_loops(np)
+                reshard = _et_reshard_scenario(np)
+    finally:
+        tracing.get_tracer().remove_sink(_collect)
     out = {
         "shards": ET_SHARDS, "owners": ET_OWNERS, "vocab": ET_VOCAB,
         "dim": ET_DIM, "steps": ET_STEPS,
@@ -1672,7 +1826,7 @@ def bench_embedding_tier(mesh=None, np=None):
         os.makedirs(art_dir, exist_ok=True)
         with open(os.path.join(art_dir, "bench-embedding-tier-trace.jsonl"),
                   "w") as f:
-            for rec in tracing.get_tracer().records:
+            for rec in leg_records:
                 f.write(json.dumps(rec) + "\n")
     return out
 
@@ -1773,6 +1927,202 @@ def bench_pipeline(mesh, np):
         flush(last)
         pipeline_sps = n_pipe / (time.perf_counter() - t1)
     return pipeline_sps, host_sps
+
+
+# ---------------------------------------------------------------------- #
+# baseline compare mode (ISSUE 11): diff a run's headline numbers against
+# a prior artifact, exit nonzero past a regression threshold — the perf
+# trajectory machine-checked instead of eyeballed across round logs.
+
+#: (dotted-path glob, direction, absolute slack) — the numeric leaves the
+#: comparator gates on. Anything numeric NOT matched here is reported
+#: informationally only (absolute wall-clock numbers vary across boxes;
+#: ratios, rates and structural metrics are the machine-checkable
+#: trajectory). The absolute slack handles near-zero baselines, where a
+#: pure percentage threshold is meaningless (overhead_pct hovers around
+#: 0 inside box noise: -0.3% -> +1% is not a 400% regression).
+_COMPARE_METRICS = (
+    ("value", "higher", 0.0),                    # headline samples/s/chip
+    ("*rows_per_sec", "higher", 0.0),
+    ("*samples_per_sec", "higher", 0.0),
+    ("*sharded_speedup", "higher", 0.0),
+    ("*flash_speedup", "higher", 0.0),
+    ("*leases_per_sec", "higher", 0.0),
+    ("*reports_per_sec", "higher", 0.0),
+    ("*beats_per_sec", "higher", 0.0),
+    ("*recompile_hit_rate", "higher", 0.0),
+    ("*recovery_speedup", "higher", 0.0),   # warm/cold RATIO, not a clock
+    ("*hot_id_share", "higher", 0.05),
+    # NOTE: recovery_s / time_to_recovery_s are deliberately NOT gated —
+    # they are sub-second absolute wall clocks that swing with scheduler
+    # noise across box classes; the warm/cold ratio above and the
+    # structural booleans are the machine-checkable recovery trajectory
+    ("*overhead_pct", "lower", 5.0),   # percentage points of box noise
+    # latency percentiles carry ms-scale absolute slack: sub-10ms
+    # percentiles on a contended box swing 2x run-to-run, and a 4ms ->
+    # 9ms journal-commit "regression" is scheduler noise, not a finding
+    ("*_p50_ms", "lower", 2.0),
+    ("*_p99_ms", "lower", 10.0),
+    ("*mfu_pct", "higher", 0.0),
+)
+
+#: paths NEVER gated even when a metric glob matches: scenario-record
+#: fields whose magnitude documents the experiment rather than the
+#: system's quality — the kill-window pull p99 is SUPPOSED to be large
+#: (it measures the injected outage), and the alert thresholds derive
+#: from the run's own baseline
+_COMPARE_EXCLUDE = ("*.alert.*",)
+
+#: boolean leaves: True in the baseline must stay True (structure gates —
+#: bit-exactness, exactly-once, warm resharding, replay identity)
+_COMPARE_BOOLS = True
+
+
+def _numeric_leaves(doc, prefix=""):
+    """Yield (dotted_path, value) for every number/bool leaf."""
+    if isinstance(doc, dict):
+        for k in sorted(doc):
+            yield from _numeric_leaves(doc[k], f"{prefix}.{k}" if prefix
+                                       else str(k))
+    elif isinstance(doc, bool):
+        yield prefix, doc
+    elif isinstance(doc, (int, float)):
+        yield prefix, float(doc)
+
+
+def _compare_direction(path):
+    import fnmatch
+
+    for pattern in _COMPARE_EXCLUDE:
+        if fnmatch.fnmatch(path, pattern):
+            return None, 0.0
+    for pattern, direction, slack in _COMPARE_METRICS:
+        if fnmatch.fnmatch(path, pattern):
+            return direction, slack
+    return None, 0.0
+
+
+def bench_compare(baseline_doc, current_doc, threshold_pct=30.0):
+    """Diff two bench records. A gated metric regresses when it moves
+    the WRONG way by more than threshold_pct; a baseline-True boolean
+    going False always regresses; a gated metric MISSING from the
+    current record regresses (a silently-dropped leg must not read as
+    green). Returns the report dict; `regressions` non-empty = fail."""
+    base = dict(_numeric_leaves(baseline_doc))
+    cur = dict(_numeric_leaves(current_doc))
+    thr = float(threshold_pct) / 100.0
+    compared, regressions, info = [], [], []
+    for path, b in sorted(base.items()):
+        if isinstance(b, bool):
+            c = cur.get(path)
+            if b is True and c is not True:
+                regressions.append({
+                    "path": path, "baseline": True, "current": c,
+                    "why": "boolean gate went false/missing",
+                })
+            continue
+        direction, slack = _compare_direction(path)
+        c = cur.get(path)
+        if direction is None:
+            if isinstance(c, float):
+                info.append({"path": path, "baseline": b, "current": c})
+            continue
+        if c is None or isinstance(c, bool):
+            regressions.append({
+                "path": path, "baseline": b, "current": None,
+                "why": "gated metric missing from current record",
+            })
+            continue
+        entry = {"path": path, "baseline": b, "current": c,
+                 "direction": direction}
+        # the allowed move combines the relative threshold with the
+        # metric's absolute slack (whichever is more permissive), so
+        # near-zero baselines don't turn box noise into "regressions"
+        margin = max(abs(b) * thr, slack)
+        if direction == "higher":
+            bad = c < b - margin
+        else:
+            bad = c > b + margin
+        entry["ratio"] = round(c / b, 4) if b else None
+        compared.append(entry)
+        if bad:
+            regressions.append(dict(entry, why=(
+                f"{direction}-is-better metric moved "
+                f"{'down' if direction == 'higher' else 'up'} past "
+                f"{threshold_pct}%")))
+    return {
+        "threshold_pct": float(threshold_pct),
+        "compared": compared,
+        "regressions": regressions,
+        "informational": info,
+    }
+
+
+def _compare_cli(argv):
+    """`python bench.py compare [--baseline] <prior.json> <current.json>
+    [--threshold-pct N]` — exit 0 ok / 1 regression / 2 usage."""
+    args = list(argv)
+    threshold = float(os.environ.get("EDL_BENCH_REGRESSION_PCT", "30"))
+    if "--threshold-pct" in args:
+        i = args.index("--threshold-pct")
+        try:
+            threshold = float(args[i + 1])
+        except (IndexError, ValueError):
+            print("--threshold-pct needs a number", file=sys.stderr)
+            return 2
+        del args[i:i + 2]
+    if "--baseline" in args:
+        args.remove("--baseline")
+    if len(args) != 2:
+        print("usage: python bench.py compare [--baseline] <prior.json> "
+              "<current.json> [--threshold-pct N]", file=sys.stderr)
+        return 2
+    docs = []
+    for path in args:
+        try:
+            with open(path, encoding="utf-8") as f:
+                docs.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"unreadable bench record {path}: {e}", file=sys.stderr)
+            return 2
+    report = bench_compare(docs[0], docs[1], threshold_pct=threshold)
+    print(json.dumps(report, indent=1))
+    for r in report["regressions"]:
+        print(
+            f"[bench] REGRESSION {r['path']}: {r['baseline']} -> "
+            f"{r['current']} ({r['why']})", file=sys.stderr,
+        )
+    return 1 if report["regressions"] else 0
+
+
+def _maybe_compare_exit(record):
+    """Single-leg `--baseline <prior.json>` mode: after printing the
+    fresh record, diff it against the prior artifact and exit nonzero on
+    regression (what the bench-* CI jobs wire)."""
+    if "--baseline" not in sys.argv:
+        return
+    i = sys.argv.index("--baseline")
+    if i + 1 >= len(sys.argv):
+        raise SystemExit("--baseline needs a path")
+    path = sys.argv[i + 1]
+    threshold = float(os.environ.get("EDL_BENCH_REGRESSION_PCT", "30"))
+    try:
+        with open(path, encoding="utf-8") as f:
+            baseline = json.load(f)
+    except (OSError, ValueError) as e:
+        raise SystemExit(f"unreadable baseline {path}: {e}")
+    report = bench_compare(baseline, record, threshold_pct=threshold)
+    for r in report["regressions"]:
+        print(
+            f"[bench] REGRESSION {r['path']}: {r['baseline']} -> "
+            f"{r['current']} ({r['why']})", file=sys.stderr,
+        )
+    if report["regressions"]:
+        raise SystemExit(1)
+    print(
+        f"[bench] baseline compare ok: {len(report['compared'])} gated "
+        f"metric(s) within {threshold}% of {path}", file=sys.stderr,
+    )
 
 
 def _run_leg(leg, mesh, np):
@@ -1946,11 +2296,19 @@ def _probe_tunnel():
 
 
 def main():
+    if len(sys.argv) >= 2 and sys.argv[1] == "compare":
+        # `python bench.py compare <prior.json> <current.json>`: diff two
+        # bench records, exit 1 past the regression threshold (jax-free —
+        # CI's machine check on the perf trajectory)
+        raise SystemExit(_compare_cli(sys.argv[2:]))
+
     if len(sys.argv) >= 2 and sys.argv[1] == "control_plane":
         # `python bench.py control_plane`: the swarm scenario alone, one
         # JSON line — deliberately BEFORE any jax import (no devices are
         # touched; the leg must run on a box with no backend at all)
-        print(json.dumps({"control_plane": bench_control_plane()}))
+        record = {"control_plane": bench_control_plane()}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
         return
 
     import subprocess
@@ -2003,7 +2361,9 @@ def main():
         # `python bench.py rescale`: the rescale scenario alone, one JSON
         # line (CI uploads it as an artifact; tier-1 smoke asserts on it)
         mesh = build_mesh({"data": len(jax.devices())})
-        print(json.dumps({"rescale": _run_leg("rescale", mesh, np)}))
+        record = {"rescale": _run_leg("rescale", mesh, np)}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
         return
 
     if len(sys.argv) >= 2 and sys.argv[1] == "embedding_tier":
@@ -2011,18 +2371,18 @@ def main():
         # JSON line (CI uploads it + its trace; tier-1 smoke asserts on
         # the record shape). Serving runs host-side; the reshard phase
         # uses device-mode stores on whatever backend is up.
-        print(json.dumps(
-            {"embedding_tier": _run_leg("embedding_tier", None, np)}
-        ))
+        record = {"embedding_tier": _run_leg("embedding_tier", None, np)}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
         return
 
     if len(sys.argv) >= 2 and sys.argv[1] == "obs_overhead":
         # `python bench.py obs_overhead`: the recorder+profiler overhead
         # gate alone (ISSUE 9 acceptance: <= 2% median step time)
         mesh = build_mesh({"data": len(jax.devices())})
-        print(json.dumps(
-            {"obs_overhead": _run_leg("obs_overhead", mesh, np)}
-        ))
+        record = {"obs_overhead": _run_leg("obs_overhead", mesh, np)}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
         return
 
     if len(sys.argv) >= 3 and sys.argv[1] == "--leg":

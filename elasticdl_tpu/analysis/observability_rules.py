@@ -45,6 +45,22 @@ EDL404 span-sink-in-hot-loop
     touching a file until an incident dumps them. Emit spans at task /
     rescale / reform granularity instead.
 
+EDL405 unbounded-metric-label-cardinality
+    A metric mutation (`.inc()`/`.set()`/`.observe()`/`.add()` on a
+    registry metric) whose label VALUE derives from a loop variable —
+    a `for` target or comprehension target lexically enclosing the
+    call. Label values become registry dictionary keys that live
+    forever: a label fed from a per-id / per-task / per-row loop grows
+    the registry (and every scrape) without bound — the classic
+    cardinality explosion. Bounded enumerations are fine and common:
+    a loop over a module-level constant tuple (the profiler's PHASES)
+    is recognized and exempt; a loop whose bound the linter cannot see
+    (range(num_shards), dict iteration) but a reviewer CAN — per-shard
+    labels bounded by --embedding_shards — carries an explicit
+    `# edl-lint: disable=EDL405` with justification. Everything else
+    should label by a bounded dimension (op, phase, method) and carry
+    the unbounded one as a value, not a label.
+
 EDL403 fsync-under-lock
     An ``os.fsync`` call lexically inside a `guarded_by:`-annotated
     lock's critical section. An fsync is milliseconds on local disk and
@@ -413,3 +429,175 @@ class SpanSinkInHotLoopRule(Rule):
                             "spans stay at task/rescale granularity "
                             "(EDL404)",
                         )
+
+
+# ------------------------------------------------------------------ #
+# EDL405 unbounded-metric-label-cardinality
+
+
+#: metric mutator attribute names whose keyword args are label values
+_MUTATOR_ATTRS = {"inc", "set", "observe", "add"}
+
+#: keyword args of the mutators that are NOT labels
+_NON_LABEL_KWARGS = {"n", "value"}
+
+
+def _metric_var_names(tree: ast.AST) -> Set[str]:
+    """Names bound (anywhere) to a registry-factory call result:
+    `X = reg.counter(...)` / `X = registry.gauge(...)` — the receivers
+    whose mutator keywords are label values."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        value = node.value
+        if not isinstance(value, ast.Call):
+            continue
+        func = value.func
+        attr = (
+            func.attr if isinstance(func, ast.Attribute)
+            else func.id if isinstance(func, ast.Name) else ""
+        )
+        if attr not in _FACTORIES:
+            continue
+        # only metric-shaped factory calls (same literal-name gate as
+        # EDL401 — a collections.Counter(...) assignment stays out)
+        name_node = _metric_name_arg(value)
+        if name_node is None or not name_node.value.startswith("edl_"):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                names.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                names.add(target.attr)
+    return names
+
+
+def _module_const_seqs(tree: ast.AST) -> Set[str]:
+    """Module-level names bound to a literal tuple/list of constants —
+    the recognizably-BOUNDED iterables (profile.py's PHASES)."""
+    out: Set[str] = set()
+    for node in getattr(tree, "body", []):
+        if not isinstance(node, ast.Assign):
+            continue
+        v = node.value
+        if isinstance(v, (ast.Tuple, ast.List)) and all(
+            isinstance(e, ast.Constant) for e in v.elts
+        ):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+    return out
+
+
+def _is_bounded_iter(node: ast.AST, const_seqs: Set[str]) -> bool:
+    """Iterables whose cardinality is statically knowable: a literal
+    tuple/list (of anything), or a module-level constant sequence by
+    name. range()/data-driven iterables are NOT bounded as far as the
+    linter can see — a reviewer may know better (disable with
+    justification)."""
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return True
+    if isinstance(node, ast.Name) and node.id in const_seqs:
+        return True
+    return False
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+    return out
+
+
+class _LabelCardinalityVisitor(ast.NodeVisitor):
+    """Walk one scope tracking loop-bound names from UNBOUNDED iterables;
+    flag metric-mutator calls whose label keyword values mention one."""
+
+    def __init__(self, rule: Rule, ctx: ModuleContext,
+                 metric_names: Set[str], const_seqs: Set[str]):
+        self.rule = rule
+        self.ctx = ctx
+        self.metric_names = metric_names
+        self.const_seqs = const_seqs
+        self.loop_vars: Set[str] = set()
+        self.findings: List[Finding] = []
+
+    def visit_For(self, node: ast.For) -> None:
+        added: Set[str] = set()
+        if not _is_bounded_iter(node.iter, self.const_seqs):
+            added = _target_names(node.target) - self.loop_vars
+            self.loop_vars |= added
+        self.generic_visit(node)
+        self.loop_vars -= added
+
+    def _visit_comp(self, node) -> None:
+        added: Set[str] = set()
+        for gen in node.generators:
+            if not _is_bounded_iter(gen.iter, self.const_seqs):
+                added |= _target_names(gen.target) - self.loop_vars
+        self.loop_vars |= added
+        self.generic_visit(node)
+        self.loop_vars -= added
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if (
+            self.loop_vars
+            and isinstance(func, ast.Attribute)
+            and func.attr in _MUTATOR_ATTRS
+            and self._receiver_is_metric(func.value)
+        ):
+            for kw in node.keywords:
+                if kw.arg is None or kw.arg in _NON_LABEL_KWARGS:
+                    continue
+                used = {
+                    n.id for n in ast.walk(kw.value)
+                    if isinstance(n, ast.Name)
+                } & self.loop_vars
+                if used:
+                    self.findings.append(self.rule.finding(
+                        self.ctx, node,
+                        f"label {kw.arg!r} derives from loop "
+                        f"variable(s) {sorted(used)} — per-iteration "
+                        "label values grow the registry without bound; "
+                        "label by a bounded dimension instead, or "
+                        "disable with the bound's justification "
+                        "(EDL405)",
+                    ))
+                    break
+        self.generic_visit(node)
+
+    def _receiver_is_metric(self, base: ast.AST) -> bool:
+        if isinstance(base, ast.Name):
+            return base.id in self.metric_names
+        if isinstance(base, ast.Attribute):
+            return base.attr in self.metric_names
+        return False
+
+
+@register
+class UnboundedMetricLabelCardinalityRule(Rule):
+    id = "EDL405"
+    name = "unbounded-metric-label-cardinality"
+    doc = (
+        "metric label value derived from a loop variable over an "
+        "unbounded iterable — per-id/per-task labels explode the "
+        "registry; label by bounded dimensions"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        metric_names = _metric_var_names(ctx.tree)
+        if not metric_names:
+            return
+        const_seqs = _module_const_seqs(ctx.tree)
+        visitor = _LabelCardinalityVisitor(
+            self, ctx, metric_names, const_seqs)
+        visitor.visit(ctx.tree)
+        yield from visitor.findings

@@ -143,6 +143,20 @@ class JobConfig:
     flight_dir: str = ""
     # Flight ring capacity (records kept at full fidelity per process).
     flight_ring: int = 4096
+    # Metrics time series (observability/timeseries.py): every process
+    # keeps a bounded ring of periodic registry snapshots, served by
+    # GET /timeseries and persisted as a rolling metrics_history.jsonl
+    # under <summary_dir|checkpoint_dir>/timeseries/<role>/. The master's
+    # ring additionally carries fleet series computed from heartbeat
+    # stats payloads — the alert engine's input.
+    timeseries_interval_s: float = 5.0
+    timeseries_samples: int = 720      # ring capacity: 720 x 5s = 1h
+    # Declarative alert rules (observability/alerts.py), evaluated on the
+    # master's wait poll: "" = the shipped default rule set (straggler,
+    # backlog-per-worker, data_wait-dominant fleet, embedding pull p99,
+    # shard imbalance), "off" = disabled, else a path to a JSON list of
+    # rule objects (see docs/observability.md "Alert rules").
+    alert_rules: str = ""
 
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
@@ -304,6 +318,12 @@ class JobConfig:
             # a ring too small to hold even one incident's records would
             # silently produce useless bundles; fail at submit time
             raise ValueError("flight_ring must be >= 16 records")
+        if self.timeseries_interval_s <= 0:
+            raise ValueError("timeseries_interval_s must be > 0")
+        if self.timeseries_samples < 8:
+            # a ring shorter than any alert window is a rule engine
+            # evaluating over nothing; fail at submit time
+            raise ValueError("timeseries_samples must be >= 8")
         if self.master_restarts > 0 and not self.checkpoint_dir:
             # a journal-less successor rebuilds the dispatcher from scratch
             # — every already-finished task would be recreated and re-run,

@@ -47,6 +47,7 @@ import io
 import json
 import os
 import threading
+import time
 import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -73,6 +74,16 @@ _STALE = _reg.counter(
     "pulls/pushes rejected for a stale shard-map version")
 _SHARDS = _reg.gauge(
     "edl_embedding_store_shards", "shards resident in this process's store")
+# per-shard skew telemetry (ISSUE 11): label cardinality is bounded by
+# --embedding_shards x registered tables x {pull,push} — a config
+# constant, not data (the EDL405 boundary)
+_SHARD_ROWS = _reg.counter(
+    "edl_embedding_store_shard_load_rows_total",
+    "rows served (pull) / applied (push) per resident shard",
+    labels=("table", "shard", "op"))
+_OP_S = _reg.histogram(
+    "edl_embedding_store_op_seconds",
+    "owner-side serve wall time per call", labels=("op",))
 
 
 class StaleShardMapError(RuntimeError):
@@ -245,6 +256,7 @@ class EmbeddingShardStore:
         """One fused gather: (n,) local row ids -> (n, dim) rows.
         Out-of-range ids (the client's pow2 padding sentinels) return
         zero rows."""
+        t0 = time.perf_counter()
         sh = self._get_shard(table, shard, map_version)
         ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
         with sh.lock:
@@ -259,7 +271,10 @@ class EmbeddingShardStore:
         # REAL rows only: the request is pow2-padded with -1 sentinels
         # (min bucket 256), and counting the padding would inflate the
         # traffic counters operators size capacity from
-        _PULLED.inc(int((ids >= 0).sum()), table=table)
+        real = int((ids >= 0).sum())
+        _PULLED.inc(real, table=table)
+        _SHARD_ROWS.inc(real, table=table, shard=str(shard), op="pull")
+        _OP_S.observe(time.perf_counter() - t0, op="pull")
         return out
 
     def push(self, table: str, shard: int, local_ids: np.ndarray,
@@ -270,6 +285,7 @@ class EmbeddingShardStore:
         local_ids)``. Returns False (without touching the table) when the
         exactly-once fence says ``(client_id, seq)`` was already applied
         — the ack a retried/requeued push gets."""
+        t0 = time.perf_counter()
         sh = self._get_shard(table, shard, map_version)
         ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
         vals = np.ascontiguousarray(np.asarray(rows, np.float32))
@@ -285,7 +301,10 @@ class EmbeddingShardStore:
                 self._host_apply(sh.rows, ids, vals, scale)
             sh.applied[client_id] = seq
         # real (non-sentinel) rows only — see the pull counter note
-        _PUSHED.inc(int((ids >= 0).sum()), table=table)
+        real = int((ids >= 0).sum())
+        _PUSHED.inc(real, table=table)
+        _SHARD_ROWS.inc(real, table=table, shard=str(shard), op="push")
+        _OP_S.observe(time.perf_counter() - t0, op="push")
         return True
 
     @staticmethod

@@ -29,6 +29,7 @@ from __future__ import annotations
 import threading
 import time
 import uuid
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, Optional, Tuple
 
@@ -37,9 +38,13 @@ import numpy as np
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.embedding import sharding
+from elasticdl_tpu.embedding.sketch import SpaceSaving
 from elasticdl_tpu.embedding.store import StaleShardMapError
 from elasticdl_tpu.embedding.transport import OwnerUnavailableError
-from elasticdl_tpu.observability.registry import default_registry
+from elasticdl_tpu.observability.registry import (
+    default_registry,
+    quantile_sorted,
+)
 
 logger = default_logger(__name__)
 
@@ -68,6 +73,26 @@ _RETRIES = _reg.counter(
 _SHARD_CALLS = _reg.histogram(
     "edl_embedding_shard_batch_ids",
     "deduped ids per per-shard call (batching effectiveness)")
+# skew telemetry (ISSUE 11): the measurement ground for the hot-row
+# cache / read replicas (ROADMAP 1) — docs/observability.md "Embedding
+# skew telemetry"
+_HOT_SHARE = _reg.gauge(
+    "edl_embedding_hot_id_share",
+    "guaranteed lower bound on the share of pull traffic carried by the "
+    "Space-Saving sketch's top-K ids (1.0 = all traffic hits K ids)")
+_SHARD_IMBALANCE = _reg.gauge(
+    "edl_embedding_shard_load_imbalance",
+    "max per-shard pull load over the uniform mean (1.0 = perfectly "
+    "balanced shards)")
+_SHARD_LOAD = _reg.gauge(
+    "edl_embedding_client_shard_load_rows",
+    "deduped rows this client pulled per shard (rolling window)",
+    labels=("shard",))
+
+#: rolling window of recent client pull/push wall times backing the
+#: heartbeat payload's emb_pull_p99_ms (the cumulative histogram cannot
+#: forget a quiet past, so a fresh spike would be diluted)
+LATENCY_WINDOW = 128
 
 #: smallest pow2 padding bucket — below this, padding overhead dominates
 MIN_BUCKET = 256
@@ -115,6 +140,7 @@ class EmbeddingTierClient:
         max_retries: int = 8,
         retry_backoff_s: float = 0.05,
         fanout_workers: int = 0,
+        sketch_k: int = 0,
     ):
         self._map_fetch = map_fetch
         self._transport = transport
@@ -133,6 +159,17 @@ class EmbeddingTierClient:
         self._lock = threading.Lock()
         self._view: Optional[sharding.ShardMapView] = None  # guarded_by: _lock
         self._seq = 0                                        # guarded_by: _lock
+        # skew telemetry (ISSUE 11), all under the client's leaf lock:
+        # the Space-Saving sketch observes every deduped pull stream
+        # (0 = default K_DEFAULT; its own leaf lock), per-shard load
+        # counts feed the imbalance gauge, and bounded recent-latency
+        # windows back the heartbeat payload's p99s (appends AND the
+        # tier_stats sort both take _lock: iterating a deque while
+        # another thread appends raises "mutated during iteration")
+        self.sketch = SpaceSaving(sketch_k if sketch_k > 0 else 128)
+        self._shard_loads: Optional[np.ndarray] = None      # guarded_by: _lock
+        self._pull_times: "deque[float]" = deque(maxlen=LATENCY_WINDOW)  # guarded_by: _lock
+        self._push_times: "deque[float]" = deque(maxlen=LATENCY_WINDOW)  # guarded_by: _lock
         self.refresh()
         # fanout_workers > 0: per-shard calls to distinct owners run
         # concurrently — right for REMOTE transports, where the calls
@@ -201,10 +238,14 @@ class EmbeddingTierClient:
             out = np.zeros((flat.shape[0], spec.dim), np.float32)
         else:
             if self.dedupe:
-                uniq, inverse = np.unique(vids, return_inverse=True)
+                uniq, inverse, id_counts = np.unique(
+                    vids, return_inverse=True, return_counts=True)
             else:
-                uniq, inverse = vids, None
+                uniq, inverse, id_counts = vids, None, None
             _PULL_UNIQUE.inc(int(uniq.shape[0]))
+            # skew measurement: the sketch sees every id's true
+            # occurrence weight (one dict op per UNIQUE id)
+            self.sketch.update_batch(uniq, id_counts)
             vectors = self._pull_unique(table, spec, uniq)
             expanded = vectors if inverse is None else vectors[inverse]
             if all_valid:
@@ -212,7 +253,10 @@ class EmbeddingTierClient:
             else:
                 out = np.zeros((flat.shape[0], spec.dim), np.float32)
                 out[valid] = expanded
-        _PULL_S.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _PULL_S.observe(dt)
+        with self._lock:
+            self._pull_times.append(dt)
         return out.reshape(*np.asarray(ids).shape, spec.dim)
 
     def _pull_unique(self, table: str, spec, uniq: np.ndarray) -> np.ndarray:
@@ -247,8 +291,9 @@ class EmbeddingTierClient:
         flat = np.asarray(ids).reshape(-1).astype(np.int64)
         valid = (flat >= 0) & (flat < spec.vocab)
         _PULL_IDS.inc(int(flat.shape[0]))
-        uniq, inverse = np.unique(
-            np.where(valid, flat, np.int64(-1)), return_inverse=True)
+        uniq, inverse, id_counts = np.unique(
+            np.where(valid, flat, np.int64(-1)),
+            return_inverse=True, return_counts=True)
         has_pad = bool(uniq.shape[0]) and uniq[0] < 0
         if has_pad:
             # rotate the sentinel slot to the END: unique ids stay a
@@ -257,12 +302,19 @@ class EmbeddingTierClient:
             uniq = np.concatenate([uniq[1:], uniq[:1]])
             inverse = np.where(
                 inverse == 0, uniq.shape[0] - 1, inverse - 1)
+            id_counts = np.concatenate([id_counts[1:], id_counts[:1]])
         _PULL_UNIQUE.inc(int(uniq.shape[0]) - int(has_pad))
         rows = np.zeros((uniq.shape[0], spec.dim), np.float32)
         real = uniq.shape[0] - int(has_pad)
         if real:
+            # the sentinel slot never reaches the sketch — padding is
+            # protocol, not traffic
+            self.sketch.update_batch(uniq[:real], id_counts[:real])
             rows[:real] = self._pull_unique(table, spec, uniq[:real])
-        _PULL_S.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _PULL_S.observe(dt)
+        with self._lock:
+            self._pull_times.append(dt)
         return rows, inverse.reshape(np.asarray(ids).shape), uniq
 
     def _pull_once(self, view, table: str, uniq: np.ndarray) -> np.ndarray:
@@ -296,6 +348,66 @@ class EmbeddingTierClient:
         ])
         if errs:
             raise errs[0]
+        # load accounting only for the attempt that SERVED: a retried
+        # round against a stale map would double-count rows that were
+        # never pulled — skewing the imbalance signal exactly when the
+        # shard-imbalance alert reads it (mid-resharding)
+        self._note_shard_loads(shards, view.num_shards)
+        return out
+
+    # -------------------------------------------------------------- #
+    # skew telemetry (ISSUE 11)
+
+    def _note_shard_loads(self, shards: np.ndarray,
+                          num_shards: int) -> None:
+        """Accumulate per-shard deduped pull traffic (one bincount + a
+        vector add under the leaf lock — the hot-path half; the gauge
+        refresh and hot-share computation live in tier_stats(), on the
+        heartbeat/scrape cadence). Rolling: loads halve once the window
+        outgrows its bound, so the signal tracks RECENT traffic instead
+        of averaging a reshard away."""
+        counts = np.bincount(shards, minlength=num_shards)
+        with self._lock:
+            if (self._shard_loads is None
+                    or self._shard_loads.shape[0] != num_shards):
+                self._shard_loads = np.zeros(num_shards, np.int64)
+            self._shard_loads += counts
+            if int(self._shard_loads.sum()) > (1 << 20):
+                self._shard_loads //= 2
+
+    def tier_stats(self) -> Dict[str, float]:
+        """The compact skew row that rides the heartbeat stats payload
+        (observability/health.py budget: few keys, scalars only) so the
+        master's fleet rollup sees tier skew without scraping workers:
+        hot-id traffic share, shard load imbalance, and RECENT pull/push
+        p99s (a bounded window, not the job-lifetime histogram — a fresh
+        owner-loss spike must not be diluted by a quiet past). Also the
+        ONE place the skew gauges refresh — heartbeat/scrape cadence,
+        never per pull (the sketch's hot_share sorts its counters)."""
+        with self._lock:
+            loads = (None if self._shard_loads is None
+                     else self._shard_loads.copy())
+            pulls = sorted(self._pull_times)
+            pushes = sorted(self._push_times)
+        hot_share = round(self.sketch.hot_share(), 4)
+        _HOT_SHARE.set(hot_share)
+        out: Dict[str, float] = {"emb_hot_id_share": hot_share}
+        if loads is not None and int(loads.sum()):
+            total = int(loads.sum())
+            imbalance = round(
+                float(loads.max()) * loads.shape[0] / total, 4)
+            out["emb_shard_imbalance"] = imbalance
+            _SHARD_IMBALANCE.set(imbalance)
+            for s in range(loads.shape[0]):
+                # per-shard labels are bounded by --embedding_shards (a
+                # config constant, not data): edl-lint: disable=EDL405
+                _SHARD_LOAD.set(float(loads[s]), shard=str(s))
+        if pulls:
+            out["emb_pull_p99_ms"] = round(
+                1e3 * quantile_sorted(pulls, 0.99), 3)
+        if pushes:
+            out["emb_push_p99_ms"] = round(
+                1e3 * quantile_sorted(pushes, 0.99), 3)
         return out
 
     # -------------------------------------------------------------- #
@@ -319,7 +431,10 @@ class EmbeddingTierClient:
         n_batch = int(flat.shape[0])
         _PUSH_IDS.inc(n_batch)
         if not vids.shape[0]:
-            _PUSH_S.observe(time.perf_counter() - t0)
+            dt = time.perf_counter() - t0
+            _PUSH_S.observe(dt)
+            with self._lock:
+                self._push_times.append(dt)
             return {"ids_in_batch": n_batch, "ids_sent": 0,
                     "dedupe_ratio": 0.0}
         if self.dedupe:
@@ -333,7 +448,10 @@ class EmbeddingTierClient:
         _PUSH_SENT.inc(sent)
         ratio = sent / max(1, n_batch)
         _DEDUPE_RATIO.set(ratio)
-        _PUSH_S.observe(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _PUSH_S.observe(dt)
+        with self._lock:
+            self._push_times.append(dt)
         return {"ids_in_batch": n_batch, "ids_sent": sent,
                 "dedupe_ratio": round(ratio, 4)}
 

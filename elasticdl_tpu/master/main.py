@@ -9,6 +9,7 @@ the control plane and blocks until the job finishes.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import List, Optional
@@ -156,6 +157,25 @@ class Master:
         # side is launcher-wired (client/local.py SIGUSR2s the worker
         # process — only the launcher knows pids).
         self.health.add_hook(self._straggler_flight_hook)
+        # Observe->decide backbone (ISSUE 11, observability/timeseries.py
+        # + alerts.py): the master's time-series ring additionally
+        # accumulates FLEET series computed from the heartbeat stats
+        # payloads it already receives (_fleet_series), and the alert
+        # engine evaluates its declarative rules against that history on
+        # every wait poll. The engine's hook seam is where ROADMAP 3's
+        # autoscaler subscribes.
+        from elasticdl_tpu.observability import alerts as alerts_lib
+        from elasticdl_tpu.observability import timeseries as timeseries_lib
+
+        self.timeseries = timeseries_lib.configure_from_config(
+            cfg, role="master")
+        base_dir = cfg.summary_dir or cfg.checkpoint_dir
+        self.alerts = alerts_lib.AlertEngine(
+            self.timeseries,
+            rules=alerts_lib.rules_from_config(cfg),
+            json_path=(os.path.join(base_dir, "control", "alerts.json")
+                       if base_dir else None),
+        )
 
         # Elastic sharded embedding tier (ROADMAP 1): the master owns the
         # id-sharded table map, durable through the same journal as task
@@ -272,6 +292,7 @@ class Master:
         self.metrics_server = start_server(
             role="master", port=self.cfg.metrics_port,
             health_fn=self._healthz_extra,
+            timeseries=self.timeseries, alerts=self.alerts,
         )
         if self.cfg.instance_manager == "k8s":
             # the reference's k8s flavor: the master creates worker pods and
@@ -332,14 +353,34 @@ class Master:
     def _healthz_extra(self) -> dict:
         """What the master's /healthz adds over the per-process base:
         which master (generation), which worker set (membership version +
-        alive count), and the latest cluster-health rollup. Reads only
-        cached/cheap state — a scrape never triggers a recompute."""
+        alive count), the latest cluster-health rollup (whose
+        `snapshot_age_s` is stamped at serve time, so a scraper can tell
+        a live rollup from one frozen at a wedge), and the active alert
+        set. Reads only cached/cheap state — a scrape never triggers a
+        recompute."""
         return {
             "generation": self.journal.generation if self.journal else 0,
             "membership_version": self.membership.version,
             "alive_workers": self.membership.alive_count(),
             "cluster": self.health.snapshot(),
+            "alerts_active": self.alerts.active(),
         }
+
+    def _fleet_series(self) -> dict:
+        """The master's extra sampler input: fleet aggregates computed
+        from the heartbeat stats records Membership already holds, plus
+        control-plane load shape (backlog per worker). Runs only when a
+        time-series sample is actually due."""
+        from elasticdl_tpu.observability.timeseries import fleet_series
+
+        counts = self.dispatcher.counts()
+        snap = self.health.snapshot()
+        return fleet_series(
+            self.membership.health_snapshot(),
+            straggler_count=snap.get("straggler_count", 0),
+            todo_tasks=counts.get("todo", 0),
+            alive_workers=self.membership.alive_count(),
+        )
 
     def wait(
         self,
@@ -363,6 +404,12 @@ class Master:
             # fleet rollup + straggler scoring (never raises; gauges and
             # edge-triggered cluster.straggler events update here)
             self.health.update()
+            # time-series sample when due (fleet series computed only
+            # then) + declarative alert evaluation over the history —
+            # edge-triggered cluster.alert events, edl_alert_* metrics,
+            # flight-ring dump on page severity. Neither ever raises.
+            self.timeseries.maybe_sample(extra_fn=self._fleet_series)
+            self.alerts.evaluate()
             if self.summary is not None:
                 # control-plane metrics ride the summary stream (rate-
                 # limited inside; never raises)
@@ -442,6 +489,14 @@ class Master:
         if self.metrics_server is not None:
             self.metrics_server.stop()
             self.metrics_server = None
+        # terminal observe->decide state: one last fleet sample into the
+        # rolling history + the alert engine's final alerts.json, so the
+        # job's artifacts carry the end-of-run picture
+        try:
+            self.timeseries.sample(extra=self._fleet_series())
+            self.alerts.write_json()
+        except Exception:
+            logger.exception("final timeseries/alerts persistence failed")
         if self.journal is not None:
             if self.dispatcher.finished():
                 # clean completion: a journal left behind would make the

@@ -92,7 +92,13 @@ def _iter_trace_files(paths: Iterable[str]) -> List[str]:
             for dirpath, dirnames, filenames in os.walk(p):
                 dirnames.sort()
                 for fn in sorted(filenames):
-                    if fn.endswith(".jsonl"):
+                    # metrics_history files (observability/timeseries.py;
+                    # chaos writes them PREFIXED, chaos-smoke-seedN.
+                    # metrics_history.jsonl) are jsonl but not traces:
+                    # their lines parse fine and would pollute the record
+                    # pool with value rows — substring match, like the
+                    # journal walk
+                    if fn.endswith(".jsonl") and "metrics_history" not in fn:
                         out.append(os.path.join(dirpath, fn))
         else:
             out.append(p)

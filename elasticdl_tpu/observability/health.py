@@ -52,16 +52,19 @@ logger = default_logger(__name__)
 STATS_METADATA_KEY = "edl-worker-stats"
 
 #: decode() rejects payloads past this — a corrupt/hostile value must cost
-#: a bounded parse attempt, never master memory
+#: a bounded parse attempt, never master memory (key budget raised for
+#: ISSUE 11's embedding skew ride-along: emb_* keys below)
 MAX_PAYLOAD_BYTES = 2048
-MAX_PAYLOAD_KEYS = 24
+MAX_PAYLOAD_KEYS = 32
 
-#: step-profiler keys (observability/profile.py snapshot schema) carried
-#: from a worker's health record into its straggler info — the WHY behind
-#: a straggler flag
+#: step-profiler keys (observability/profile.py snapshot schema) plus the
+#: embedding-tier skew keys (embedding/tier.tier_stats) carried from a
+#: worker's health record into its straggler info — the WHY behind a
+#: straggler flag ("blocked on input" / "melting under tier pulls")
 _PROFILE_KEYS = (
     "phase_data_wait_ms", "phase_h2d_ms", "phase_compute_ms",
     "phase_handoff_ms", "mem_host_mb", "mem_dev_mb",
+    "emb_pull_p99_ms", "emb_hot_id_share", "emb_shard_imbalance",
 )
 
 # cluster rollup gauges (master-side; docs/observability.md)
@@ -381,9 +384,18 @@ class ClusterHealth:
             )
         return snap
 
-    def snapshot(self) -> Dict:
+    def snapshot(self, now: Optional[float] = None) -> Dict:
         """The last computed rollup (cheap; /healthz serves this — a
         scrape must never trigger a recompute, and scoring never depends
-        on the scrape surface being alive)."""
+        on the scrape surface being alive). `snapshot_age_s` stamps how
+        stale the cached rollup is AT SERVE TIME (-1 = never computed):
+        a scraper reading a wedged master's /healthz must be able to
+        tell a live rollup from one frozen at the wedge."""
         with self._lock:
-            return dict(self._last)
+            snap = dict(self._last)
+        ts = float(snap.get("ts") or 0.0)
+        now = time.time() if now is None else now
+        snap["snapshot_age_s"] = (
+            round(max(0.0, now - ts), 3) if ts > 0 else -1.0
+        )
+        return snap

@@ -45,7 +45,7 @@ class ObservabilityServer:
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  role: str = "", host: str = "127.0.0.1",
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 flight=None):
+                 flight=None, timeseries=None, alerts=None):
         self.registry = registry or default_registry()
         self.role = role
         self.host = host
@@ -53,6 +53,12 @@ class ObservabilityServer:
         # falls back to the process singleton at request time — the
         # recorder may be configured after the server starts
         self.flight = flight
+        # /timeseries serves this store's recent window (None falls back
+        # to the process singleton — every process has one); /alerts
+        # serves the engine's snapshot (masters wire one; elsewhere the
+        # endpoint answers with an empty, disabled-marked state)
+        self.timeseries = timeseries
+        self.alerts = alerts
         # /healthz enrichment: a dict merged into the response (the master
         # wires generation/alive-count/cluster-rollup here). Best-effort
         # like everything else on this surface — a raising callback marks
@@ -107,6 +113,49 @@ class ObservabilityServer:
                     )
                     body = (
                         json.dumps(bundle, default=repr) + "\n"
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/timeseries":
+                    # the bounded snapshot ring (observability/
+                    # timeseries.py): ?window=<s> bounds the window,
+                    # ?series=a,b filters. to_payload copies the ring
+                    # under its leaf lock and does the arithmetic
+                    # outside, so a scrape never blocks sampling.
+                    from urllib.parse import parse_qs, urlsplit
+
+                    from elasticdl_tpu.observability import (
+                        timeseries as ts_lib,
+                    )
+
+                    q = parse_qs(urlsplit(self.path).query)
+                    try:
+                        window = float(q.get("window", ["300"])[0])
+                    except ValueError:
+                        window = 300.0
+                    wanted = None
+                    if q.get("series"):
+                        wanted = [
+                            s for s in q["series"][0].split(",") if s
+                        ]
+                    store = outer.timeseries or ts_lib.get_store()
+                    payload = store.to_payload(
+                        window_s=window, series=wanted)
+                    payload["role"] = outer.role
+                    body = (
+                        json.dumps(payload, default=repr) + "\n"
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/alerts":
+                    # the alert engine's cached state (observability/
+                    # alerts.py) — a scrape never triggers an evaluation
+                    if outer.alerts is not None:
+                        payload = outer.alerts.snapshot()
+                    else:
+                        payload = {"enabled": False, "active": [],
+                                   "history": [], "rules": []}
+                    payload["role"] = outer.role
+                    body = (
+                        json.dumps(payload, default=repr) + "\n"
                     ).encode()
                     ctype = "application/json"
                 elif self.path.split("?")[0] == "/healthz":
@@ -206,6 +255,7 @@ class ObservabilityServer:
 def start_server(role: str = "", port: Optional[int] = None,
                  registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
+                 timeseries=None, alerts=None,
                  ) -> Optional[ObservabilityServer]:
     """Best-effort endpoint start for the master/worker entrypoints.
     A set (non-empty) EDL_METRICS_PORT env overrides `port` in BOTH
@@ -233,7 +283,8 @@ def start_server(role: str = "", port: Optional[int] = None,
     if port < 0:
         return None
     server = ObservabilityServer(
-        registry=registry, role=role, health_fn=health_fn
+        registry=registry, role=role, health_fn=health_fn,
+        timeseries=timeseries, alerts=alerts,
     )
     try:
         server.start(port)

@@ -47,6 +47,7 @@ KEY_EVENT_NAMES = (
     "flight.crash", "flight.dump", "master.crash", "master.recovered",
     "worker.reconnect", "membership.reregister", "membership.death",
     "cluster.straggler", "cluster.straggler_cleared",
+    "cluster.alert", "cluster.alert_cleared",
     "rpc.generation_handshake", "rpc.breaker_open", "rpc.breaker_reset",
     "reform.announce",
 )
@@ -221,7 +222,8 @@ def _timeline_entry(rec: dict, source: str) -> Optional[dict]:
         "source": source,
     }
     for k in ("dur_ms", "reason", "error", "level", "msg", "worker_id",
-              "generation", "trace_id", "score", "world_version"):
+              "generation", "trace_id", "score", "world_version",
+              "rule", "severity", "value", "threshold"):
         if k in rec and rec[k] is not None:
             entry[k] = rec[k]
     return entry
@@ -339,11 +341,19 @@ def render_text(report: dict, max_entries: int = 200) -> str:
             f"tail of {len(journal['tail'])} kept"
         )
     for snap in report.get("health") or ():
+        # snapshot_age_s (ISSUE 11): how stale the rollup was when it
+        # was served — the difference between "the fleet was fine" and
+        # "the master stopped looking"
+        age = snap.get("snapshot_age_s")
+        cluster = snap.get("cluster") or snap
+        if age is None:
+            age = cluster.get("snapshot_age_s")
         lines.append(
             f"health {snap.get('_path', '?')}: "
-            f"{snap.get('workers_reporting', 0)} reporting, "
-            f"{snap.get('straggler_count', 0)} straggler(s), "
-            f"skew {snap.get('skew', 1.0)}"
+            f"{cluster.get('workers_reporting', 0)} reporting, "
+            f"{cluster.get('straggler_count', 0)} straggler(s), "
+            f"skew {cluster.get('skew', 1.0)}"
+            + (f", rollup age {age}s" if age is not None else "")
         )
 
     timeline = report["timeline"]
@@ -359,7 +369,8 @@ def render_text(report: dict, max_entries: int = 200) -> str:
     t0 = timeline[0]["ts"] if timeline else 0.0
     for e in shown:
         extra = ""
-        for k in ("reason", "error", "msg", "worker_id", "generation"):
+        for k in ("reason", "error", "msg", "worker_id", "generation",
+                  "rule", "severity", "value"):
             if k in e:
                 extra += f" {k}={e[k]}"
         dur = f" {e['dur_ms']:.1f}ms" if "dur_ms" in e else ""

@@ -283,9 +283,13 @@ class Histogram(Metric):
                 suffix = _render_labels(self.label_names, key)
                 out[self.name + "_count" + suffix] = float(child.count)
                 out[self.name + "_sum" + suffix] = child.sum
+                # sort the reservoir ONCE for all quantiles — snapshot
+                # runs on the summary-stream/time-series cadence, and
+                # per-quantile sorts triple its dominant cost
+                s = sorted(child.sample)
                 for q in _QUANTILES:
                     out[f"{self.name}_p{int(q * 100)}{suffix}"] = (
-                        child.quantile(q)
+                        quantile_sorted(s, q)
                     )
         return out
 

@@ -418,6 +418,14 @@ class CohortWorker:
         # per-step phase breakdown + memory watermarks (the leader's own;
         # follower profiles ride their MemberBeats via the exchange)
         stats.update(profile_lib.get_profiler().snapshot())
+        # embedding-tier skew ride-along (ISSUE 11; see worker.py's
+        # _stats_payload) — best-effort, never costs the heartbeat
+        if self._tier is not None:
+            try:
+                stats.update(self._tier.client.tier_stats())
+            except Exception:
+                # edl-lint: disable=EDL303
+                pass
         return stats
 
     def _member_beats(self) -> List[pb.MemberBeat]:
@@ -527,7 +535,11 @@ class CohortWorker:
         self._member_stats = fresh   # atomic swap; heartbeat thread reads
 
     def _heartbeat_loop(self) -> None:
+        from elasticdl_tpu.observability import timeseries as timeseries_lib
+
         while not self._shutdown.is_set():
+            # interval-gated time-series sample (normally a clock read)
+            timeseries_lib.get_store().maybe_sample()
             try:
                 # optional telemetry metadata; a payload failure degrades
                 # this beat to liveness-only (same contract as worker.py)
@@ -1052,6 +1064,14 @@ class CohortWorker:
             # land on the leader for the next heartbeat's MemberBeats
             self._exchange_member_stats()
 
+        # every process — followers included — samples its own time-series
+        # ring at the task boundary (interval-gated: a clock read when not
+        # due). The leader additionally samples from its heartbeat thread;
+        # followers have no heartbeat, so this is their only cadence.
+        from elasticdl_tpu.observability import timeseries as timeseries_lib
+
+        timeseries_lib.get_store().maybe_sample()
+
         if flags & FLAG_CHECKPOINT:
             mngr = self._checkpoint_manager()
             if mngr is not None and self._state is not None:
@@ -1143,6 +1163,12 @@ class CohortWorker:
         # (crash/SIGUSR2//debug/flight triggers; flight.py trigger matrix)
         flight_lib.configure_from_config(self.cfg, role=role)
         flight_lib.install_crash_hooks()
+        # metrics time series: ring + rolling history for this process;
+        # sampled from the leader's heartbeat loop (followers sample at
+        # task boundaries via the same singleton)
+        from elasticdl_tpu.observability import timeseries as timeseries_lib
+
+        timeseries_lib.configure_from_config(self.cfg, role=role)
         reform_tid = membership_signal.trace_id()
         # a set EDL_METRICS_PORT overrides cfg.metrics_port either way
         metrics_server = start_server(

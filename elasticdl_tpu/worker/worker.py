@@ -26,6 +26,7 @@ from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import flight as flight_lib
 from elasticdl_tpu.observability import profile as profile_lib
+from elasticdl_tpu.observability import timeseries as timeseries_lib
 from elasticdl_tpu.observability import tracing
 from elasticdl_tpu.observability.health import (
     STATS_METADATA_KEY,
@@ -161,6 +162,13 @@ class Worker:
             self.cfg, role=f"worker-{self.worker_id}"
         )
         flight_lib.install_crash_hooks()
+        # metrics time series (observability/timeseries.py): the process
+        # ring behind GET /timeseries + rolling metrics_history.jsonl;
+        # sampled from the heartbeat loop (the interval gate makes the
+        # per-beat cost a clock read)
+        timeseries_lib.configure_from_config(
+            self.cfg, role=f"worker-{self.worker_id}"
+        )
         logger.info(
             "registered as worker %d (membership v%d, %d workers)",
             self.worker_id, resp.membership_version, resp.num_workers,
@@ -445,10 +453,24 @@ class Worker:
         # step-profiler phase breakdown + memory watermarks (bounded key
         # set): the master's ClusterHealth sees WHY a straggler is slow
         stats.update(profile_lib.get_profiler().snapshot())
+        # embedding-tier skew ride-along (ISSUE 11): hot-id share, shard
+        # imbalance, recent pull/push p99 — the fleet rollup's sensor for
+        # the hot-row-cache decision. Best-effort like the rest of the
+        # payload: a tier hiccup must never cost the heartbeat.
+        if self._tier is not None:
+            try:
+                stats.update(self._tier.client.tier_stats())
+            except Exception:
+                # edl-lint: disable=EDL303
+                pass
         return stats
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.is_set():
+            # time-series sample when due (interval-gated: normally one
+            # clock read per beat); rides the heartbeat thread so the
+            # train loop never pays for a registry snapshot
+            timeseries_lib.get_store().maybe_sample()
             try:
                 # chaos hook: worker.heartbeat:crash kills the process here
                 # (a hard worker death between task boundaries); drop/delay

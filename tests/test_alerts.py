@@ -1,0 +1,360 @@
+"""Alert engine (observability/alerts.py): declarative rules, edge
+triggering with carried-forward blindness semantics, burn-rate windows,
+page-severity flight dumps, the hook seam, and the concurrent-scrape
+contract over /alerts + /timeseries."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.observability.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_rules,
+    rules_from_json,
+)
+from elasticdl_tpu.observability.registry import MetricsRegistry
+from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+SERIES = "edl_fleet_probe"
+
+
+def make_engine(rules, **kw):
+    store = TimeSeriesStore(
+        capacity=512, interval_s=0.0, registry=MetricsRegistry())
+    dumps = []
+    eng = AlertEngine(store, rules=rules,
+                      flight_dump=dumps.append, **kw)
+    return store, eng, dumps
+
+
+def feed(store, eng, t0, values, step_s=5.0):
+    """Sample value[i] at t0 + i*step and evaluate after each."""
+    for i, v in enumerate(values):
+        now = t0 + step_s * i
+        extra = {} if v is None else {SERIES: v}
+        store.sample(now=now, extra=extra)
+        eng.evaluate(now=now)
+    return t0 + step_s * (len(values) - 1)
+
+
+def onsets(eng):
+    return [h for h in eng.snapshot()["history"]
+            if h["transition"] == "firing"]
+
+
+def clears(eng):
+    return [h for h in eng.snapshot()["history"]
+            if h["transition"] == "cleared"]
+
+
+# ---------------------------------------------------------------------- #
+# rule validation
+
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule("x", series=SERIES, threshold=1, mode="nope")
+    with pytest.raises(ValueError):
+        AlertRule("x", series=SERIES, threshold=1, op="!=")
+    with pytest.raises(ValueError):
+        AlertRule("x", series=SERIES, threshold=1, severity="critical")
+    with pytest.raises(ValueError):
+        AlertRule("x", series=SERIES, threshold=1, mode="burn_rate",
+                  window_s=60, long_window_s=30)
+
+
+def test_duplicate_rule_names_rejected():
+    store = TimeSeriesStore(registry=MetricsRegistry())
+    rules = [AlertRule("a", series=SERIES, threshold=1),
+             AlertRule("a", series=SERIES, threshold=2)]
+    with pytest.raises(ValueError):
+        AlertEngine(store, rules=rules)
+
+
+def test_rules_from_json_rejects_unknown_keys():
+    good = rules_from_json([
+        {"name": "a", "series": SERIES, "threshold": 2.0,
+         "mode": "avg", "window_s": 30}
+    ])
+    assert good[0].name == "a" and good[0].mode == "avg"
+    with pytest.raises(ValueError):
+        rules_from_json([{"name": "a", "series": SERIES,
+                          "threshold": 2.0, "treshold": 3.0}])
+    with pytest.raises(ValueError):
+        rules_from_json({"name": "a"})
+
+
+def test_default_rules_are_valid_and_unique():
+    rules = default_rules()
+    names = [r.name for r in rules]
+    assert len(set(names)) == len(names)
+    assert {"straggler", "dispatcher_backlog_per_worker",
+            "fleet_data_wait_dominant", "embedding_pull_p99",
+            "embedding_shard_imbalance"} == set(names)
+    # page rules are the flight-dumping ones
+    pages = {r.name for r in rules if r.severity == "page"}
+    assert pages == {"embedding_pull_p99", "embedding_shard_imbalance"}
+
+
+# ---------------------------------------------------------------------- #
+# edge triggering (the satellite's named coverage)
+
+
+def test_onset_fires_once_and_clears_once():
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10, mode="value")])
+    hook_calls = []
+    eng.add_hook(hook_calls.append)
+    t = feed(store, eng, 1000.0, [1, 1, 50, 60, 70, 80])   # bad from i=2
+    assert len(onsets(eng)) == 1
+    assert len(hook_calls) == 1
+    assert hook_calls[0]["rule"] == "probe"
+    assert [a["rule"] for a in eng.active()] == ["probe"]
+    # recovery
+    feed(store, eng, t + 5, [2, 2, 2])
+    assert eng.active() == []
+    assert len(clears(eng)) == 1
+    assert len(onsets(eng)) == 1       # no re-onset anywhere
+
+
+def test_for_s_holds_back_onset_until_held():
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10, mode="value",
+                   for_s=12.0)])
+    # bad at t=0 and t=5: held for 5s < 12s -> still pending
+    feed(store, eng, 1000.0, [50, 50])
+    assert eng.active() == []
+    # bad at t=10 and t=15: held >= 12s at t=15 -> onset (once)
+    feed(store, eng, 1010.0, [50, 50])
+    assert len(onsets(eng)) == 1
+    # a recovery resets the pending clock
+    store2, eng2, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10, mode="value",
+                   for_s=12.0)])
+    feed(store2, eng2, 1000.0, [50, 50, 1, 50, 50])
+    assert eng2.active() == []         # never held 12s continuously
+
+
+def test_carried_forward_on_blindness_no_spurious_clear():
+    """An ACTIVE alert whose series stops appearing (reporter died) is
+    carried forward: no clear, no second onset when data returns bad,
+    exactly one clear when data returns good."""
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10, mode="value",
+                   window_s=30.0)])
+    t = feed(store, eng, 1000.0, [50, 60])
+    assert len(onsets(eng)) == 1
+    # blindness: samples WITHOUT the series, long past the window
+    t = feed(store, eng, t + 5, [None] * 20)
+    active = eng.active()
+    assert [a["rule"] for a in active] == ["probe"]
+    assert active[0]["carried_forward"] is True
+    assert clears(eng) == []
+    # data returns, still bad: NO second onset
+    t = feed(store, eng, t + 5, [70, 70])
+    assert len(onsets(eng)) == 1
+    assert eng.active()[0]["carried_forward"] is False
+    # data returns good: exactly one clear
+    feed(store, eng, t + 5, [1, 1])
+    assert len(clears(eng)) == 1
+    assert eng.active() == []
+
+
+def test_burn_rate_requires_both_windows():
+    """A transient spike breaches the short window but not the long one:
+    no page. A sustained burn breaches both: page."""
+    rule = AlertRule("probe", series=SERIES, threshold=100,
+                     mode="burn_rate", window_s=30, long_window_s=300)
+    store, eng, _ = make_engine([rule])
+    # 300s of health, then one 30s spike, then health again
+    t = feed(store, eng, 1000.0, [1] * 60)
+    t = feed(store, eng, t + 5, [500] * 6)     # 30s spike
+    assert eng.active() == []                  # long window still healthy
+    t = feed(store, eng, t + 5, [1] * 10)
+    assert onsets(eng) == []
+    # sustained: long window saturates too
+    feed(store, eng, t + 5, [500] * 70)
+    assert len(onsets(eng)) == 1
+    info = onsets(eng)[0]
+    assert info["value"] > 100 and info["long_value"] > 100
+
+
+def test_rate_mode_alerts_on_counter_rate_of_change():
+    rule = AlertRule("probe_rate", series="edl_fleet_errs_total",
+                     threshold=5.0, mode="rate", window_s=60)
+    store, eng, _ = make_engine([rule])
+    v = 0.0
+    for i in range(10):                 # +1/s: rate 1 < 5
+        v += 5.0
+        store.sample(now=1000.0 + 5 * i,
+                     extra={"edl_fleet_errs_total": v})
+        eng.evaluate(now=1000.0 + 5 * i)
+    assert eng.active() == []
+    for i in range(10, 24):             # +50 per 5s: rate 10 > 5
+        v += 50.0
+        store.sample(now=1000.0 + 5 * i,
+                     extra={"edl_fleet_errs_total": v})
+        eng.evaluate(now=1000.0 + 5 * i)
+    assert len(onsets(eng)) == 1
+
+
+# ---------------------------------------------------------------------- #
+# side effects: metrics, events, flight dump, persistence
+
+
+def test_page_severity_dumps_flight_ring_warn_does_not():
+    store, eng, dumps = make_engine([
+        AlertRule("warny", series=SERIES, threshold=10, severity="warn"),
+        AlertRule("pagey", series="edl_fleet_other", threshold=10,
+                  severity="page"),
+    ])
+    store.sample(now=1000.0, extra={SERIES: 50, "edl_fleet_other": 1})
+    eng.evaluate(now=1000.0)
+    assert dumps == []                 # only warn fired
+    store.sample(now=1005.0, extra={SERIES: 50, "edl_fleet_other": 99})
+    eng.evaluate(now=1005.0)
+    assert dumps == ["alert:pagey"]
+
+
+def test_transition_metrics_and_events():
+    from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.registry import default_registry
+
+    reg = default_registry()
+    active = reg.get("edl_alert_active")
+    transitions = reg.get("edl_alert_transitions_total")
+    store, eng, _ = make_engine(
+        [AlertRule("probe_m", series=SERIES, threshold=10)])
+    events = []
+
+    def sink(rec):
+        if rec.get("name", "").startswith("cluster.alert"):
+            events.append(rec)
+
+    tracing.get_tracer().add_sink(sink)
+    try:
+        t = feed(store, eng, 1000.0, [50, 60, 70])
+        assert active.value(rule="probe_m") == 1
+        assert transitions.value(rule="probe_m") == 1
+        feed(store, eng, t + 5, [1])
+        assert active.value(rule="probe_m") == 0
+        assert transitions.value(rule="probe_m") == 2
+    finally:
+        tracing.get_tracer().remove_sink(sink)
+    names = [e["name"] for e in events]
+    assert names.count("cluster.alert") == 1
+    assert names.count("cluster.alert_cleared") == 1
+    onset = next(e for e in events if e["name"] == "cluster.alert")
+    assert onset["rule"] == "probe_m" and onset["severity"] == "warn"
+
+
+def test_failing_hook_never_breaks_evaluation():
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10)])
+    eng.add_hook(lambda info: 1 / 0)
+    ok = []
+    eng.add_hook(ok.append)
+    feed(store, eng, 1000.0, [50])
+    assert len(ok) == 1                # later hooks still ran
+    assert [a["rule"] for a in eng.active()] == ["probe"]
+
+
+def test_evaluate_never_raises_even_with_broken_store():
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10)])
+    eng._store = None                  # worst case: store gone
+    snap = eng.evaluate(now=1000.0)
+    assert snap["active"] == []
+
+
+def test_write_json_atomic(tmp_path):
+    path = str(tmp_path / "control" / "alerts.json")
+    store, eng, _ = make_engine(
+        [AlertRule("probe", series=SERIES, threshold=10)],
+        json_path=path)
+    feed(store, eng, 1000.0, [50])     # transition writes the file
+    with open(path) as f:
+        doc = json.load(f)
+    assert [a["rule"] for a in doc["active"]] == ["probe"]
+    assert doc["rules"][0]["name"] == "probe"
+    assert doc["history"][0]["transition"] == "firing"
+
+
+# ---------------------------------------------------------------------- #
+# the satellite's concurrency coverage: /alerts + /timeseries scrape
+# while rules evaluate
+
+
+def test_concurrent_scrape_while_evaluating():
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    reg = MetricsRegistry()
+    store = TimeSeriesStore(capacity=256, interval_s=0.0, registry=reg)
+    eng = AlertEngine(
+        store,
+        rules=[AlertRule("probe", series=SERIES, threshold=10)],
+        flight_dump=lambda r: None,
+    )
+    server = ObservabilityServer(
+        registry=reg, role="t", timeseries=store, alerts=eng)
+    port = server.start(0)
+    stop = threading.Event()
+    errs = []
+
+    def evaluator():
+        i = 0
+        while not stop.is_set():
+            # values oscillate across the threshold: transitions happen
+            # WHILE scrapes read state
+            store.sample(extra={SERIES: 50 if (i // 3) % 2 else 1})
+            eng.evaluate()
+            i += 1
+
+    def scraper(path):
+        while not stop.is_set():
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=5
+                ) as resp:
+                    assert resp.status == 200
+                    json.loads(resp.read())
+            except Exception as e:     # pragma: no cover
+                errs.append((path, e))
+                return
+
+    threads = [
+        threading.Thread(target=evaluator),
+        threading.Thread(target=scraper, args=("/alerts",)),
+        threading.Thread(target=scraper, args=("/timeseries?window=60",)),
+    ]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.8)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        server.stop()
+    assert not errs, errs
+    # transitions really happened under the scrape load
+    assert eng.snapshot()["evaluations"] > 5
+
+
+def test_alerts_endpoint_disabled_shape():
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    server = ObservabilityServer(registry=MetricsRegistry(), role="t")
+    port = server.start(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/alerts", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        assert doc["enabled"] is False and doc["active"] == []
+    finally:
+        server.stop()

@@ -1145,7 +1145,7 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
                 "EDL206", "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
-                "EDL401", "EDL402", "EDL403", "EDL404"):
+                "EDL401", "EDL402", "EDL403", "EDL404", "EDL405"):
         assert rid in out
 
 
@@ -1231,3 +1231,106 @@ def test_span_sink_suppressible_inline():
                     tracing.event("x")  # edl-lint: disable=EDL404
     """
     assert findings_for(src, select={"EDL404"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL405 unbounded-metric-label-cardinality
+
+
+EDL405_BAD = """
+    from elasticdl_tpu.observability.registry import default_registry
+
+    _reg = default_registry()
+    _ROWS = _reg.counter("edl_x_rows_total", "rows", labels=("task",))
+    _LAT = _reg.histogram("edl_x_lat_seconds", "lat", labels=("task",))
+    _LVL = _reg.gauge("edl_x_level", "level", labels=("worker",))
+
+    def per_task(tasks):
+        for task in tasks:                       # unbounded: data-driven
+            _ROWS.inc(task.records, task=task.name)
+
+    def per_task_fstring(tasks):
+        for t in tasks:
+            _LAT.observe(t.wall, task=f"task-{t.id}")
+
+    def per_worker_comprehension(workers):
+        return [_LVL.set(w.load, worker=str(w)) for w in workers]
+"""
+
+EDL405_GOOD = """
+    from elasticdl_tpu.observability.registry import default_registry
+
+    _reg = default_registry()
+    _ROWS = _reg.counter("edl_x_rows_total", "rows", labels=("op",))
+    _PHASE = _reg.gauge("edl_x_phase_seconds", "p", labels=("phase",))
+
+    PHASES = ("data_wait", "h2d", "compute")
+
+    def parameter_labels_are_fine(op, n):
+        # the label comes from a parameter, not a loop: the CALLER
+        # decides cardinality (store.push's table/shard shape)
+        _ROWS.inc(n, op=op)
+
+    def bounded_constant_iteration():
+        for phase in PHASES:                 # module-level constant tuple
+            _PHASE.set(0.0, phase=phase)
+
+    def literal_iteration():
+        for op in ("pull", "push"):          # literal tuple: bounded
+            _ROWS.inc(0, op=op)
+
+    def loop_value_not_label(items):
+        for item in items:
+            _ROWS.inc(item.count, op="pull")   # loop feeds the VALUE
+
+    def unrelated_calls(things):
+        for t in things:
+            t.registry.set(t)                  # not a metric receiver
+"""
+
+
+def test_unbounded_label_cardinality_fires_on_loop_derived_labels():
+    fs = findings_for(EDL405_BAD, select={"EDL405"})
+    assert rule_ids(fs) == ["EDL405"]
+    assert len(fs) == 3
+    assert sorted(f.context for f in fs) == [
+        "per_task", "per_task_fstring", "per_worker_comprehension",
+    ]
+    assert all("grow the registry without bound" in f.message for f in fs)
+
+
+def test_unbounded_label_cardinality_quiet_on_bounded_shapes():
+    assert findings_for(EDL405_GOOD, select={"EDL405"}) == []
+
+
+def test_unbounded_label_cardinality_suppressible_with_justification():
+    src = """
+        from elasticdl_tpu.observability.registry import default_registry
+
+        _reg = default_registry()
+        _LOAD = _reg.gauge("edl_x_shard_load", "l", labels=("shard",))
+
+        def per_shard(loads, num_shards):
+            for s in range(num_shards):
+                # bounded by --embedding_shards (config constant):
+                # edl-lint: disable=EDL405
+                _LOAD.set(loads[s], shard=str(s))
+    """
+    assert findings_for(src, select={"EDL405"}) == []
+    # and WITHOUT the disable the same shape fires (range() is not
+    # statically bounded — the reviewer's knowledge is the bound)
+    undisabled = src.replace(
+        "# bounded by --embedding_shards (config constant):\n", ""
+    ).replace("# edl-lint: disable=EDL405\n", "")
+    fs = findings_for(undisabled, select={"EDL405"})
+    assert rule_ids(fs) == ["EDL405"]
+
+
+def test_tier_per_shard_gauge_carries_the_reviewed_disable():
+    # the live tree's one intentional per-shard label loop
+    # (embedding/tier.py _note_shard_loads) must keep its justification —
+    # meta-test so the disable cannot silently rot
+    import elasticdl_tpu.embedding.tier as tmod
+
+    src = open(tmod.__file__, encoding="utf-8").read()
+    assert "edl-lint: disable=EDL405" in src

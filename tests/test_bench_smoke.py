@@ -141,15 +141,20 @@ def test_control_plane_leg_smoke(bench, monkeypatch):
         assert rc[mode]["stranded_lease_requeued"] is True, rc
 
 
-def test_embedding_tier_leg_smoke(bench, monkeypatch):
-    """The elastic embedding tier scenario (ISSUE 10): tiny sizes must
-    still run the full shape — sharded vs single-host serving loops with
-    measured dedupe (< 1 on the skewed distribution), pull/push
-    latencies, and the kill-worker resharding scenario with bit-exact
-    shards, exactly-once accounting (one injected lost ack absorbed),
-    compile-cache-warm recovery, and a crash-consistent journaled map.
-    The >= 3x throughput claim itself is sized for the full bench run,
-    not this smoke."""
+def test_embedding_tier_leg_smoke(bench, monkeypatch, tmp_path):
+    """The elastic embedding tier scenario (ISSUE 10 + the ISSUE 11
+    skew/alert acceptance): tiny sizes must still run the full shape —
+    sharded vs single-host serving loops with measured dedupe (< 1 on
+    the skewed distribution), pull/push latencies, the kill-worker
+    resharding scenario with bit-exact shards, exactly-once accounting
+    (one injected lost ack absorbed), compile-cache-warm recovery, a
+    crash-consistent journaled map — AND the kill must raise a
+    pull-p99/shard-imbalance alert, edge-triggered ONCE, that the
+    incident CLI finds in the uploaded artifacts with a clean --strict
+    pass. The >= 3x throughput claim itself is sized for the full bench
+    run, not this smoke."""
+    art = str(tmp_path / "art")
+    monkeypatch.setenv("EDL_BENCH_ARTIFACT_DIR", art)
     monkeypatch.setattr(bench, "ET_VOCAB", 8192)
     monkeypatch.setattr(bench, "ET_BATCH", 256)
     monkeypatch.setattr(bench, "ET_LEN", 8)
@@ -161,6 +166,12 @@ def test_embedding_tier_leg_smoke(bench, monkeypatch):
     for key in ("pull_p50_ms", "pull_p99_ms", "push_p50_ms", "push_p99_ms"):
         assert s[key] >= 0
     assert res["sharded_speedup"] > 0
+    # skew telemetry (ISSUE 11 acceptance): the zipf stream's hot-id
+    # share must be consistent with its measured dedupe ratio — a
+    # heavily-duplicated stream concentrates traffic on a small head
+    # (hot_id_share is a guaranteed LOWER bound, so the gate is one-sided)
+    assert 0.3 < res["hot_id_share"] <= 1.0, res["hot_id_share"]
+    assert res["shard_load_imbalance"] >= 1.0
     rs = res["reshard"]
     assert rs["bit_exact"] is True, rs
     assert rs["exactly_once"] is True, rs
@@ -171,6 +182,32 @@ def test_embedding_tier_leg_smoke(bench, monkeypatch):
     assert rs["reshard_compile_misses"] == 0, rs
     assert rs["journal_map_consistent"] is True, rs
     assert rs["recovery_s"] > 0
+    # the kill raised exactly one alert onset (edge-triggered), of the
+    # embedding sensor pair
+    al = rs["alert"]
+    assert al["raised"] in ("embedding_pull_p99",
+                            "embedding_shard_imbalance"), al
+    assert al["onsets"] == 1, al
+    assert al["killwindow_pull_p99_ms"] > al["pull_p99_threshold_ms"], al
+    # artifacts: alerts.json + rolling metrics_history.jsonl + the trace
+    # — and the incident CLI merges the cluster.alert into its timeline
+    # with a clean strict pass (the CI job runs exactly this)
+    import json as _json
+
+    names = sorted(os.listdir(art))
+    assert "alerts.json" in names and "metrics_history.jsonl" in names
+    with open(os.path.join(art, "alerts.json")) as f:
+        alerts_doc = _json.load(f)
+    assert [h["rule"] for h in alerts_doc["history"]
+            if h["transition"] == "firing"] == [al["raised"]]
+    from elasticdl_tpu.observability import incident
+
+    assert incident.main([art, "--strict"]) == 0
+    report = incident.correlate([art])
+    alert_entries = [e for e in report["timeline"]
+                     if e["name"] == "cluster.alert"]
+    assert len(alert_entries) == 1
+    assert alert_entries[0]["rule"] == al["raised"]
 
 
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
@@ -195,3 +232,94 @@ def test_obs_overhead_leg_smoke(bench, mesh8, monkeypatch):
     # would mean the instrumentation path broke, not drifted
     assert res["median_step_s_on"] < 10 * res["median_step_s_off"]
     assert "2%" in res["gate"]
+
+
+# ---------------------------------------------------------------------- #
+# baseline compare mode (ISSUE 11): the perf trajectory machine-checked
+
+
+def test_bench_compare_passes_on_improvement(bench):
+    base = {"leg": {"rows_per_sec": 1000.0, "pull_p99_ms": 10.0,
+                    "bit_exact": True, "note": "informational", "n": 3}}
+    cur = {"leg": {"rows_per_sec": 1400.0, "pull_p99_ms": 8.0,
+                   "bit_exact": True, "n": 99}}
+    report = bench.bench_compare(base, cur, threshold_pct=30)
+    assert report["regressions"] == []
+    paths = {c["path"] for c in report["compared"]}
+    assert paths == {"leg.rows_per_sec", "leg.pull_p99_ms"}
+    # ungated numerics are reported, never gated
+    assert {i["path"] for i in report["informational"]} == {"leg.n"}
+
+
+def test_bench_compare_flags_regressions_and_boolean_gates(bench):
+    base = {"leg": {"rows_per_sec": 1000.0, "pull_p99_ms": 10.0,
+                    "exactly_once": True, "recompile_hit_rate": 1.0}}
+    cur = {"leg": {"rows_per_sec": 500.0, "pull_p99_ms": 40.0,
+                   "exactly_once": False, "recompile_hit_rate": 0.5}}
+    report = bench.bench_compare(base, cur, threshold_pct=30)
+    bad = {r["path"] for r in report["regressions"]}
+    assert bad == {"leg.rows_per_sec", "leg.pull_p99_ms",
+                   "leg.exactly_once", "leg.recompile_hit_rate"}
+
+
+def test_bench_compare_missing_gated_metric_is_a_regression(bench):
+    base = {"leg": {"rows_per_sec": 1000.0}}
+    report = bench.bench_compare(base, {"leg": {}}, threshold_pct=30)
+    assert [r["path"] for r in report["regressions"]] == [
+        "leg.rows_per_sec"]
+    assert "missing" in report["regressions"][0]["why"]
+
+
+def test_bench_compare_absolute_slack_handles_near_zero_baselines(bench):
+    # overhead_pct hovers around 0 inside box noise: -0.3 -> 1.2 is NOT
+    # a regression (5-percentage-point slack), -0.3 -> 9 is
+    base = {"obs": {"overhead_pct": -0.3}}
+    ok = bench.bench_compare(base, {"obs": {"overhead_pct": 1.2}},
+                             threshold_pct=30)
+    assert ok["regressions"] == []
+    bad = bench.bench_compare(base, {"obs": {"overhead_pct": 9.0}},
+                              threshold_pct=30)
+    assert [r["path"] for r in bad["regressions"]] == ["obs.overhead_pct"]
+
+
+def test_bench_compare_cli_exit_codes(bench, tmp_path, capsys):
+    import json as _json
+
+    base = tmp_path / "base.json"
+    good = tmp_path / "good.json"
+    bad = tmp_path / "bad.json"
+    base.write_text(_json.dumps(
+        {"leg": {"rows_per_sec": 100.0, "bit_exact": True}}))
+    good.write_text(_json.dumps(
+        {"leg": {"rows_per_sec": 120.0, "bit_exact": True}}))
+    bad.write_text(_json.dumps(
+        {"leg": {"rows_per_sec": 10.0, "bit_exact": False}}))
+    assert bench._compare_cli([str(base), str(good)]) == 0
+    capsys.readouterr()
+    assert bench._compare_cli([str(base), str(bad)]) == 1
+    capsys.readouterr()
+    # usage errors: bad arity, unreadable file
+    assert bench._compare_cli([str(base)]) == 2
+    assert bench._compare_cli([str(base), str(tmp_path / "nope.json")]) == 2
+    capsys.readouterr()
+
+
+def test_checked_in_baselines_compare_clean_against_themselves(bench):
+    """The committed bench-baselines/ artifacts must parse and self-
+    compare with zero regressions — a malformed baseline would fail
+    every CI bench job at the compare step."""
+    import json as _json
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bdir = os.path.join(repo, "bench-baselines")
+    names = sorted(os.listdir(bdir))
+    assert {"bench-control-plane.json", "bench-embedding-tier.json",
+            "bench-obs-overhead.json", "bench-rescale.json"} <= set(names)
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        with open(os.path.join(bdir, name)) as f:
+            doc = _json.load(f)
+        report = bench.bench_compare(doc, doc, threshold_pct=30)
+        assert report["regressions"] == [], (name, report["regressions"])
+        assert report["compared"], name   # something is actually gated

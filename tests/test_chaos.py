@@ -77,8 +77,13 @@ def run_control_plane_scenario(seed: int):
 
     from elasticdl_tpu.observability import health as health_lib
     from elasticdl_tpu.observability import tracing
+    from elasticdl_tpu.observability.alerts import AlertEngine, default_rules
     from elasticdl_tpu.observability.health import ClusterHealth
     from elasticdl_tpu.observability.registry import default_registry
+    from elasticdl_tpu.observability.timeseries import (
+        TimeSeriesStore,
+        fleet_series,
+    )
 
     art_dir = os.environ.get("EDL_CHAOS_ARTIFACT_DIR")
     flight_rec = None
@@ -106,6 +111,25 @@ def run_control_plane_scenario(seed: int):
     servicer = MasterServicer(dispatcher, membership, None)
     cluster_health = ClusterHealth(membership)
     step_stats = health_lib.WorkerStepStats()
+    # observe->decide backbone riding the chaos schedule (ISSUE 11): a
+    # time-series ring sampled on a deterministic iteration cadence +
+    # the default alert rules evaluated against it — the run's rolling
+    # metrics_history.jsonl and alerts.json upload with the other
+    # artifacts (values are wall-clock noise; the artifact's point is
+    # the PLUMBING surviving chaos, and no assertion reads them)
+    ts_store = TimeSeriesStore(
+        capacity=512, interval_s=0.0,
+        history_path=(os.path.join(
+            art_dir, f"chaos-smoke-seed{seed}.metrics_history.jsonl")
+            if art_dir else None),
+    )
+    alert_engine = AlertEngine(
+        ts_store, rules=default_rules(),
+        json_path=(os.path.join(
+            art_dir, f"chaos-smoke-seed{seed}.alerts.json")
+            if art_dir else None),
+        flight_dump=lambda reason: None,
+    )
     # lock-order recording rides the whole scenario: any inversion
     # introduced into the control plane raises at its acquire site, and
     # the graph is certified acyclic before the scenario returns
@@ -131,7 +155,16 @@ def run_control_plane_scenario(seed: int):
         wid = stub.RegisterWorker(
             pb.RegisterWorkerRequest(worker_name="chaos-smoke")
         ).worker_id
-        for _ in range(10_000):            # livelock guard
+        for it in range(10_000):           # livelock guard
+            if it % 50 == 0:
+                ts_store.sample(extra=fleet_series(
+                    membership.health_snapshot(),
+                    straggler_count=cluster_health.snapshot().get(
+                        "straggler_count", 0),
+                    todo_tasks=dispatcher.counts()["todo"],
+                    alive_workers=membership.alive_count(),
+                ))
+                alert_engine.evaluate()
             try:
                 stub.Heartbeat(
                     pb.HeartbeatRequest(worker_id=wid),
@@ -193,13 +226,20 @@ def run_control_plane_scenario(seed: int):
                 f.write(default_registry().render_prometheus())
             # the cluster-health rollup the run ended with (ISSUE 7):
             # uploaded next to trace + metrics so a chaos regression in
-            # the telemetry path ships its own fleet-health evidence
+            # the telemetry path ships its own fleet-health evidence.
+            # snapshot() (not the raw update() dict) so the serialized
+            # rollup carries snapshot_age_s (ISSUE 11) — the incident
+            # CLI prints the age next to each snapshot it correlates
+            cluster_health.update()
             with open(
                 os.path.join(art_dir, f"chaos-smoke-seed{seed}.health.json"),
                 "w",
             ) as f:
-                _json.dump(cluster_health.update(), f, indent=2,
+                _json.dump(cluster_health.snapshot(), f, indent=2,
                            sort_keys=True)
+            # terminal alert state (alerts.json also lands on every
+            # transition during the run)
+            alert_engine.write_json()
     return applied, counts, trace
 
 

@@ -1,0 +1,236 @@
+"""Embedding skew telemetry (ISSUE 11): the Space-Saving sketch's
+guarantees, the tier client's hot-share / shard-imbalance / latency
+stats, the store's per-shard load counters, and the heartbeat
+ride-along into the master's fleet view."""
+
+import collections
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding import sharding, store, tier, transport
+from elasticdl_tpu.embedding.sketch import SpaceSaving
+
+# ---------------------------------------------------------------------- #
+# Space-Saving sketch
+
+
+def test_sketch_exact_below_capacity():
+    sk = SpaceSaving(16)
+    for key, n in ((1, 5), (2, 3), (3, 1)):
+        sk.update(key, n)
+    assert sk.total == 9
+    assert sk.top() == [(1, 5, 0), (2, 3, 0), (3, 1, 0)]
+    assert sk.hot_share() == 1.0       # everything tracked exactly
+
+
+def test_sketch_eviction_inherits_min_as_error():
+    sk = SpaceSaving(2)
+    sk.update(1, 10)
+    sk.update(2, 3)
+    sk.update(3, 1)                    # evicts key 2? no — the MIN (2:3)
+    # key 3 inherits count 3 as error: count 4, error 3
+    top = dict((k, (c, e)) for k, c, e in sk.top())
+    assert top[1] == (10, 0)
+    assert top[3] == (4, 3)
+    assert 2 not in top
+    # guaranteed counts: 10 + (4-3) = 11 of total 14
+    assert sk.hot_share() == pytest.approx(11 / 14)
+
+
+def test_sketch_overestimates_never_underestimates():
+    r = np.random.RandomState(3)
+    stream = (r.zipf(1.3, 50_000) % 4096).astype(np.int64)
+    sk = SpaceSaving(64)
+    # feed in chunks through the batch API (the tier's shapes)
+    for chunk in np.array_split(stream, 100):
+        u, c = np.unique(chunk, return_counts=True)
+        sk.update_batch(u, c)
+    true = collections.Counter(stream.tolist())
+    n = stream.size
+    assert sk.total == n
+    for key, count, err in sk.top():
+        assert count >= true[key]              # overestimate only
+        assert count - err <= true[key]        # guaranteed lower bound
+        assert err <= n // 64 + 1              # N/k error bound
+    # every id heavier than N/k is tracked (the Space-Saving guarantee)
+    tracked = {k for k, _, _ in sk.top()}
+    for key, c in true.items():
+        if c > n // 64:
+            assert key in tracked, (key, c)
+    # hot_share is a LOWER bound on the true top-64 share
+    true_share = sum(c for _, c in true.most_common(64)) / n
+    assert 0.0 < sk.hot_share() <= true_share + 1e-9
+
+
+def test_sketch_heap_stays_bounded():
+    sk = SpaceSaving(8)
+    r = np.random.RandomState(0)
+    for _ in range(50):
+        ids = r.randint(0, 1000, 64)
+        u, c = np.unique(ids, return_counts=True)
+        sk.update_batch(u, c)
+    assert len(sk._heap) <= 8
+    assert len(sk) == 8
+
+
+def test_sketch_reset():
+    sk = SpaceSaving(4)
+    sk.update(1, 5)
+    sk.reset()
+    assert sk.total == 0 and len(sk) == 0 and sk.hot_share() == 0.0
+
+
+# ---------------------------------------------------------------------- #
+# tier client skew stats
+
+
+def build_tier(num_shards=4, owners=(0, 1), vocab=4096, dim=8,
+               dedupe=True):
+    spec = sharding.TableSpec("t", vocab=vocab, dim=dim, seed=3)
+    owner_list = sharding.assign_round_robin(num_shards, list(owners))
+    view = sharding.ShardMapView(
+        version=1, num_shards=num_shards, owners=tuple(owner_list),
+        tables=(spec,),
+    )
+    tr = transport.LocalTransport()
+    for o in owners:
+        st = store.EmbeddingShardStore(o, device=False)
+        st.attach(view)
+        tr.register(st)
+    client = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="skew-test", dedupe=dedupe)
+    return client, view, tr
+
+
+def test_tier_stats_populated_by_pulls_and_pushes():
+    client, _, _ = build_tier()
+    r = np.random.RandomState(5)
+    ids = (r.zipf(1.3, (64, 8)) % 4096).astype(np.int64)
+    client.pull("t", ids)
+    rows, inverse, uniq = client.pull_unique("t", ids)
+    client.push("t", uniq, rows * 0.1, scale=-0.1)
+    stats = client.tier_stats()
+    assert 0.0 < stats["emb_hot_id_share"] <= 1.0
+    assert stats["emb_shard_imbalance"] >= 1.0
+    assert stats["emb_pull_p99_ms"] > 0.0
+    assert stats["emb_push_p99_ms"] > 0.0
+    # scalars only — the payload codec drops anything else
+    for v in stats.values():
+        assert isinstance(v, (int, float))
+
+
+def test_tier_sketch_sees_occurrence_weights_not_unique_streams():
+    """Duplicates must count with their multiplicity: the sketch measures
+    TRAFFIC share, and the dedupe that batches the wire must not hide
+    the skew it exists to exploit."""
+    client, _, _ = build_tier()
+    ids = np.array([7] * 99 + [11], np.int64)
+    client.pull("t", ids)
+    top = dict((k, c) for k, c, _ in client.sketch.top())
+    assert top[7] == 99
+    assert top[11] == 1
+    assert client.sketch.hot_share(1) == pytest.approx(0.99)
+
+
+def test_tier_sentinel_ids_never_reach_the_sketch():
+    client, _, _ = build_tier()
+    ids = np.array([[-1, 5, 5, -1]], np.int64)
+    client.pull_unique("t", ids)
+    tracked = {k for k, _, _ in client.sketch.top()}
+    assert tracked == {5}
+    assert client.sketch.total == 2
+
+
+def test_shard_imbalance_tracks_hot_shard():
+    client, _, _ = build_tier(num_shards=4)
+    # all traffic to shard 1 (ids ≡ 1 mod 4)
+    ids = (np.arange(64, dtype=np.int64) * 4) + 1
+    client.pull("t", ids)
+    stats = client.tier_stats()
+    # one of 4 shards takes everything: imbalance = max/mean = 4
+    assert stats["emb_shard_imbalance"] == pytest.approx(4.0)
+
+
+def test_store_per_shard_load_counters_and_op_latency():
+    from elasticdl_tpu.observability.registry import default_registry
+
+    reg = default_registry()
+    shard_rows = reg.get("edl_embedding_store_shard_load_rows_total")
+    op_s = reg.get("edl_embedding_store_op_seconds")
+    client, view, tr = build_tier(num_shards=2, owners=(0,))
+    before = {
+        (s, op): shard_rows.value(table="t", shard=str(s), op=op)
+        for s in range(2) for op in ("pull", "push")
+    }
+    ids = np.arange(32, dtype=np.int64)            # 16 ids per shard
+    client.pull("t", ids)
+    rows = np.ones((32, 8), np.float32)
+    client.push("t", ids, rows)
+    for s in range(2):
+        assert shard_rows.value(
+            table="t", shard=str(s), op="pull"
+        ) - before[(s, "pull")] == 16
+        assert shard_rows.value(
+            table="t", shard=str(s), op="push"
+        ) - before[(s, "push")] == 16
+    assert op_s.count(op="pull") > 0
+    assert op_s.count(op="push") > 0
+
+
+# ---------------------------------------------------------------------- #
+# heartbeat ride-along: payload -> membership record -> fleet series
+
+
+def test_tier_stats_survive_the_payload_codec():
+    from elasticdl_tpu.observability import health as health_lib
+
+    client, _, _ = build_tier()
+    r = np.random.RandomState(5)
+    ids = (r.zipf(1.3, (64, 8)) % 4096).astype(np.int64)
+    client.pull("t", ids)
+    payload = {"steps": 4, "step_p50_ms": 9.0, "phase": "train"}
+    payload.update(client.tier_stats())
+    decoded = health_lib.decode_stats(health_lib.encode_stats(payload))
+    assert decoded is not None
+    assert decoded["emb_hot_id_share"] == payload["emb_hot_id_share"]
+    assert decoded["emb_pull_p99_ms"] == payload["emb_pull_p99_ms"]
+
+
+def test_fleet_series_carries_tier_skew_from_membership_records():
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.observability.timeseries import fleet_series
+
+    m = Membership(heartbeat_timeout_s=1e9)
+    w1 = m.register("w1").worker_id
+    w2 = m.register("w2").worker_id
+    m.heartbeat(w1, stats={"step_p50_ms": 10.0, "emb_pull_p99_ms": 8.0,
+                           "emb_hot_id_share": 0.6})
+    m.heartbeat(w2, stats={"step_p50_ms": 11.0, "emb_pull_p99_ms": 400.0,
+                           "emb_hot_id_share": 0.4,
+                           "emb_shard_imbalance": 3.5})
+    out = fleet_series(m.health_snapshot(), alive_workers=2)
+    assert out["edl_fleet_emb_pull_p99_ms"] == 400.0   # worst reporter
+    assert out["edl_fleet_emb_hot_id_share"] == 0.6
+    assert out["edl_fleet_emb_shard_imbalance"] == 3.5
+
+
+def test_straggler_info_carries_emb_keys():
+    """The scorer's straggler infos surface the tier view of a slow
+    worker (_PROFILE_KEYS extension)."""
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.observability.health import ClusterHealth
+
+    m = Membership(heartbeat_timeout_s=1e9)
+    ids = [m.register(f"w{i}").worker_id for i in range(4)]
+    for wid in ids[:3]:
+        m.heartbeat(wid, stats={"step_p50_ms": 10.0})
+    m.heartbeat(ids[3], stats={"step_p50_ms": 500.0,
+                               "emb_pull_p99_ms": 480.0,
+                               "emb_shard_imbalance": 6.0})
+    health = ClusterHealth(m)
+    snap = health.update()
+    assert snap["straggler_count"] == 1
+    info = snap["stragglers"][0]
+    assert info["emb_pull_p99_ms"] == 480.0
+    assert info["emb_shard_imbalance"] == 6.0

@@ -551,3 +551,29 @@ def test_injected_delay_straggler_detected_within_bounded_heartbeats(
             "edl_cluster_straggler_count").value() == 0
         assert not [r for r in new_records(t, start)
                     if r["name"] == "cluster.straggler"]
+
+
+# ---------------------------------------------------------------------- #
+# /healthz staleness (ISSUE 11 satellite): snapshot_age_s
+
+
+def test_snapshot_age_stamped_at_serve_time():
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.observability.health import ClusterHealth
+
+    m = Membership(heartbeat_timeout_s=1e9)
+    health = ClusterHealth(m)
+    # never computed: the sentinel, not a bogus huge age
+    assert health.snapshot()["snapshot_age_s"] == -1.0
+    health.update(now=1000.0)
+    # age is now - rollup ts, computed PER SERVE (a frozen rollup reads
+    # older on every scrape — that's the point)
+    assert health.snapshot(now=1002.5)["snapshot_age_s"] == 2.5
+    assert health.snapshot(now=1060.0)["snapshot_age_s"] == 60.0
+    # a fresh update resets the age
+    health.update(now=1100.0)
+    assert health.snapshot(now=1100.1)["snapshot_age_s"] == 0.1
+    # the age is serve-time metadata, never part of the stored rollup
+    health.update(now=1200.0)
+    with health._lock:
+        assert "snapshot_age_s" not in health._last

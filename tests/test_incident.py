@@ -111,3 +111,48 @@ def test_json_report_roundtrips(capsys):
     assert rc == 0
     report = json.loads(out)
     assert report["roles"] and report["timeline"]
+
+
+# ---------------------------------------------------------------------- #
+# cluster.alert merge (ISSUE 11 golden fixture: tests/fixtures/incident/
+# alerts/ — an edge-triggered page alert whose event is in BOTH the
+# master's trace and the flight bundle its onset dumped)
+
+ALERTS = os.path.join(FIXTURES, "alerts")
+
+
+def test_alert_events_merge_into_timeline_once():
+    report = incident.correlate([ALERTS])
+    alert_entries = [e for e in report["timeline"]
+                     if e["name"] == "cluster.alert"]
+    # the same onset lives in the trace AND the bundle's ring: the
+    # cross-source dedup must keep exactly ONE timeline entry
+    assert len(alert_entries) == 1
+    entry = alert_entries[0]
+    assert entry["rule"] == "embedding_pull_p99"
+    assert entry["severity"] == "page"
+    assert entry["value"] == 412.5 and entry["threshold"] == 250.0
+    cleared = [e for e in report["timeline"]
+               if e["name"] == "cluster.alert_cleared"]
+    assert len(cleared) == 1
+    # alerts are KEY events: the curated view must carry both
+    key_names = [e["name"] for e in report["key_events"]]
+    assert "cluster.alert" in key_names
+    assert "cluster.alert_cleared" in key_names
+    # ordering: straggler context precedes the alert precedes the clear
+    names = [e["name"] for e in report["timeline"]]
+    assert names.index("cluster.straggler") < names.index("cluster.alert")
+    assert names.index("cluster.alert") < names.index(
+        "cluster.alert_cleared")
+
+
+def test_alert_fixture_strict_clean_and_renders_age(capsys):
+    rc = incident.main([ALERTS, "--strict"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    # the alert's identity is readable in the text timeline
+    assert "cluster.alert" in out
+    assert "rule=embedding_pull_p99" in out
+    # the health snapshot's serve-time staleness stamp (ISSUE 11
+    # satellite) surfaces next to the rollup line
+    assert "rollup age 2.5s" in out

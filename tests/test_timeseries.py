@@ -1,0 +1,322 @@
+"""Metrics time series (observability/timeseries.py): ring + window
+queries with counter-reset awareness, rolling history persistence, the
+interval gate, fleet aggregation, and the /timeseries endpoint."""
+
+import json
+import os
+import threading
+import urllib.request
+
+from elasticdl_tpu.observability.registry import MetricsRegistry
+from elasticdl_tpu.observability.timeseries import (
+    TimeSeriesStore,
+    fleet_series,
+)
+
+
+def make_store(**kw):
+    reg = MetricsRegistry()
+    kw.setdefault("capacity", 64)
+    kw.setdefault("interval_s", 0.0)
+    return TimeSeriesStore(registry=reg, **kw), reg
+
+
+# ---------------------------------------------------------------------- #
+# sampling + windows
+
+
+def test_window_avg_quantile_latest():
+    st, reg = make_store()
+    g = reg.gauge("edl_t_level")
+    for i in range(10):
+        g.set(float(i))
+        st.sample(now=1000.0 + i)
+    assert st.latest("edl_t_level") == 9.0
+    assert st.avg("edl_t_level", 100, now=1009.0) == 4.5
+    # only the last 5 samples (values 5..9)
+    assert st.avg("edl_t_level", 4.5, now=1009.0) == 7.0
+    assert st.quantile("edl_t_level", 1.0, 100, now=1009.0) == 9.0
+    assert st.window("edl_t_level", 2.0, now=1009.0) == [
+        (1007.0, 7.0), (1008.0, 8.0), (1009.0, 9.0)
+    ]
+
+
+def test_latest_respects_max_age():
+    st, reg = make_store()
+    g = reg.gauge("edl_t_level")
+    g.set(3.0)
+    st.sample(now=1000.0)
+    assert st.latest("edl_t_level", now=1004.0, max_age_s=10) == 3.0
+    assert st.latest("edl_t_level", now=1050.0, max_age_s=10) is None
+
+
+def test_missing_series_queries_return_none():
+    st, _ = make_store()
+    st.sample(now=1000.0)
+    assert st.latest("edl_t_nope") is None
+    assert st.avg("edl_t_nope", 100, now=1000.0) is None
+    assert st.rate("edl_t_nope", 100, now=1000.0) is None
+
+
+# ---------------------------------------------------------------------- #
+# counter delta/rate semantics (the satellite's named coverage)
+
+
+def test_counter_delta_and_rate():
+    st, reg = make_store()
+    c = reg.counter("edl_t_things_total")
+    for i in range(6):
+        c.inc(10)
+        st.sample(now=1000.0 + i)
+    # 5 intervals x +10 (the first sample's value is the baseline)
+    assert st.delta("edl_t_things_total", 100, now=1005.0) == 50.0
+    assert st.rate("edl_t_things_total", 100, now=1005.0) == 10.0
+
+
+def test_counter_reset_counts_post_reset_value_as_increase():
+    """A restarted process zeroes its counters; the increase across the
+    reset is the post-reset value (Prometheus rate() semantics), never a
+    negative delta."""
+    st, reg = make_store()
+    c = reg.counter("edl_t_things_total")
+    c.inc(100)
+    st.sample(now=1000.0)
+    c.inc(20)
+    st.sample(now=1001.0)              # 120
+    # simulate the restart: fresh registry state, same series name
+    c._values[()] = 0.0
+    c.inc(7)
+    st.sample(now=1002.0)              # 7 after reset
+    d = st.delta("edl_t_things_total", 100, now=1002.0)
+    assert d == 20.0 + 7.0             # +20 pre-reset, +7 post-reset
+    assert st.rate("edl_t_things_total", 100, now=1002.0) == d / 2.0
+
+
+def test_series_kind_classification():
+    st, reg = make_store()
+    reg.counter("edl_t_things_total").inc()
+    reg.gauge("edl_t_level").set(1)
+    h = reg.histogram("edl_t_lat_seconds")
+    h.observe(0.5)
+    st.sample(now=1000.0)
+    assert st.kind("edl_t_things_total") == "counter"
+    assert st.kind("edl_t_level") == "gauge"
+    assert st.kind("edl_t_lat_seconds_count") == "counter"
+    assert st.kind("edl_t_lat_seconds_sum") == "counter"
+    assert st.kind("edl_t_lat_seconds_p99") == "gauge"
+
+
+def test_extra_series_ride_samples_and_follow_naming_kinds():
+    st, _ = make_store()
+    st.sample(now=1000.0, extra={"edl_fleet_x": 3,
+                                "edl_fleet_hits_total": 5,
+                                "bad": "not-a-number"})
+    assert st.latest("edl_fleet_x") == 3.0
+    assert st.kind("edl_fleet_x") == "gauge"
+    assert st.kind("edl_fleet_hits_total") == "counter"
+    assert st.latest("bad") is None
+
+
+# ---------------------------------------------------------------------- #
+# interval gate + ring bound
+
+
+def test_maybe_sample_interval_gate():
+    st, reg = make_store(interval_s=5.0)
+    reg.gauge("edl_t_level").set(1)
+    assert st.maybe_sample(now=1000.0) is True
+    assert st.maybe_sample(now=1002.0) is False
+    assert st.maybe_sample(now=1005.0) is True
+    assert st.sample_count == 2
+
+
+def test_ring_is_bounded():
+    st, reg = make_store(capacity=16)
+    g = reg.gauge("edl_t_level")
+    for i in range(100):
+        g.set(i)
+        st.sample(now=1000.0 + i)
+    pts = st.window("edl_t_level", 1e9, now=1099.0)
+    assert len(pts) == 16
+    assert pts[0] == (1084.0, 84.0)
+
+
+# ---------------------------------------------------------------------- #
+# rolling history file
+
+
+def test_history_appends_and_compacts(tmp_path):
+    path = str(tmp_path / "ts" / "metrics_history.jsonl")
+    st, reg = make_store(history_path=path, history_max_lines=20)
+    g = reg.gauge("edl_t_level")
+    for i in range(50):
+        g.set(i)
+        st.sample(now=1000.0 + i)
+    with open(path) as f:
+        lines = [json.loads(line) for line in f if line.strip()]
+    # bounded: compaction keeps the file at ~1.5x max worst case
+    assert len(lines) <= 30
+    # newest data survives, oldest fell off
+    assert lines[-1]["values"]["edl_t_level"] == 49.0
+    assert lines[0]["ts"] > 1000.0
+    for rec in lines:
+        assert set(rec) == {"ts", "values"}
+
+
+def test_history_failure_disables_persistence_quietly(tmp_path):
+    # point at a path whose parent is a FILE — every write fails
+    blocker = tmp_path / "blocker"
+    blocker.write_text("x")
+    st, reg = make_store(
+        history_path=str(blocker / "metrics_history.jsonl"))
+    reg.gauge("edl_t_level").set(1)
+    st.sample(now=1000.0)
+    st.sample(now=1001.0)              # must not raise; disabled after #1
+    assert st._history_failed is True
+    assert st.sample_count == 2        # sampling itself keeps working
+
+
+# ---------------------------------------------------------------------- #
+# fleet aggregation
+
+
+def _rec(now, **kw):
+    base = {"worker_id": 1, "updated_at": now}
+    base.update(kw)
+    return base
+
+
+def test_fleet_series_aggregates_heartbeat_records():
+    now = 1000.0
+    records = [
+        _rec(now, worker_id=1, step_p50_ms=10.0,
+             phase_data_wait_ms=6.0, phase_compute_ms=2.0,
+             emb_pull_p99_ms=12.0, emb_hot_id_share=0.5,
+             emb_shard_imbalance=1.1),
+        _rec(now, worker_id=2, step_p50_ms=20.0,
+             phase_data_wait_ms=1.0, phase_compute_ms=9.0,
+             emb_pull_p99_ms=300.0, emb_hot_id_share=0.7,
+             emb_shard_imbalance=4.0),
+        _rec(now - 120, worker_id=3, step_p50_ms=99.0),   # stale: dropped
+    ]
+    out = fleet_series(records, straggler_count=1, todo_tasks=96,
+                       alive_workers=2, now=now)
+    assert out["edl_fleet_workers_reporting"] == 2.0
+    assert out["edl_fleet_straggler_count"] == 1.0
+    assert out["edl_fleet_step_p50_ms_median"] == 15.0
+    assert out["edl_fleet_backlog_per_worker"] == 48.0
+    # per-worker fracs 0.75 and 0.1 -> median of two = mean
+    assert abs(out["edl_fleet_data_wait_frac"] - 0.425) < 1e-6
+    # embedding series take the WORST reporter
+    assert out["edl_fleet_emb_pull_p99_ms"] == 300.0
+    assert out["edl_fleet_emb_hot_id_share"] == 0.7
+    assert out["edl_fleet_emb_shard_imbalance"] == 4.0
+
+
+def test_fleet_series_embedding_keys_absent_without_tier():
+    out = fleet_series([_rec(1000.0, step_p50_ms=5.0)], now=1000.0)
+    assert "edl_fleet_emb_pull_p99_ms" not in out
+    assert "edl_fleet_data_wait_frac" not in out
+
+
+# ---------------------------------------------------------------------- #
+# /timeseries endpoint
+
+
+def test_timeseries_endpoint_serves_window_and_filters():
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    st, reg = make_store()
+    c = reg.counter("edl_t_things_total")
+    g = reg.gauge("edl_t_level")
+    for i in range(5):
+        c.inc(2)
+        g.set(i)
+        st.sample()
+    server = ObservabilityServer(
+        registry=reg, role="t", timeseries=st)
+    port = server.start(0)
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/timeseries?window=600", timeout=5
+        ) as resp:
+            payload = json.loads(resp.read())
+        assert payload["role"] == "t"
+        assert payload["samples_in_window"] == 5
+        series = payload["series"]
+        assert series["edl_t_things_total"]["kind"] == "counter"
+        assert series["edl_t_things_total"]["delta"] == 8.0
+        assert series["edl_t_level"]["latest"] == 4.0
+        # series filter
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/timeseries?series=edl_t_level",
+            timeout=5,
+        ) as resp:
+            filtered = json.loads(resp.read())
+        assert set(filtered["series"]) == {"edl_t_level"}
+        assert all(set(s["values"]) <= {"edl_t_level"}
+                   for s in filtered["samples"])
+    finally:
+        server.stop()
+
+
+def test_payload_is_cheap_copy_under_concurrent_sampling():
+    """to_payload must never block sampling (leaf-lock copy): hammer
+    both concurrently and require no exception and monotone counts."""
+    st, reg = make_store()
+    g = reg.gauge("edl_t_level")
+    stop = threading.Event()
+    errs = []
+
+    def sampler():
+        i = 0
+        while not stop.is_set():
+            g.set(i)
+            st.sample()
+            i += 1
+
+    def reader():
+        while not stop.is_set():
+            try:
+                st.to_payload(window_s=60)
+            except Exception as e:   # pragma: no cover
+                errs.append(e)
+                return
+
+    threads = [threading.Thread(target=sampler),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join(timeout=5)
+    assert not errs
+    assert st.sample_count > 0
+
+
+def test_fleet_series_tolerates_string_payload_values():
+    """decode_stats admits string values from mixed-version workers;
+    the master's sampler must read them as absent, never raise (the
+    wait loop's 'never raises' contract)."""
+    now = 1000.0
+    records = [
+        _rec(now, step_p50_ms="12.5ms", phase_data_wait_ms="x",
+             emb_pull_p99_ms="nope"),
+        _rec(now, worker_id=2, step_p50_ms=8.0, emb_pull_p99_ms=40.0),
+        _rec("garbage-ts", worker_id=3, step_p50_ms=5.0),
+    ]
+    out = fleet_series(records, now=now)
+    assert out["edl_fleet_step_p50_ms_median"] == 8.0   # strings dropped
+    assert out["edl_fleet_emb_pull_p99_ms"] == 40.0
+    # the garbage updated_at record reads as stale, not a crash
+    assert out["edl_fleet_workers_reporting"] == 2.0
+
+
+def test_maybe_sample_survives_raising_extra_fn():
+    st, reg = make_store(interval_s=0.0)
+    reg.gauge("edl_t_level").set(1)
+    assert st.maybe_sample(now=1000.0, extra_fn=lambda: 1 / 0) is True
+    assert st.latest("edl_t_level") == 1.0   # registry still sampled
