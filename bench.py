@@ -877,7 +877,11 @@ def bench_observability_overhead(mesh, np):
 
     def run(instrumented: bool):
         nonlocal state
-        prof = profile_lib.StepProfiler()
+        from elasticdl_tpu.observability.goodput import GoodputLedger
+
+        # the goodput-ledger tee (ISSUE 12) is hot-path cost the real
+        # worker pays on every profiler add — it belongs inside the gate
+        prof = profile_lib.StepProfiler(ledger=GoodputLedger())
         stats = WorkerStepStats()
         rec = flight_lib.FlightRecorder(ring=4096, role="bench")
         # per-step maybe_sample against a 0.5 s interval: real registry
@@ -1930,6 +1934,300 @@ def bench_pipeline(mesh, np):
 
 
 # ---------------------------------------------------------------------- #
+# fleet goodput ledger (ISSUE 12): a scripted scenario — steady train ->
+# injected straggler -> kill-worker rescale -> recover — over the REAL
+# dispatcher+journal and real per-worker GoodputLedgers, asserting the
+# ledger's total-attribution invariant against independently measured
+# wall clock and that the wasted-work bill lands where the scenario put
+# it. Jax-free and device-free: `python bench.py goodput` runs anywhere.
+
+GP_WORKERS = int(os.environ.get("EDL_BENCH_GP_WORKERS", "3"))
+GP_TASKS = int(os.environ.get("EDL_BENCH_GP_TASKS", "18"))
+GP_RECORDS_PER_TASK = int(os.environ.get("EDL_BENCH_GP_RECORDS", "64"))
+GP_STEPS_PER_TASK = 4
+#: simulated phase sleeps (seconds) — small enough for CI, large enough
+#: that scheduler jitter stays well under the 1% attribution gate
+GP_DATA_WAIT_S = 0.002
+GP_H2D_S = 0.001
+GP_COMPUTE_S = 0.004
+GP_STRAGGLE_EXTRA_S = 0.012
+GP_RESCALE_S = {"settle": 0.005, "handoff": 0.010, "compile": 0.015}
+
+
+def bench_goodput(mesh=None, np=None):
+    """Fleet goodput scenario (ISSUE 12 acceptance): per-worker category
+    seconds must sum to measured wall clock within 1%, the injected
+    straggler must surface in `train_compute`, the killed worker's
+    requeued lease must bill nonzero `worker_died` wasted records, the
+    survivors must book nonzero `rescale` seconds, and the journal must
+    replay the whole wasted-work bill identically. The headline number
+    is the fleet goodput fraction. `mesh`/`np` ignored (uniform leg
+    signature; no devices touched)."""
+    import tempfile
+    import threading
+
+    from elasticdl_tpu.master.journal import ControlPlaneJournal, replay_lines
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.observability import goodput as goodput_lib
+    from elasticdl_tpu.observability import profile as profile_lib
+    from elasticdl_tpu.observability import tracing
+
+    tracing.configure(role="bench-goodput")
+    trace_id = tracing.new_trace_id()
+
+    n_workers = max(2, GP_WORKERS)
+    killed_wid = n_workers - 1
+    straggler_wid = n_workers - 2
+    total_records = GP_TASKS * GP_RECORDS_PER_TASK
+
+    out = {
+        "workers": n_workers, "tasks": GP_TASKS,
+        "records_per_task": GP_RECORDS_PER_TASK,
+        "straggler_worker": straggler_wid, "killed_worker": killed_wid,
+    }
+
+    killed_event = threading.Event()     # the victim abandoned its lease
+    rescale_event = threading.Event()    # survivors must pay a rescale
+    abandoned = {}                       # task_id the victim walked off with
+
+    with tempfile.TemporaryDirectory() as tmp:
+        journal = ControlPlaneJournal(tmp)
+        dispatcher = TaskDispatcher(
+            training_shards=[("train", 0, total_records)],
+            records_per_task=GP_RECORDS_PER_TASK,
+            num_epochs=1, shuffle=False, task_timeout_s=600.0,
+            journal=journal,
+        )
+
+        walls = {}
+        snaps = {}
+
+        def run_worker(wid):
+            ledger = goodput_lib.GoodputLedger()
+            prof = profile_lib.StepProfiler(ledger=ledger)
+            t0 = time.monotonic()
+            tasks_done = 0
+            rescaled = False
+            straggling = False
+            while True:
+                if (
+                    rescale_event.is_set() and wid != killed_wid
+                    and not rescaled
+                ):
+                    # the kill-worker rescale, reacted to at a task
+                    # boundary: settle/handoff/compile, exactly like a
+                    # real in-place rescale bills them
+                    for sub, dur in GP_RESCALE_S.items():
+                        with ledger.phase("rescale", sub=sub):
+                            time.sleep(dur)
+                    rescaled = True
+                task = dispatcher.get(wid)
+                if task is None:
+                    if dispatcher.finished():
+                        break
+                    with ledger.phase("lease_wait"):
+                        time.sleep(0.002)
+                    continue
+                if wid == killed_wid and tasks_done >= 2:
+                    # the kill: walk off mid-task with the lease held —
+                    # the master's death callback requeues it and bills
+                    # worker_died wasted records
+                    abandoned["task_id"] = task.task_id
+                    abandoned["records"] = task.num_records
+                    killed_event.set()
+                    break
+                straggling = (
+                    wid == straggler_wid and 2 <= tasks_done <= 4
+                )
+                for _ in range(GP_STEPS_PER_TASK):
+                    with prof.phase("data_wait"):
+                        time.sleep(GP_DATA_WAIT_S)
+                    with prof.phase("h2d"):
+                        time.sleep(GP_H2D_S)
+                    step_t0 = time.perf_counter()
+                    time.sleep(
+                        GP_COMPUTE_S
+                        + (GP_STRAGGLE_EXTRA_S if straggling else 0.0)
+                    )
+                    prof.add("compute", time.perf_counter() - step_t0)
+                    prof.step_done()
+                dispatcher.report(
+                    task.task_id, wid, success=True,
+                    records_processed=task.num_records,
+                )
+                tasks_done += 1
+            walls[wid] = time.monotonic() - t0
+            # snapshot IN-THREAD, at the same instant the external wall
+            # measurement stops — join latency must not read as skew
+            snaps[wid] = ledger.snapshot()
+
+        with tracing.adopt(trace_id):
+            with tracing.span("goodput", workers=n_workers):
+                threads = [
+                    threading.Thread(target=run_worker, args=(wid,))
+                    for wid in range(n_workers)
+                ]
+                for t in threads:
+                    t.start()
+                assert killed_event.wait(timeout=120), "victim never died"
+                tracing.event(
+                    "goodput.kill_worker", worker_id=killed_wid,
+                    task_id=abandoned.get("task_id"),
+                )
+                # the master's reaction: recover the dead worker's
+                # leases (worker_died wasted records) and announce the
+                # rescale the survivors pay at their next task boundary
+                dispatcher.recover_tasks(killed_wid)
+                rescale_event.set()
+                # the ghost: the dead worker's delayed report arrives
+                # after recovery and is rejected — the stale_report
+                # evidence bucket
+                ghost_accepted = dispatcher.report(
+                    abandoned["task_id"], killed_wid, success=True,
+                    records_processed=abandoned["records"],
+                )
+                for t in threads:
+                    t.join(timeout=300)
+                assert not any(t.is_alive() for t in threads), \
+                    "scenario wedged"
+
+        # ---- per-worker self-consistency: categories sum to wall ----
+        per_worker = {}
+        worst_err_pct = 0.0
+        for wid, snap in sorted(snaps.items()):
+            measured = walls[wid]
+            cat_sum = sum(snap["categories"].values())
+            err_pct = (
+                100.0 * abs(cat_sum - measured) / measured
+                if measured else 0.0
+            )
+            worst_err_pct = max(worst_err_pct, err_pct)
+            per_worker[f"worker{wid}"] = {
+                "measured_wall_s": round(measured, 6),
+                "ledger_wall_s": snap["wall_s"],
+                "category_sum_s": round(cat_sum, 6),
+                "attribution_error_pct": round(err_pct, 4),
+                "overattributed_s": snap["overattributed_s"],
+                "goodput_fraction": snap["goodput_fraction"],
+                "categories": snap["categories"],
+                "rescale_phases": snap["rescale_phases"],
+            }
+        out["per_worker"] = per_worker
+        out["attribution_worst_error_pct"] = round(worst_err_pct, 4)
+        out["attribution_within_1pct"] = bool(worst_err_pct <= 1.0)
+
+        # ---- injected phases land in the right buckets ----
+        strag = per_worker[f"worker{straggler_wid}"]["categories"]
+        peers = [
+            per_worker[f"worker{w}"]["categories"]["train_compute"]
+            for w in range(n_workers)
+            if w not in (straggler_wid, killed_wid)
+        ]
+        out["straggler_compute_s"] = strag["train_compute"]
+        out["peer_compute_s"] = round(max(peers), 6) if peers else 0.0
+        out["straggler_in_compute_bucket"] = bool(
+            strag["train_compute"] > (max(peers) if peers else 0.0)
+        )
+        survivor_rescale = [
+            per_worker[f"worker{w}"]["categories"]["rescale"]
+            for w in range(n_workers) if w != killed_wid
+        ]
+        out["rescale_seconds_min_survivor"] = round(
+            min(survivor_rescale), 6)
+        out["rescale_booked_on_survivors"] = bool(
+            min(survivor_rescale) > 0.0)
+
+        # ---- wasted-work bill (dispatcher + journal replay) ----
+        wasted = dispatcher.wasted_work()
+        out["wasted"] = wasted
+        by = wasted["by_reason"]
+        out["wasted_from_requeued_lease"] = bool(
+            by.get("worker_died", {}).get("records", 0) > 0
+        )
+        out["ghost_report_rejected"] = bool(
+            not ghost_accepted
+            and by.get("stale_report", {}).get("events", 0) > 0
+        )
+        journal.close()
+        with open(journal.path, encoding="utf-8") as f:
+            replayed = replay_lines(f.readlines()).dispatcher
+        out["wasted_journal_consistent"] = bool(
+            replayed is not None
+            and replayed.wasted_records == wasted["wasted_records"]
+            and replayed.wasted_events == wasted["wasted_events"]
+            and replayed.records_completed == wasted["records_completed"]
+            and replayed.wasted_by_reason == by
+        )
+
+        # ---- fleet rollup (the headline) ----
+        def payload_from(snap):
+            # the frozen in-thread snapshot in heartbeat-payload shape,
+            # built from the ONE exported key schema (the live worker's
+            # ledger.payload() uses the same mapping) — the fleet
+            # fraction must not drift with post-scenario wall
+            out_p = {"gp_wall_s": round(snap["wall_s"], 3)}
+            for cat, key in goodput_lib._PAYLOAD_KEYS.items():
+                v = snap["categories"].get(cat, 0.0)
+                if v > 0:
+                    out_p[key] = round(v, 3)
+            return out_p
+
+        class _StubMembership:
+            def health_snapshot(self):
+                now = time.time()
+                return [
+                    dict(payload_from(snaps[w]), worker_id=w,
+                         updated_at=now)
+                    for w in range(n_workers)
+                ]
+
+        fleet_gp = goodput_lib.FleetGoodput(_StubMembership(), dispatcher)
+        fleet_snap = fleet_gp.update()
+        out["fleet"] = fleet_snap.get("fleet")
+        out["fleet_goodput_fraction"] = (
+            fleet_snap.get("fleet") or {}
+        ).get("goodput_fraction", 0.0)
+        out["trace_id"] = trace_id
+
+        art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+        if art_dir:
+            os.makedirs(art_dir, exist_ok=True)
+            # the ledger JSON (the CI job's headline artifact)
+            with open(os.path.join(art_dir, "bench-goodput-ledgers.json"),
+                      "w") as f:
+                json.dump(
+                    {"per_worker": per_worker, "fleet": out["fleet"],
+                     "wasted": wasted},
+                    f, indent=1, sort_keys=True,
+                )
+            # the journal (replayable by the incident CLI: its filename
+            # keeps the journal.jsonl suffix the walker looks for)
+            import shutil
+
+            shutil.copyfile(
+                journal.path,
+                os.path.join(art_dir, "bench-goodput-journal.jsonl"),
+            )
+            # a health snapshot carrying the fleet goodput rollup (the
+            # incident CLI's worker-seconds source)
+            with open(
+                os.path.join(art_dir, "bench-goodput.health.json"), "w"
+            ) as f:
+                json.dump(
+                    {"role": "bench-goodput",
+                     "goodput": fleet_gp.snapshot(),
+                     "cluster": {"workers_reporting": n_workers - 1,
+                                 "straggler_count": 0, "skew": 1.0}},
+                    f, indent=1, sort_keys=True,
+                )
+            with open(os.path.join(art_dir, "bench-goodput-trace.jsonl"),
+                      "w") as f:
+                for rec in tracing.get_tracer().records:
+                    f.write(json.dumps(rec) + "\n")
+    return out
+
+
+# ---------------------------------------------------------------------- #
 # baseline compare mode (ISSUE 11): diff a run's headline numbers against
 # a prior artifact, exit nonzero past a regression threshold — the perf
 # trajectory machine-checked instead of eyeballed across round logs.
@@ -1964,6 +2262,13 @@ _COMPARE_METRICS = (
     ("*_p50_ms", "lower", 2.0),
     ("*_p99_ms", "lower", 10.0),
     ("*mfu_pct", "higher", 0.0),
+    # ISSUE 12: the fleet goodput fraction is sleep-structured (the
+    # scenario's phase durations dominate scheduler noise) but a
+    # contended box inflates the overhead residual — 0.1 absolute slack
+    ("*fleet_goodput_fraction", "higher", 0.1),
+    # absolute slack = the scenario's own 1% gate: a contended runner
+    # inside the documented invariant must not fail the compare step
+    ("*attribution_worst_error_pct", "lower", 1.0),
 )
 
 #: paths NEVER gated even when a metric glob matches: scenario-record
@@ -1971,7 +2276,14 @@ _COMPARE_METRICS = (
 #: system's quality — the kill-window pull p99 is SUPPOSED to be large
 #: (it measures the injected outage), and the alert thresholds derive
 #: from the run's own baseline
-_COMPARE_EXCLUDE = ("*.alert.*",)
+_COMPARE_EXCLUDE = (
+    "*.alert.*",
+    # goodput scenario-record fields: per-category absolute seconds and
+    # the wasted bill document the EXPERIMENT (sleep choices, task
+    # spans), not the system's quality — the booleans and the fraction
+    # are the gates
+    "*.per_worker.*", "*.wasted.*", "*.fleet.categories.*",
+)
 
 #: boolean leaves: True in the baseline must stay True (structure gates —
 #: bit-exactness, exactly-once, warm resharding, replay identity)
@@ -2050,11 +2362,26 @@ def bench_compare(baseline_doc, current_doc, threshold_pct=30.0):
                 f"{direction}-is-better metric moved "
                 f"{'down' if direction == 'higher' else 'up'} past "
                 f"{threshold_pct}%")))
+    # gated metrics present ONLY in the current record (a new leg added
+    # since the baseline was cut): a NOTE, never a failure — the next
+    # baseline refresh adopts them (ISSUE 12 satellite; without this, a
+    # freshly-added leg reads as untracked silence)
+    new_metrics = []
+    for path, c in sorted(cur.items()):
+        if path in base or isinstance(c, bool):
+            continue
+        direction, _ = _compare_direction(path)
+        if direction is not None:
+            new_metrics.append({
+                "path": path, "current": c,
+                "note": "new metric, no baseline",
+            })
     return {
         "threshold_pct": float(threshold_pct),
         "compared": compared,
         "regressions": regressions,
         "informational": info,
+        "new_metrics": new_metrics,
     }
 
 
@@ -2087,6 +2414,11 @@ def _compare_cli(argv):
             return 2
     report = bench_compare(docs[0], docs[1], threshold_pct=threshold)
     print(json.dumps(report, indent=1))
+    for n in report["new_metrics"]:
+        print(
+            f"[bench] NOTE {n['path']}: {n['current']} "
+            f"({n['note']})", file=sys.stderr,
+        )
     for r in report["regressions"]:
         print(
             f"[bench] REGRESSION {r['path']}: {r['baseline']} -> "
@@ -2187,6 +2519,8 @@ def _run_leg(leg, mesh, np):
         return bench_rescale(mesh, np)
     if leg == "control_plane":
         return bench_control_plane(mesh, np)
+    if leg == "goodput":
+        return bench_goodput(mesh, np)
     if leg == "embedding_tier":
         return bench_embedding_tier(mesh, np)
     if leg == "obs_overhead":
@@ -2230,9 +2564,10 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "rescale", "control_plane", "embedding_tier", "obs_overhead",
-    "embedding", "transformer_lm", "time_to_auc", "mnist_cnn",
-    "census_wide_deep", "xdeepfm", "cifar10_resnet20", "resnet50_imagenet",
+    "rescale", "control_plane", "goodput", "embedding_tier",
+    "obs_overhead", "embedding", "transformer_lm", "time_to_auc",
+    "mnist_cnn", "census_wide_deep", "xdeepfm", "cifar10_resnet20",
+    "resnet50_imagenet",
 )
 LEG_TIMEOUT_S = int(os.environ.get("EDL_BENCH_LEG_TIMEOUT_S", "420"))
 # import time ~= leg-subprocess start: lets long-running legs budget
@@ -2307,6 +2642,14 @@ def main():
         # JSON line — deliberately BEFORE any jax import (no devices are
         # touched; the leg must run on a box with no backend at all)
         record = {"control_plane": bench_control_plane()}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
+        return
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "goodput":
+        # `python bench.py goodput`: the fleet goodput scenario alone
+        # (ISSUE 12) — jax-free like control_plane, before any jax import
+        record = {"goodput": bench_goodput()}
         print(json.dumps(record))
         _maybe_compare_exit(record)
         return

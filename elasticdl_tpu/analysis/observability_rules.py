@@ -61,6 +61,20 @@ EDL405 unbounded-metric-label-cardinality
     should label by a bounded dimension (op, phase, method) and carry
     the unbounded one as a value, not a label.
 
+EDL406 wall-clock-duration-measurement
+    A subtraction whose BOTH operands are wall-clock stamps — a
+    ``time.time()`` call and/or a local name assigned directly from one
+    in the same scope (``t0 = time.time() ... time.time() - t0``). A
+    wall-clock delta used as a duration is corrupted by NTP steps and
+    leap adjustments: a 30 s clock slew lands as a 30 s "step time" in a
+    histogram, a negative phase in the goodput ledger, a phantom reform
+    spike — monotonic/perf_counter deltas are immune and cost the same.
+    Epoch arithmetic against STORED wall-clock stamps (heartbeat
+    staleness windows, cross-process `updated_at` comparisons) is
+    intentionally out of scope: only local-local / call-local pairs
+    flag, and the rare intended case carries a reviewed
+    ``# edl-lint: disable=EDL406`` with justification.
+
 EDL403 fsync-under-lock
     An ``os.fsync`` call lexically inside a `guarded_by:`-annotated
     lock's critical section. An fsync is milliseconds on local disk and
@@ -429,6 +443,112 @@ class SpanSinkInHotLoopRule(Rule):
                             "spans stay at task/rescale granularity "
                             "(EDL404)",
                         )
+
+
+# ------------------------------------------------------------------ #
+# EDL406 wall-clock-duration-measurement
+
+
+def _direct_time_imports(tree: ast.AST) -> Set[str]:
+    """Local names bound to time.time by `from time import time` (any
+    alias)."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for alias in node.names:
+                if alias.name == "time":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_wallclock_call(node: ast.AST, direct_names: Set[str]) -> bool:
+    if not isinstance(node, ast.Call) or node.args or node.keywords:
+        return False
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id in direct_names
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "time"
+        and isinstance(func.value, ast.Name)
+        and func.value.id == "time"
+    )
+
+
+def _scope_bodies(tree: ast.AST):
+    """One statement body per scope: the module body and every function
+    body, each analyzed independently — a name tracked in one function
+    says nothing about another's."""
+    yield getattr(tree, "body", [])
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            yield node.body if not isinstance(node, ast.Lambda) \
+                else [node.body]
+
+
+def _walk_scope(body):
+    """ast.walk over a scope body WITHOUT descending into nested
+    function/lambda scopes (those get their own _scope_bodies entry)."""
+    from collections import deque
+
+    queue = deque(body)
+    while queue:
+        node = queue.popleft()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a nested scope: its body gets its own _scope_bodies entry
+            continue
+        queue.extend(ast.iter_child_nodes(node))
+
+
+@register
+class WallClockDurationRule(Rule):
+    id = "EDL406"
+    name = "wall-clock-duration-measurement"
+    doc = (
+        "time.time() delta used as a duration — NTP steps corrupt "
+        "ledgers and histograms; use time.monotonic()/perf_counter() "
+        "for durations (epoch arithmetic against stored stamps carries "
+        "a reviewed disable)"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        direct_names = _direct_time_imports(ctx.tree)
+        for body in _scope_bodies(ctx.tree):
+            # pass 1: simple names assigned DIRECTLY from time.time() in
+            # this scope (nested defs are separate scopes, not entered)
+            tracked: Set[str] = set()
+            for node in _walk_scope(body):
+                if isinstance(node, ast.Assign) and _is_wallclock_call(
+                    node.value, direct_names
+                ):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            tracked.add(target.id)
+
+            def stampish(node: ast.AST) -> bool:
+                return _is_wallclock_call(node, direct_names) or (
+                    isinstance(node, ast.Name) and node.id in tracked
+                )
+
+            for node in _walk_scope(body):
+                if (
+                    isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)
+                    and stampish(node.left)
+                    and stampish(node.right)
+                ):
+                    yield self.finding(
+                        ctx, node,
+                        "wall-clock delta used as a duration — an "
+                        "NTP step lands here as a phantom (or "
+                        "negative) interval; measure durations with "
+                        "time.monotonic()/perf_counter() (EDL406; "
+                        "intended epoch arithmetic carries a "
+                        "reviewed disable)",
+                    )
 
 
 # ------------------------------------------------------------------ #

@@ -89,6 +89,28 @@ _SHARD_LOAD = _reg.gauge(
     "deduped rows this client pulled per shard (rolling window)",
     labels=("shard",))
 
+
+_GOODPUT_LEDGER = None
+
+
+def _goodput_pull(seconds: float) -> None:
+    """Tee pull wall time into the process goodput ledger: client pulls
+    block the step (ROADMAP 1's pipeline item exists to change that), so
+    they are the `emb_pull_blocked` category — distinct from compute,
+    which times only the jitted step dispatch. The ledger reference is
+    cached after the first pull (same idiom as StepProfiler's tee): this
+    runs per pull on the step path and must not pay the singleton lock
+    every time. (Tests calling goodput.reset_for_tests may leave a
+    stale cached ledger here — adds then land on a detached ledger,
+    which is harmless; nothing asserts on it across resets.)"""
+    global _GOODPUT_LEDGER
+    if _GOODPUT_LEDGER is None:
+        from elasticdl_tpu.observability import goodput
+
+        _GOODPUT_LEDGER = goodput.get_ledger()
+    _GOODPUT_LEDGER.add("emb_pull_blocked", seconds)
+
+
 #: rolling window of recent client pull/push wall times backing the
 #: heartbeat payload's emb_pull_p99_ms (the cumulative histogram cannot
 #: forget a quiet past, so a fresh spike would be diluted)
@@ -255,6 +277,7 @@ class EmbeddingTierClient:
                 out[valid] = expanded
         dt = time.perf_counter() - t0
         _PULL_S.observe(dt)
+        _goodput_pull(dt)
         with self._lock:
             self._pull_times.append(dt)
         return out.reshape(*np.asarray(ids).shape, spec.dim)
@@ -313,6 +336,7 @@ class EmbeddingTierClient:
             rows[:real] = self._pull_unique(table, spec, uniq[:real])
         dt = time.perf_counter() - t0
         _PULL_S.observe(dt)
+        _goodput_pull(dt)
         with self._lock:
             self._pull_times.append(dt)
         return rows, inverse.reshape(np.asarray(ids).shape), uniq

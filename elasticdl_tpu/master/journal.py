@@ -133,6 +133,20 @@ class DispatcherState:
     training_done: bool = False
     save_model_created: bool = False
     requeued_leases: int = 0
+    # goodput accounting (observability/goodput.py): completed training
+    # records (task_finish carries `records` since ISSUE 12; absent in
+    # older journals -> 0) and the wasted-work ledger totals replayed
+    # from `wasted_work` records — the bill survives a master restart.
+    records_completed: int = 0
+    wasted_records: int = 0
+    wasted_events: int = 0
+    wasted_by_reason: Dict[str, Dict[str, int]] = field(default_factory=dict)
+    # the CURRENT replay's conservatively-requeued in-flight leases
+    # ({task_id, records} per TRAINING lease): the successor journals
+    # these as `crash_requeue` wasted-work entries at restore. Always
+    # overwritten by the replay's end block (a snapshot-carried list from
+    # a prior generation must not re-journal).
+    requeued: List[Dict[str, Any]] = field(default_factory=list)
 
 
 @dataclass
@@ -204,14 +218,28 @@ def _replay_dispatcher(
         if rec.get("training"):
             state.finished_training += 1
             state.completed_versions += 1
+            state.records_completed += int(rec.get("records", 0) or 0)
+    elif rtype == "wasted_work":
+        records = int(rec.get("records", 0) or 0)
+        state.wasted_events += 1
+        state.wasted_records += records
+        ent = state.wasted_by_reason.setdefault(
+            str(rec.get("reason", "?")), {"events": 0, "records": 0})
+        ent["events"] += 1
+        ent["records"] += records
     elif rtype == "task_requeue":
         task = doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
         if task is not None:
             task["start"] = rec.get("start", task["start"])
             task["retries"] = rec.get("retries", task.get("retries", 0))
             state.todo.insert(0, task)
+        # a drain requeue retires its `completed` prefix (covered by the
+        # worker's drain checkpoint) — replay parity for the live
+        # records_completed counter
+        state.records_completed += int(rec.get("completed", 0) or 0)
     elif rtype == "task_drop":
         doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
+        state.records_completed += int(rec.get("completed", 0) or 0)
     elif rtype == "task_fail":
         doing.pop(rec["task_id"], None) or take_todo(rec["task_id"])
         state.failed_permanently += 1
@@ -241,7 +269,7 @@ _SAVE_MODEL_TYPE = 3
 _DISPATCHER_RECORDS = frozenset({
     "task_create", "task_lease", "task_finish", "task_requeue", "task_drop",
     "task_fail", "epoch_advance", "epoch_end", "training_done", "job_end",
-    "stop_training",
+    "stop_training", "wasted_work",
 })
 
 
@@ -399,6 +427,17 @@ def replay_lines(lines: List[str]) -> ReplayResult:
         ]
         dispatcher.todo = requeued + dispatcher.todo
         dispatcher.requeued_leases = len(requeued)
+        # the wasted-work view of the conservative requeue: every
+        # requeued TRAINING lease's span re-trains whole. The successor
+        # dispatcher journals these as `crash_requeue` entries at restore
+        # (this list is replay-LOCAL — always overwritten here, so a
+        # snapshot-carried copy from a prior generation never
+        # re-journals).
+        dispatcher.requeued = [
+            {"task_id": t.get("task_id", -1),
+             "records": max(0, int(t.get("end", 0)) - int(t.get("start", 0)))}
+            for t in requeued if t.get("type") == _TRAINING_TYPE
+        ]
     if pending_reshard is not None:
         # master died mid-resharding: the moves may be partially executed
         # but were never committed — roll back to the committed map (the

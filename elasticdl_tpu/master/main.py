@@ -176,6 +176,15 @@ class Master:
             json_path=(os.path.join(base_dir, "control", "alerts.json")
                        if base_dir else None),
         )
+        # Fleet goodput ledger (ISSUE 12, observability/goodput.py): the
+        # rollup over heartbeat ledger payloads + the dispatcher's
+        # journal-durable wasted-work bill — recomputed every wait poll,
+        # exported as edl_goodput_* gauges, sampled into the time series
+        # (the goodput_burn / wasted_work_ratio default rules' input),
+        # served at /goodput and inside /healthz.
+        from elasticdl_tpu.observability.goodput import FleetGoodput
+
+        self.goodput = FleetGoodput(self.membership, self.dispatcher)
 
         # Elastic sharded embedding tier (ROADMAP 1): the master owns the
         # id-sharded table map, durable through the same journal as task
@@ -293,6 +302,7 @@ class Master:
             role="master", port=self.cfg.metrics_port,
             health_fn=self._healthz_extra,
             timeseries=self.timeseries, alerts=self.alerts,
+            goodput_fn=self.goodput.snapshot,
         )
         if self.cfg.instance_manager == "k8s":
             # the reference's k8s flavor: the master creates worker pods and
@@ -364,6 +374,10 @@ class Master:
             "alive_workers": self.membership.alive_count(),
             "cluster": self.health.snapshot(),
             "alerts_active": self.alerts.active(),
+            # the fleet goodput/wasted-work picture rides health
+            # snapshots too, so chaos artifacts (and the incident CLI
+            # reading them) carry the incident's bill
+            "goodput": self.goodput.snapshot(),
         }
 
     def _fleet_series(self) -> dict:
@@ -375,12 +389,16 @@ class Master:
 
         counts = self.dispatcher.counts()
         snap = self.health.snapshot()
-        return fleet_series(
+        series = fleet_series(
             self.membership.health_snapshot(),
             straggler_count=snap.get("straggler_count", 0),
             todo_tasks=counts.get("todo", 0),
             alive_workers=self.membership.alive_count(),
         )
+        # goodput series join the same sample: the fraction + wasted
+        # ratio the default alert rules window over
+        series.update(self.goodput.series())
+        return series
 
     def wait(
         self,
@@ -404,6 +422,10 @@ class Master:
             # fleet rollup + straggler scoring (never raises; gauges and
             # edge-triggered cluster.straggler events update here)
             self.health.update()
+            # fleet goodput rollup (never raises): heartbeat ledger
+            # payloads + the dispatcher's wasted-work bill -> the
+            # edl_goodput_* gauges the sampler below snapshots
+            self.goodput.update()
             # time-series sample when due (fleet series computed only
             # then) + declarative alert evaluation over the history —
             # edge-triggered cluster.alert events, edl_alert_* metrics,

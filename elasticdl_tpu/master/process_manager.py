@@ -426,7 +426,9 @@ class ProcessManager:
         re-rendezvous at a new world size, SURVEY §2.1/§3.4). The new world
         restores from the latest checkpoint and keeps the global batch and
         LR unchanged (strong scaling — only per-device slice sizes move)."""
-        t0 = time.time()
+        # monotonic: this delta feeds the reform-duration histogram, and
+        # an NTP step through a wall-clock delta would corrupt it (EDL406)
+        t0 = time.monotonic()
         # the span wraps the lock (not the reverse) so its exit — a
         # trace.jsonl write — never runs under the control-plane lock
         with tracing.span(
@@ -496,7 +498,7 @@ class ProcessManager:
                 _COHORT_SIZE.set(self._cohort_size)
         tracing.set_world_version(world_version)
         _REFORMS.inc(kind="resize" if new_size != old_size else "relaunch")
-        _REFORM_S.observe(time.time() - t0)
+        _REFORM_S.observe(time.monotonic() - t0)
         if new_size != old_size:
             logger.warning(
                 "cohort RESIZED %d -> %d processes (world v%d): %s",

@@ -90,7 +90,8 @@ class MasterServicer:
             # edl-lint: disable=EDL303
             return {}
 
-    def _fence_generation(self, method: str, context) -> None:
+    def _fence_generation(self, method: str, context,
+                          on_fence=None) -> None:
         """Abort with a retriable FAILED_PRECONDITION when the caller
         claims a master generation other than this master's. The claim is
         optional (no claim = unfenced legacy caller); the mismatch aborts
@@ -98,7 +99,12 @@ class MasterServicer:
         dead master's generation ever reaches the replayed queues. Workers
         react by re-registering (a generation-free RegisterWorker with
         REREGISTER_KEY), not by dying — see proto/service.py
-        is_stale_generation."""
+        is_stale_generation.
+
+        `on_fence` runs just before the abort — the wasted-work ledger's
+        hook (a fenced ReportTaskResult is finished work being
+        discarded). Best-effort: a failing hook never unfences the
+        call."""
         if not self.generation or context is None:
             return
         claimed = self._request_metadata(context).get(GENERATION_KEY)
@@ -114,6 +120,13 @@ class MasterServicer:
                 "%s fenced: stale master generation %d (current %d)",
                 method, claimed, self.generation,
             )
+            if on_fence is not None:
+                try:
+                    on_fence()
+                except Exception:
+                    # accounting is advisory; the fence must still land:
+                    # edl-lint: disable=EDL303
+                    logger.exception("fence accounting hook failed")
             context.abort(
                 grpc.StatusCode.FAILED_PRECONDITION,
                 f"stale master generation {claimed} (current "
@@ -183,7 +196,15 @@ class MasterServicer:
         return pb.GetTaskResponse(task=protos[0], tasks=protos)
 
     def ReportTaskResult(self, request, context):
-        self._fence_generation("ReportTaskResult", context)
+        # a fenced report is COMPLETED work the fence discards (the
+        # replayed lease re-runs it whole): bill the wasted-work ledger
+        # before aborting — docs/observability.md "Goodput ledger"
+        self._fence_generation(
+            "ReportTaskResult", context,
+            on_fence=lambda: self._dispatcher.note_fenced_report(
+                request.task_id, request.records_processed,
+            ),
+        )
         accepted = self._dispatcher.report(
             request.task_id,
             request.worker_id,

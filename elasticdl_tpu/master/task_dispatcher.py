@@ -13,6 +13,7 @@ reference relied purely on pod-death events, which misses hung workers.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import random
 import threading
 import time
@@ -23,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.master.journal import CommitGate
 from elasticdl_tpu.observability import tracing
+from elasticdl_tpu.observability.goodput import record_wasted
 from elasticdl_tpu.observability.registry import default_registry
 from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
 
@@ -147,6 +149,21 @@ class TaskDispatcher(CommitGate):
         self._pending_failed: List[TaskSpec] = []    # guarded_by: _lock
         # training version counter: bumps on every finished training task
         self._completed_versions = 0                 # guarded_by: _lock
+        # goodput accounting (observability/goodput.py): completed
+        # training records, and the wasted-work ledger — every entry is
+        # journaled (`wasted_work`) inside the same critical section as
+        # the transition that caused it, so a master restart replays the
+        # bill intact
+        self._records_completed = 0                  # guarded_by: _lock
+        self._wasted_records = 0                     # guarded_by: _lock
+        self._wasted_events = 0                      # guarded_by: _lock
+        self._wasted_by_reason: Dict[str, Dict[str, int]] = {}  # guarded_by: _lock
+        # the evidence buckets bill at most once per task (in-memory: a
+        # master restart may re-bill one, which their at-least-once
+        # semantics tolerate) — a client re-sending the same rejected
+        # report must not grow the journal or the ratio per attempt
+        self._fenced_billed: set = set()             # guarded_by: _lock
+        self._stale_billed: set = set()              # guarded_by: _lock
         # final exclusive SAVE_MODEL task (reference: the master's save-model
         # task at job end, SURVEY §2.1): created once, after everything else
         # drains, before job-end fires
@@ -184,6 +201,21 @@ class TaskDispatcher(CommitGate):
         self._completed_versions = snap.completed_versions
         self._stop_training = snap.stop_training
         self._save_model_created = snap.save_model_created
+        self._records_completed = snap.records_completed
+        self._wasted_records = snap.wasted_records
+        self._wasted_events = snap.wasted_events
+        self._wasted_by_reason = {
+            k: dict(v) for k, v in snap.wasted_by_reason.items()
+        }
+        # the conservative lease requeue is the crash's wasted-work bill:
+        # every requeued TRAINING span re-trains whole. Journaled NOW by
+        # the successor (the crashed master could not), one entry per
+        # task, in the construction-time single-threaded window.
+        for entry in snap.requeued:
+            self._note_wasted_locked(
+                "crash_requeue", int(entry.get("task_id", -1)),
+                int(entry.get("records", 0)),
+            )
         if self._training_shards:
             # epoch_end / training_done / job_end CALLBACKS are volatile
             # (they create eval jobs and run zoo hooks) and run OUTSIDE
@@ -216,6 +248,98 @@ class TaskDispatcher(CommitGate):
     # _j / _take_commit_locked / _await: the ack-after-fsync plumbing is
     # CommitGate (master/journal.py) — shared with Membership so the
     # durability protocol cannot drift between the two
+
+    # ------------------------------------------------------------------ #
+    # wasted-work ledger (observability/goodput.py)
+
+
+    #: how deep the rejection paths look into todo when resolving a
+    #: claimed task: requeued leases land at the FRONT (appendleft), so
+    #: a bounded scan covers the real ghost-report case while keeping
+    #: the hammerable rejection path O(1)-ish instead of O(todo) under
+    #: the control-plane lock
+    _REJECT_SCAN_BOUND = 64
+
+    def _resolve_front_locked(self, task_id: int):  # holds: _lock
+        """The claimed task's spec, from the live lease or the front of
+        todo (bounded); None = unresolvable, rejected unbilled."""
+        lease = self._doing.get(task_id)
+        if lease is not None:
+            return lease.task
+        return next(
+            (t for t in itertools.islice(
+                self._todo, self._REJECT_SCAN_BOUND)
+             if t.task_id == task_id),
+            None,
+        )
+
+    def _note_wasted_locked(  # holds: _lock
+        self, reason: str, task_id: int, records: int,
+    ) -> None:
+        """One wasted-work entry: counted, metric'd, and journaled inside
+        the SAME critical section as the transition that caused it (disk
+        order is mutation order, so replay reconstructs the bill
+        exactly). `reason` values come from goodput.WASTED_REASONS — a
+        bounded vocabulary, every call site a literal."""
+        records = max(0, int(records))
+        self._wasted_events += 1
+        self._wasted_records += records
+        ent = self._wasted_by_reason.setdefault(
+            reason, {"events": 0, "records": 0})
+        ent["events"] += 1
+        ent["records"] += records
+        record_wasted(reason, records)
+        self._j(
+            "wasted_work", reason=reason, task_id=task_id, records=records,
+        )
+
+    def wasted_work(self) -> Dict[str, Any]:
+        """The wasted-work rollup FleetGoodput (and /goodput) reads:
+        journal-durable totals, per-reason buckets, and the wasted ratio
+        against completed training records."""
+        with self._lock:
+            wasted = self._wasted_records
+            completed = self._records_completed
+            return {
+                "wasted_records": wasted,
+                "wasted_events": self._wasted_events,
+                "records_completed": completed,
+                "wasted_ratio": round(
+                    wasted / max(1, wasted + completed), 6),
+                "by_reason": {
+                    k: dict(v) for k, v in self._wasted_by_reason.items()
+                },
+            }
+
+    def note_fenced_report(self, task_id: int, records: int) -> None:
+        """A completed ReportTaskResult rejected by the generation fence
+        (servicer, pre-mutation): the work behind it is discarded — the
+        restarted master's replay already requeued the lease whole. The
+        claimed records land in the `fenced_report` evidence bucket
+        (overlapping the `crash_requeue` re-training bill on purpose:
+        one bucket bills the re-run, the other proves finished work was
+        thrown away).
+
+        Same credibility gates as the stale_report bucket: the claim
+        must resolve to a TRAINING task the dispatcher can still see, is
+        clamped to its real span, bills at most ONCE per task, and is
+        never awaited — a fence rejection is a cheap path a stale client
+        can hammer, and an unvalidated claim would inflate the wasted
+        ratio (the wasted_work_ratio alert's input) without bound."""
+        with self._lock:
+            spec = self._resolve_front_locked(task_id)
+            claimed = max(0, int(records))
+            if (
+                spec is None or spec.type != pb.TRAINING
+                or claimed <= 0 or task_id in self._fenced_billed
+            ):
+                return
+            self._fenced_billed.add(task_id)
+            self._note_wasted_locked(
+                "fenced_report", task_id, min(claimed, spec.num_records)
+            )
+            # advisory evidence — flushed on the journal's cadence
+            self._take_commit_locked()
 
     # ------------------------------------------------------------------ #
     # task creation
@@ -382,34 +506,57 @@ class TaskDispatcher(CommitGate):
         """Returns False for an unknown/stale lease (e.g. the task was
         already recovered from this worker and completed elsewhere)."""
         callbacks: List[Callable] = []
+        stale = False
+        held_by: Optional[int] = None
         with self._lock:
             lease = self._doing.get(task_id)
-            if lease is None:
+            stale = lease is None or lease.worker_id != worker_id
+            if stale:
                 _STALE_REPORTS.inc()
-                logger.warning(
-                    "stale/unknown task report: task=%d worker=%d", task_id, worker_id
-                )
-                return False
-            if lease.worker_id != worker_id:
-                _STALE_REPORTS.inc()
-                # The lease expired and was re-leased to another worker; this
-                # report is from the original (stale) holder. Accepting it
-                # would retire records the new holder is still re-running —
-                # double-application under the preemption-drain protocol.
-                logger.warning(
-                    "rejecting report for task %d from worker %d: lease now "
-                    "held by worker %d", task_id, worker_id, lease.worker_id,
-                )
-                return False
-            del self._doing[task_id]
-            task = lease.task
-            if success:
+                held_by = lease.worker_id if lease is not None else None
+                # Bill ONLY a credible discarded-work claim: a TRAINING
+                # task the dispatcher can still see (held by a newer
+                # lease, or requeued onto todo — the kill-worker ghost
+                # report) whose reporter claims completed records. A
+                # failed/empty stale report discards nothing, and a
+                # report for a task id the dispatcher cannot resolve is
+                # unvalidated remote input — rejected unbilled, or a
+                # misbehaving client could inflate the wasted ratio (the
+                # wasted_work_ratio alert's input) without bound.
+                spec = self._resolve_front_locked(task_id)
+                if (
+                    spec is not None and spec.type == pb.TRAINING
+                    and (success or records_processed > 0)
+                    and task_id not in self._stale_billed
+                ):
+                    self._stale_billed.add(task_id)
+                    claimed = records_processed or spec.num_records
+                    self._note_wasted_locked(
+                        "stale_report", task_id,
+                        min(claimed, spec.num_records),
+                    )
+                # the entry is advisory EVIDENCE, flushed on the
+                # journal's normal cadence — deliberately NOT awaited:
+                # the rejection must stay a cheap, never-raising path (a
+                # JournalCommitError here would read as delivery failure
+                # and flip the worker's drain-checkpoint retention)
+                self._take_commit_locked()
+            else:
+                del self._doing[task_id]
+                task = lease.task
+            if stale:
+                pass   # rejection path finishes after the lock releases
+            elif success:
                 if task.type == pb.TRAINING:
                     self._finished_training += 1
                     self._completed_versions += 1
+                    self._records_completed += task.num_records
                 self._j(
                     "task_finish", task_id=task_id,
                     training=task.type == pb.TRAINING,
+                    records=(
+                        task.num_records if task.type == pb.TRAINING else 0
+                    ),
                 )
                 _TASKS_FINISHED.inc()
             elif preempted:
@@ -421,13 +568,23 @@ class TaskDispatcher(CommitGate):
                     if task.type == pb.TRAINING:
                         self._finished_training += 1
                         self._completed_versions += 1
+                        self._records_completed += done
                     self._j(
                         "task_finish", task_id=task_id,
                         training=task.type == pb.TRAINING,
+                        records=done if task.type == pb.TRAINING else 0,
                     )
                 else:
                     task.start += done
-                    self._requeue_locked(task, "preemption remainder")
+                    # the drained remainder re-leases elsewhere: its
+                    # batches were read (and possibly prefetched) once
+                    # for nothing — the drain_requeue bucket; the `done`
+                    # prefix COMPLETED (covered by the drain checkpoint)
+                    self._requeue_locked(
+                        task, "preemption remainder",
+                        wasted_reason="drain_requeue",
+                        completed=done,
+                    )
                     logger.info(
                         "task %d preempted after %d records; requeued remainder "
                         "[%d, %d)", task_id, done, task.start, task.end,
@@ -439,12 +596,28 @@ class TaskDispatcher(CommitGate):
                         "task %d failed (%s); requeue retry %d",
                         task_id, err, task.retries,
                     )
-                    self._requeue_locked(task, "failure retry")
+                    self._requeue_locked(
+                        task, "failure retry",
+                        wasted_reason="failure_retry",
+                    )
                 else:
                     self._fail_permanently_locked(task, err)
-            callbacks = self._maybe_advance_epoch_locked()
-            commit = self._take_commit_locked()
-            self._set_queue_gauges_locked()
+            if not stale:
+                callbacks = self._maybe_advance_epoch_locked()
+                commit = self._take_commit_locked()
+                self._set_queue_gauges_locked()
+        if stale:
+            if held_by is None:
+                logger.warning(
+                    "stale/unknown task report: task=%d worker=%d",
+                    task_id, worker_id,
+                )
+            else:
+                logger.warning(
+                    "rejecting report for task %d from worker %d: lease "
+                    "now held by worker %d", task_id, worker_id, held_by,
+                )
+            return False
         # ack-after-fsync: accepted=True is the acknowledgment the worker
         # keys destructive decisions off (drain-checkpoint retention) — it
         # must not leave before the finish/requeue record is durable
@@ -456,23 +629,42 @@ class TaskDispatcher(CommitGate):
         self._flush_callbacks(callbacks)
         return True
 
-    def _requeue_locked(self, task: TaskSpec, why: str) -> None:
+    def _requeue_locked(self, task: TaskSpec, why: str,
+                        wasted_reason: Optional[str] = None,
+                        completed: int = 0) -> None:
         """Put a task back on todo — unless it's a TRAINING task after
         request_stop_training, which would resurrect training the early stop
         already ended (the one-shot queue purge can't catch tasks that were
-        in flight when the stop fired)."""
+        in flight when the stop fired).
+
+        `wasted_reason` bills the requeue to the wasted-work ledger
+        (goodput.REQUEUE_REASONS; None = nothing wasted — e.g. a lease
+        that never ran). `completed` journals drain-retired records so
+        replay's records_completed matches the live counter."""
+        # `completed` counts (and journals) for TRAINING only — replay
+        # adds the journaled field unconditionally, so journaling it for
+        # a non-training drain would make the replayed records_completed
+        # diverge from the live counter
+        completed = completed if task.type == pb.TRAINING else 0
+        if completed > 0:
+            self._records_completed += completed
         if self._stop_training and task.type == pb.TRAINING:
             logger.info(
                 "dropping training task %d (%s) after stop request",
                 task.task_id, why,
             )
-            self._j("task_drop", task_id=task.task_id)
+            self._j(
+                "task_drop", task_id=task.task_id, completed=completed,
+            )
             return
+        if wasted_reason is not None and task.type == pb.TRAINING:
+            self._note_wasted_locked(
+                wasted_reason, task.task_id, task.num_records)
         _TASKS_REQUEUED.inc()
         self._todo.appendleft(task)
         self._j(
             "task_requeue", task_id=task.task_id, start=task.start,
-            retries=task.retries,
+            retries=task.retries, completed=completed,
         )
 
     def _fail_permanently_locked(self, task: TaskSpec, err: str) -> None:
@@ -492,7 +684,13 @@ class TaskDispatcher(CommitGate):
             stale = [t for t, l in self._doing.items() if l.worker_id == worker_id]
             for tid in stale:
                 task = self._doing.pop(tid).task
-                self._requeue_locked(task, f"worker {worker_id} died")
+                # the dead worker's span re-trains whole: the rescale
+                # bill's wasted-records half (bench.py goodput asserts
+                # the kill-worker scenario lands here)
+                self._requeue_locked(
+                    task, f"worker {worker_id} died",
+                    wasted_reason="worker_died",
+                )
             commit = self._take_commit_locked()
             self._set_queue_gauges_locked()
         self._await(commit)
@@ -516,7 +714,10 @@ class TaskDispatcher(CommitGate):
                     "task %d lease expired (worker %d); requeued",
                     tid, lease.worker_id,
                 )
-                self._requeue_locked(lease.task, "lease expired")
+                self._requeue_locked(
+                    lease.task, "lease expired",
+                    wasted_reason="lease_expired",
+                )
             else:
                 self._fail_permanently_locked(lease.task, "lease expired")
         if expired:

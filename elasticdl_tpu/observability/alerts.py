@@ -188,6 +188,36 @@ def default_rules() -> List[AlertRule]:
             description="one embedding shard serves >3x the mean load — "
                         "the hot-row-cache / replica signal (ROADMAP 1)",
         ),
+        # ISSUE 12 (observability/goodput.py): the two rules that watch
+        # the bill itself. Both series come from the master's
+        # FleetGoodput rollup riding the fleet sampler.
+        # both goodput rules watch the WINDOWED (per-rollup-delta)
+        # series, not the lifetime-cumulative ones: after 10h at 0.9 a
+        # 30-minute stall barely moves a cumulative fraction, and a long
+        # boot compile would depress it past any for_s hold — the recent
+        # series measure the last interval and reach the store ONLY via
+        # FleetGoodput.series() (deliberately no registry gauge — see
+        # observability/goodput.py's note above its gauge factories)
+        AlertRule(
+            "goodput_burn",
+            series="edl_goodput_fleet_recent_fraction",
+            threshold=0.5, op="<", mode="burn_rate", window_s=60.0,
+            long_window_s=300.0, for_s=120.0, severity="warn",
+            description="fleet goodput fraction (windowed) sustained "
+                        "below half — most paid chip-seconds are not "
+                        "training; read /goodput for the category "
+                        "breakdown (for_s rides out boot compiles)",
+        ),
+        AlertRule(
+            "wasted_work_ratio",
+            series="edl_goodput_recent_wasted_ratio",
+            threshold=0.05, mode="avg", window_s=120.0, for_s=30.0,
+            severity="warn",
+            description="over 5% of recently-processed training records "
+                        "are being re-trained (requeues after "
+                        "crash/expiry) — crash-replay or lease-timeout "
+                        "churn",
+        ),
     ]
 
 

@@ -38,7 +38,9 @@ bundle can only mean the writer itself died mid-incident):
                  {"kind": "log", ...} lines, explicit records],
      "metrics": {series: value},            # full registry snapshot
      "metrics_delta": {series: delta},      # vs the previous dump/mark
-     "profile": {...step-profiler snapshot...}}
+     "profile": {...step-profiler snapshot...},
+     "goodput": {...goodput-ledger snapshot: per-category wall-clock
+                 attribution at the moment the box was cut...}}
 
 Everything here is stdlib-only, jax-free, and strictly best-effort: a
 full ring, a failed dump, or a missing directory must never take the
@@ -260,6 +262,17 @@ class FlightRecorder:
         except Exception:
             # the profiler block is advisory; a bundle without it is still
             # a bundle: edl-lint: disable=EDL303
+            pass
+        try:
+            from elasticdl_tpu.observability import goodput as goodput_lib
+
+            # the process's goodput attribution at the moment the box
+            # was cut — the per-worker half of the incident's bill
+            # (ISSUE 12; the fleet half rides health snapshots)
+            out["goodput"] = goodput_lib.get_ledger().snapshot()
+        except Exception:
+            # advisory, same as the profiler block:
+            # edl-lint: disable=EDL303
             pass
         return out
 

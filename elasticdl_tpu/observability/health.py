@@ -53,9 +53,11 @@ STATS_METADATA_KEY = "edl-worker-stats"
 
 #: decode() rejects payloads past this — a corrupt/hostile value must cost
 #: a bounded parse attempt, never master memory (key budget raised for
-#: ISSUE 11's embedding skew ride-along: emb_* keys below)
-MAX_PAYLOAD_BYTES = 2048
-MAX_PAYLOAD_KEYS = 32
+#: ISSUE 11's embedding skew ride-along — emb_* keys below — and again
+#: for ISSUE 12's goodput-ledger ride-along: up to 9 gp_* keys per
+#: worker, observability/goodput.py payload schema)
+MAX_PAYLOAD_BYTES = 3072
+MAX_PAYLOAD_KEYS = 48
 
 #: step-profiler keys (observability/profile.py snapshot schema) plus the
 #: embedding-tier skew keys (embedding/tier.tier_stats) carried from a

@@ -42,10 +42,33 @@ PORT_ENV = "EDL_METRICS_PORT"
 class ObservabilityServer:
     """One /metrics + /healthz endpoint over a registry."""
 
+    #: endpoint -> one-line description: what GET / serves, so an
+    #: operator curling a process learns its surface without reading
+    #: source (every process serves all of these; master-only state —
+    #: alerts, fleet goodput — answers with a disabled/absent marker
+    #: elsewhere)
+    ENDPOINTS = {
+        "/": "this index",
+        "/metrics": "Prometheus text: the process metric registry",
+        "/healthz": "liveness + role/world-version (master adds "
+                    "generation, membership, cluster rollup, alerts, "
+                    "fleet goodput)",
+        "/timeseries": "recent metric history ring "
+                       "(?window=<s>&series=a,b)",
+        "/alerts": "alert engine state (active/history/rules; "
+                   "disabled off-master)",
+        "/goodput": "goodput ledger: per-category wall-clock "
+                    "attribution (master adds the fleet rollup + "
+                    "wasted-work bill)",
+        "/debug/flight": "dump + serve the flight-recorder ring "
+                         "(explicit incident trigger)",
+    }
+
     def __init__(self, registry: Optional[MetricsRegistry] = None,
                  role: str = "", host: str = "127.0.0.1",
                  health_fn: Optional[Callable[[], Dict]] = None,
-                 flight=None, timeseries=None, alerts=None):
+                 flight=None, timeseries=None, alerts=None,
+                 goodput_fn: Optional[Callable[[], Dict]] = None):
         self.registry = registry or default_registry()
         self.role = role
         self.host = host
@@ -59,6 +82,10 @@ class ObservabilityServer:
         # endpoint answers with an empty, disabled-marked state)
         self.timeseries = timeseries
         self.alerts = alerts
+        # /goodput serves the process ledger's attribution; the master
+        # wires goodput_fn to add its FleetGoodput rollup (cached state,
+        # never a recompute — same contract as health_fn)
+        self.goodput_fn = goodput_fn
         # /healthz enrichment: a dict merged into the response (the master
         # wires generation/alive-count/cluster-rollup here). Best-effort
         # like everything else on this surface — a raising callback marks
@@ -92,7 +119,20 @@ class ObservabilityServer:
                     outer.stop(_from_handler=True)
                     self.close_connection = True
                     return
-                if self.path.split("?")[0] == "/metrics":
+                if self.path.split("?")[0] == "/":
+                    # the index (ISSUE 12 satellite): every mounted
+                    # endpoint with a one-line description — no more
+                    # reading the source to learn what a process serves
+                    payload = {
+                        "role": outer.role,
+                        "endpoints": dict(outer.ENDPOINTS),
+                    }
+                    body = (
+                        json.dumps(payload, indent=1, sort_keys=True)
+                        + "\n"
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/metrics":
                     body = outer.registry.render_prometheus().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif self.path.split("?")[0] == "/debug/flight":
@@ -154,6 +194,34 @@ class ObservabilityServer:
                         payload = {"enabled": False, "active": [],
                                    "history": [], "rules": []}
                     payload["role"] = outer.role
+                    body = (
+                        json.dumps(payload, default=repr) + "\n"
+                    ).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/goodput":
+                    # the goodput ledger (observability/goodput.py):
+                    # this process's per-category wall-clock attribution
+                    # (snapshot copies under the leaf lock, arithmetic
+                    # outside), plus — on the master — the cached fleet
+                    # rollup and wasted-work bill. Best-effort like
+                    # health_fn: a raising fleet callback marks the
+                    # response, never 500s it.
+                    from elasticdl_tpu.observability import (
+                        goodput as goodput_lib,
+                    )
+
+                    payload = {
+                        "role": outer.role,
+                        "ledger": goodput_lib.get_ledger().snapshot(),
+                    }
+                    if outer.goodput_fn is not None:
+                        try:
+                            extra = outer.goodput_fn()
+                            if isinstance(extra, dict):
+                                payload["fleet"] = extra
+                        except Exception:
+                            # edl-lint: disable=EDL303
+                            payload["fleet_error"] = True
                     body = (
                         json.dumps(payload, default=repr) + "\n"
                     ).encode()
@@ -256,6 +324,7 @@ def start_server(role: str = "", port: Optional[int] = None,
                  registry: Optional[MetricsRegistry] = None,
                  health_fn: Optional[Callable[[], Dict]] = None,
                  timeseries=None, alerts=None,
+                 goodput_fn: Optional[Callable[[], Dict]] = None,
                  ) -> Optional[ObservabilityServer]:
     """Best-effort endpoint start for the master/worker entrypoints.
     A set (non-empty) EDL_METRICS_PORT env overrides `port` in BOTH
@@ -284,7 +353,7 @@ def start_server(role: str = "", port: Optional[int] = None,
         return None
     server = ObservabilityServer(
         registry=registry, role=role, health_fn=health_fn,
-        timeseries=timeseries, alerts=alerts,
+        timeseries=timeseries, alerts=alerts, goodput_fn=goodput_fn,
     )
     try:
         server.start(port)

@@ -152,6 +152,22 @@ def _load_journal(paths: Iterable[str], tail: int) -> Optional[dict]:
         out["records"] += result.records
         out["dropped_lines"] += result.dropped_lines
         out["generations"].append(result.prior_generation)
+        if result.dispatcher is not None:
+            # the wasted-work bill the journal carries (ISSUE 12,
+            # observability/goodput.py): re-trained / discarded records,
+            # per reason — the incident's data-plane cost
+            d = result.dispatcher
+            out["wasted_records"] = (
+                out.get("wasted_records", 0) + d.wasted_records)
+            out["wasted_events"] = (
+                out.get("wasted_events", 0) + d.wasted_events)
+            out["records_completed"] = (
+                out.get("records_completed", 0) + d.records_completed)
+            by = out.setdefault("wasted_by_reason", {})
+            for reason, ent in d.wasted_by_reason.items():
+                tot = by.setdefault(reason, {"events": 0, "records": 0})
+                tot["events"] += ent.get("events", 0)
+                tot["records"] += ent.get("records", 0)
         parsed = []
         for line in lines:
             line = line.strip()
@@ -276,6 +292,46 @@ def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
     pooled = list({_entry_key(r): r for r in span_records}.values())
     analysis = analyzer.analyze_records(pooled)
 
+    journal = _load_journal(paths, tail)
+    health = _health_snapshots(paths)
+
+    # the incident's bill (ISSUE 12): wasted records from the replayed
+    # journal + non-productive worker-seconds from the NEWEST fleet
+    # goodput rollup any health snapshot carries ("this incident cost
+    # 412 worker-seconds and 18k re-trained records")
+    goodput_summary: dict = {}
+    if journal and journal.get("wasted_records") is not None:
+        goodput_summary["wasted_records"] = journal["wasted_records"]
+        goodput_summary["wasted_events"] = journal.get("wasted_events", 0)
+        goodput_summary["records_completed"] = journal.get(
+            "records_completed", 0)
+        goodput_summary["wasted_by_reason"] = journal.get(
+            "wasted_by_reason", {})
+    best_fleet = None
+    best_ts = -1.0
+    for snap in health:
+        gp = snap.get("goodput") or {}
+        fleet = gp.get("fleet") or {}
+        if not fleet:
+            continue
+        # newest by the rollup's OWN timestamp, not by wall_s: reporter
+        # churn (a killed worker's ledger leaving the sum) makes a
+        # pre-incident snapshot's cumulative wall LARGER than the
+        # post-incident one, and the summary must describe the latest
+        # fleet state
+        ts = gp.get("ts")
+        ts = float(ts) if isinstance(ts, (int, float)) else 0.0
+        if best_fleet is None or ts > best_ts:
+            best_fleet = fleet
+            best_ts = ts
+    if best_fleet:
+        cats = best_fleet.get("categories") or {}
+        goodput_summary["fleet_goodput_fraction"] = best_fleet.get(
+            "goodput_fraction")
+        goodput_summary["fleet_wall_s"] = best_fleet.get("wall_s")
+        goodput_summary["non_productive_worker_seconds"] = round(
+            sum(v for c, v in cats.items() if c != "train_compute"), 3)
+
     report = {
         "paths": paths,
         "bundles": [
@@ -307,8 +363,9 @@ def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
         "timeline": timeline,
         "key_events": key_events,
         "traces": analysis,
-        "journal": _load_journal(paths, tail),
-        "health": _health_snapshots(paths),
+        "journal": journal,
+        "health": health,
+        "goodput": goodput_summary,
     }
     return report
 
@@ -340,6 +397,35 @@ def render_text(report: dict, max_entries: int = 200) -> str:
             f"{journal['dropped_lines']} dropped line(s), "
             f"tail of {len(journal['tail'])} kept"
         )
+    goodput = report.get("goodput") or {}
+    if goodput:
+        # the headline bill, in one sentence a capacity owner can read
+        parts = []
+        if goodput.get("non_productive_worker_seconds") is not None:
+            parts.append(
+                f"{goodput['non_productive_worker_seconds']:g} "
+                "non-productive worker-seconds"
+            )
+        if goodput.get("wasted_records") is not None:
+            parts.append(
+                f"{goodput['wasted_records']} re-trained/discarded "
+                "record(s)"
+            )
+        if parts:
+            lines.append("goodput: this incident cost " + " and ".join(parts))
+        if goodput.get("fleet_goodput_fraction") is not None:
+            lines.append(
+                f"  fleet goodput fraction "
+                f"{goodput['fleet_goodput_fraction']:.3f} over "
+                f"{goodput.get('fleet_wall_s', 0):g} worker-seconds"
+            )
+        for reason, ent in sorted(
+            (goodput.get("wasted_by_reason") or {}).items()
+        ):
+            lines.append(
+                f"  wasted[{reason}]: {ent.get('records', 0)} record(s) "
+                f"across {ent.get('events', 0)} event(s)"
+            )
     for snap in report.get("health") or ():
         # snapshot_age_s (ISSUE 11): how stale the rollup was when it
         # was served — the difference between "the fleet was fine" and

@@ -59,12 +59,31 @@ _MEM_DEV = _reg.gauge(
     "device memory high-water mark (MB; 0 when the backend exposes none)")
 
 
+#: profiler phase -> goodput-ledger category (observability/goodput.py).
+#: `handoff` is deliberately ABSENT: rescale seconds are attributed at
+#: the rescale sites themselves (with settle/handoff/compile sub-buckets)
+#: and teeing the profiler's handoff too would double-bill them.
+PHASE_TO_GOODPUT = {
+    "data_wait": "data_wait",
+    "h2d": "h2d",
+    "compute": "train_compute",
+}
+
+
 class StepProfiler:
     """Accumulate phase seconds into the CURRENT step, roll them into the
     window at `step_done()`. Thread-safe (heartbeat threads snapshot while
-    the train loop observes); the lock is a LEAF lock."""
+    the train loop observes); the lock is a LEAF lock.
 
-    def __init__(self, window: int = WINDOW_DEFAULT):
+    `ledger` (a goodput.GoodputLedger) receives a tee of every phase add
+    through PHASE_TO_GOODPUT — the goodput ledger's train/data/h2d
+    attribution costs no second timer on the hot path. The process
+    singleton (`get_profiler`) wires the process ledger; direct
+    constructions opt in explicitly (bench.py's obs_overhead ON leg
+    does, so the tee's cost stays inside the measured <=2% gate)."""
+
+    def __init__(self, window: int = WINDOW_DEFAULT, ledger=None):
+        self._ledger = ledger
         self._lock = threading.Lock()
         self._acc: Dict[str, float] = {}                 # guarded_by: _lock
         # per-phase rolling windows with maintained sums (mean is O(1))
@@ -87,6 +106,10 @@ class StepProfiler:
             return
         with self._lock:
             self._acc[phase] = self._acc.get(phase, 0.0) + seconds
+        if self._ledger is not None:
+            category = PHASE_TO_GOODPUT.get(phase)
+            if category is not None:
+                self._ledger.add(category, seconds)
 
     @contextmanager
     def phase(self, name: str) -> Iterator[None]:
@@ -227,7 +250,11 @@ def get_profiler() -> StepProfiler:
     global _PROFILER
     with _PROFILER_LOCK:
         if _PROFILER is None:
-            _PROFILER = StepProfiler()
+            from elasticdl_tpu.observability import goodput
+
+            # the process profiler tees phase adds into the process
+            # goodput ledger: one instrumentation site, two consumers
+            _PROFILER = StepProfiler(ledger=goodput.get_ledger())
         return _PROFILER
 
 

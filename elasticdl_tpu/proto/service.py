@@ -660,8 +660,11 @@ def register_with_retry(
     "0 disables") — retry until ``shutdown`` fires, never give up on the
     master. Shared by worker.py and cohort.py so the handshake cannot
     diverge between the two worker flavors."""
+    from elasticdl_tpu.observability import goodput as goodput_lib
+
     deadline = (time.monotonic() + window_s) if window_s > 0 else None
     attempt = 0
+    ledger = goodput_lib.get_ledger()
     while True:
         request = pb.RegisterWorkerRequest(
             worker_name=name,
@@ -685,7 +688,10 @@ def register_with_retry(
                 "%s boot registration failed (attempt %d): %s; retrying",
                 what, attempt, e,
             )
-            shutdown.wait(random.uniform(0.5, 1.5))
+            # goodput: riding out a down/restarting master is the
+            # `reconnect` category (the generation-fence window)
+            with ledger.phase("reconnect"):
+                shutdown.wait(random.uniform(0.5, 1.5))
             if shutdown.is_set():
                 raise
 
@@ -700,15 +706,20 @@ def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int,
     a fresh join (no membership-version bump for a live worker, so the
     cohort does not re-form). Callers apply the response to their own
     state; shared by worker.py and cohort.py."""
+    from elasticdl_tpu.observability import goodput as goodput_lib
+
     stub.generation = None
-    return stub.RegisterWorker(
-        pb.RegisterWorkerRequest(
-            worker_name=name, preferred_id_plus_one=worker_id + 1,
-            member_names=list(member_names),
-        ),
-        timeout=30,
-        metadata=((REREGISTER_KEY, "1"),),
-    )
+    # goodput: the re-register handshake is `reconnect` time — part of
+    # the master-restart bill the fleet ledger totals
+    with goodput_lib.get_ledger().phase("reconnect"):
+        return stub.RegisterWorker(
+            pb.RegisterWorkerRequest(
+                worker_name=name, preferred_id_plus_one=worker_id + 1,
+                member_names=list(member_names),
+            ),
+            timeout=30,
+            metadata=((REREGISTER_KEY, "1"),),
+        )
 
 
 def _is_deadline_exceeded(e: BaseException) -> bool:

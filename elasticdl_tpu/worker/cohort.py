@@ -48,6 +48,7 @@ from elasticdl_tpu.common.constants import ExitCode
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import flight as flight_lib
+from elasticdl_tpu.observability import goodput as goodput_lib
 from elasticdl_tpu.observability import profile as profile_lib
 from elasticdl_tpu.observability.health import (
     STATS_METADATA_KEY,
@@ -418,6 +419,10 @@ class CohortWorker:
         # per-step phase breakdown + memory watermarks (the leader's own;
         # follower profiles ride their MemberBeats via the exchange)
         stats.update(profile_lib.get_profiler().snapshot())
+        # goodput ledger ride-along (ISSUE 12): the leader's own
+        # wall-clock attribution (followers' ledgers stay process-local;
+        # their training phases ride the member-stats exchange)
+        stats.update(goodput_lib.get_ledger().payload())
         # embedding-tier skew ride-along (ISSUE 11; see worker.py's
         # _stats_payload) — best-effort, never costs the heartbeat
         if self._tier is not None:
@@ -1175,11 +1180,14 @@ class CohortWorker:
             role=role, port=self.cfg.metrics_port
         )
         try:
+            # goodput: a (re-)forming world's formation + build time IS
+            # the cohort flavor's rescale cost — settle (rendezvous) and
+            # compile (trainer construction against the warm cache)
             with tracing.span(
                 "cohort.world_form", trace_id=reform_tid,
                 num_processes=self.ctx.num_processes,
                 process_id=self.ctx.process_id,
-            ):
+            ), goodput_lib.get_ledger().phase("rescale", sub="settle"):
                 self.ctx.initialize()
         except Exception:
             logger.exception(
@@ -1195,7 +1203,8 @@ class CohortWorker:
             return ExitCode.WORLD_FORM_FAILED
         self._install_sigterm_drain()
         try:
-            with tracing.span("cohort.build", trace_id=reform_tid):
+            with tracing.span("cohort.build", trace_id=reform_tid), \
+                    goodput_lib.get_ledger().phase("rescale", sub="compile"):
                 self._build()
             if self.ctx.is_leader:
                 # the register RPC carries the reform trace id (when this
@@ -1218,10 +1227,13 @@ class CohortWorker:
                 op = ctrl[0]
                 if op == OP_NOOP:
                     # jittered on the LEADER only (followers just follow
-                    # the broadcast), so idle cohorts de-phase their polls
-                    time.sleep(
-                        jittered(backoff) if self.ctx.is_leader else backoff
-                    )
+                    # the broadcast), so idle cohorts de-phase their
+                    # polls. Goodput: idle-with-no-task is `lease_wait`.
+                    with goodput_lib.get_ledger().phase("lease_wait"):
+                        time.sleep(
+                            jittered(backoff) if self.ctx.is_leader
+                            else backoff
+                        )
                     continue
                 if op == OP_TASK:
                     self._run_task(ctrl)

@@ -25,6 +25,7 @@ from elasticdl_tpu.common.constants import WorkerEnv
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import flight as flight_lib
+from elasticdl_tpu.observability import goodput as goodput_lib
 from elasticdl_tpu.observability import profile as profile_lib
 from elasticdl_tpu.observability import timeseries as timeseries_lib
 from elasticdl_tpu.observability import tracing
@@ -453,6 +454,10 @@ class Worker:
         # step-profiler phase breakdown + memory watermarks (bounded key
         # set): the master's ClusterHealth sees WHY a straggler is slow
         stats.update(profile_lib.get_profiler().snapshot())
+        # goodput ledger ride-along (ISSUE 12): cumulative per-category
+        # wall-clock attribution (gp_* keys) — the master's FleetGoodput
+        # rollup totals these into the fleet goodput fraction
+        stats.update(goodput_lib.get_ledger().payload())
         # embedding-tier skew ride-along (ISSUE 11): hot-id share, shard
         # imbalance, recent pull/push p99 — the fleet rollup's sensor for
         # the hot-row-cache decision. Best-effort like the rest of the
@@ -608,6 +613,12 @@ class Worker:
         # rescale starts its own trace
         announced_tid = membership_signal.trace_id()
         try:
+            # goodput: every second of the rescale lands in the `rescale`
+            # category, sub-bucketed settle/compile/handoff to mirror the
+            # resize trace's phase vocabulary (the profiler's handoff
+            # phase is deliberately NOT teed into the ledger — these
+            # explicit adds are the one billing site)
+            ledger = goodput_lib.get_ledger()
             with tracing.span(
                 "rescale", trace_id=announced_tid,
                 mid_task=not reset_services,
@@ -615,15 +626,18 @@ class Worker:
                 # build everything fallible FIRST, swap worker state LAST: a
                 # failed construction must leave the old mesh/trainer/state
                 # fully intact
-                with tracing.span("rescale.mesh"):
+                with tracing.span("rescale.mesh"), \
+                        ledger.phase("rescale", sub="settle"):
                     new_mesh = build_mesh(axis_sizes, devices)
-                with tracing.span("rescale.compile"):
+                with tracing.span("rescale.compile"), \
+                        ledger.phase("rescale", sub="compile"):
                     # construction resolves the executable cache; an actual
                     # re-trace (cache miss) is deferred to the first step
                     new_trainer = self._make_trainer(new_mesh)
                 new_state = self._state
                 if new_state is not None:
-                    with tracing.span("rescale.handoff"):
+                    with tracing.span("rescale.handoff"), \
+                            ledger.phase("rescale", sub="handoff"):
                         handoff = elastic.LiveStateHandoff().capture(
                             new_state
                         )
@@ -1122,8 +1136,11 @@ class Worker:
                         break
                     # jittered: a cohort of relaunched workers retrying a
                     # recovering master on the same constant beat is a
-                    # thundering herd (edl-lint EDL304)
-                    time.sleep(jittered(2))
+                    # thundering herd (edl-lint EDL304). Goodput: time
+                    # spent riding out an unreachable master is the
+                    # `reconnect` category.
+                    with goodput_lib.get_ledger().phase("reconnect"):
+                        time.sleep(jittered(2))
                     continue
                 if resp.job_done:
                     logger.info("job done after %d tasks", tasks_done)
@@ -1175,8 +1192,11 @@ class Worker:
                     logger.exception("embedding tier refresh failed")
             if task.type == pb.WAIT:
                 # jittered so an idle swarm does not re-poll in phase
-                # (epoch boundaries unblock every worker at once)
-                time.sleep(jittered(wait_backoff))
+                # (epoch boundaries unblock every worker at once).
+                # Goodput: idle-with-no-task is the `lease_wait` category
+                # — the autoscaler's shrink signal.
+                with goodput_lib.get_ledger().phase("lease_wait"):
+                    time.sleep(jittered(wait_backoff))
                 continue
 
             report = pb.ReportTaskResultRequest(

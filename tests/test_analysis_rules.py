@@ -1145,7 +1145,7 @@ def test_cli_list_rules(capsys):
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
                 "EDL206", "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
-                "EDL401", "EDL402", "EDL403", "EDL404", "EDL405"):
+                "EDL401", "EDL402", "EDL403", "EDL404", "EDL405", "EDL406"):
         assert rid in out
 
 
@@ -1334,3 +1334,104 @@ def test_tier_per_shard_gauge_carries_the_reviewed_disable():
 
     src = open(tmod.__file__, encoding="utf-8").read()
     assert "edl-lint: disable=EDL405" in src
+
+
+# ------------------------------------------------------------------ #
+# EDL406 wall-clock-duration-measurement
+
+
+EDL406_BAD = """
+    import time
+    from time import time as now
+
+    def measure_call_minus_local():
+        t0 = time.time()
+        work()
+        return time.time() - t0
+
+    def measure_two_locals():
+        a = now()
+        work()
+        b = now()
+        return b - a
+
+    MODULE_T0 = time.time()
+    MODULE_ELAPSED = time.time() - MODULE_T0
+"""
+
+EDL406_GOOD = """
+    import time
+
+    def staleness(rec):
+        # epoch arithmetic against a STORED stamp (another process's
+        # updated_at): not a local-local delta, out of scope by design
+        now = time.time()
+        return now - rec["updated_at"]
+
+    def deadline_math(timeout_s):
+        # deadline = wall + timeout is a stamp, not a duration; the
+        # conservative tracker only follows X = time.time() directly
+        deadline = time.time() + timeout_s
+        return deadline - 1.0
+
+    def monotonic_duration():
+        t0 = time.monotonic()
+        work()
+        return time.monotonic() - t0
+
+    def perf_duration():
+        t0 = time.perf_counter()
+        work()
+        return time.perf_counter() - t0
+
+    def closure_is_its_own_scope():
+        t0 = time.time()
+
+        def inner(x):
+            return x - t0      # name from an enclosing scope: untracked
+        return inner
+"""
+
+
+def test_wall_clock_duration_fires_on_time_time_deltas():
+    fs = findings_for(EDL406_BAD, select={"EDL406"})
+    assert rule_ids(fs) == ["EDL406"]
+    assert len(fs) == 3
+    assert all("NTP step" in f.message for f in fs)
+
+
+def test_wall_clock_duration_quiet_on_epoch_and_monotonic_shapes():
+    assert findings_for(EDL406_GOOD, select={"EDL406"}) == []
+
+
+def test_wall_clock_duration_suppressible_with_justification():
+    src = """
+        import time
+
+        def sample_interval(last_wall_ts):
+            now = time.time()
+            t0 = time.time()
+            # cross-restart cadence vs a PERSISTED wall stamp — epoch
+            # arithmetic intended: edl-lint: disable=EDL406
+            return now - t0
+    """
+    assert findings_for(src, select={"EDL406"}) == []
+    undisabled = src.replace(
+        "            # cross-restart cadence vs a PERSISTED wall stamp "
+        "— epoch\n"
+        "            # arithmetic intended: edl-lint: disable=EDL406\n",
+        "",
+    )
+    assert undisabled != src
+    fs = findings_for(undisabled, select={"EDL406"})
+    assert rule_ids(fs) == ["EDL406"]
+
+
+def test_tree_measures_durations_monotonically():
+    # the one historical true positive (process_manager's reform timer)
+    # must stay fixed: no time.time() deltas anywhere in the package
+    # (the lint gate enforces it; this pins the reform site explicitly)
+    import elasticdl_tpu.master.process_manager as pm
+
+    src = open(pm.__file__, encoding="utf-8").read()
+    assert "_REFORM_S.observe(time.monotonic() - t0)" in src

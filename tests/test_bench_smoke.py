@@ -210,6 +210,55 @@ def test_embedding_tier_leg_smoke(bench, monkeypatch, tmp_path):
     assert alert_entries[0]["rule"] == al["raised"]
 
 
+def test_goodput_leg_smoke(bench, monkeypatch, tmp_path):
+    """The fleet goodput scenario (ISSUE 12 acceptance): per-worker
+    category seconds sum to measured wall clock within 1%, the injected
+    straggler lands in train_compute, the kill-worker rescale books
+    nonzero rescale seconds on survivors AND nonzero worker_died wasted
+    records for the requeued lease, the journal replays the whole bill
+    identically, and the incident CLI reads the artifacts --strict-clean
+    with the wasted-record total in its summary."""
+    art = str(tmp_path / "art")
+    monkeypatch.setenv("EDL_BENCH_ARTIFACT_DIR", art)
+    monkeypatch.setattr(bench, "GP_TASKS", 12)
+    res = bench.bench_goodput()
+    assert res["attribution_within_1pct"] is True, res
+    assert res["attribution_worst_error_pct"] <= 1.0
+    for row in res["per_worker"].values():
+        assert row["overattributed_s"] == 0.0, row
+        cats = row["categories"]
+        assert set(cats) == {
+            "train_compute", "data_wait", "h2d", "emb_pull_blocked",
+            "rescale", "lease_wait", "reconnect", "overhead",
+        }
+    assert res["straggler_in_compute_bucket"] is True, res
+    assert res["rescale_booked_on_survivors"] is True
+    assert res["rescale_seconds_min_survivor"] > 0
+    surv = res["per_worker"][f"worker{res['straggler_worker']}"]
+    assert surv["rescale_phases"]["handoff"] > 0
+    assert surv["rescale_phases"]["compile"] > 0
+    # the wasted-work bill: the abandoned lease re-trains (worker_died)
+    # and the ghost report is rejected into the stale_report bucket
+    assert res["wasted_from_requeued_lease"] is True
+    assert res["wasted"]["by_reason"]["worker_died"]["records"] > 0
+    assert res["ghost_report_rejected"] is True
+    assert res["wasted_journal_consistent"] is True, res["wasted"]
+    assert 0.0 < res["fleet_goodput_fraction"] < 1.0
+    # artifacts + the incident CLI pass the CI job runs
+    names = sorted(os.listdir(art))
+    assert "bench-goodput-ledgers.json" in names
+    assert "bench-goodput-journal.jsonl" in names
+    assert "bench-goodput.health.json" in names
+    from elasticdl_tpu.observability import incident
+
+    assert incident.main([art, "--strict"]) == 0
+    report = incident.correlate([art])
+    gp = report["goodput"]
+    assert gp["wasted_records"] == res["wasted"]["wasted_records"]
+    assert gp["fleet_goodput_fraction"] == res["fleet_goodput_fraction"]
+    assert gp["non_productive_worker_seconds"] > 0
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)
@@ -314,7 +363,8 @@ def test_checked_in_baselines_compare_clean_against_themselves(bench):
     bdir = os.path.join(repo, "bench-baselines")
     names = sorted(os.listdir(bdir))
     assert {"bench-control-plane.json", "bench-embedding-tier.json",
-            "bench-obs-overhead.json", "bench-rescale.json"} <= set(names)
+            "bench-goodput.json", "bench-obs-overhead.json",
+            "bench-rescale.json"} <= set(names)
     for name in names:
         if not name.endswith(".json"):
             continue
@@ -323,3 +373,36 @@ def test_checked_in_baselines_compare_clean_against_themselves(bench):
         report = bench.bench_compare(doc, doc, threshold_pct=30)
         assert report["regressions"] == [], (name, report["regressions"])
         assert report["compared"], name   # something is actually gated
+
+
+def test_bench_compare_new_leg_is_a_note_not_a_failure(bench, tmp_path,
+                                                       capsys):
+    """ISSUE 12 satellite: a CURRENT record carrying a whole leg the
+    prior baseline lacks (new leg added since the baseline was cut) must
+    exit 0 with a "new metric, no baseline" note — never a structural
+    failure. (The inverse — a BASELINE leg missing from current — stays
+    a regression.)"""
+    import json as _json
+
+    base = {"rescale": {"recovery_speedup": 20.0, "ok": True}}
+    cur = {"rescale": {"recovery_speedup": 21.0, "ok": True},
+           "goodput": {"fleet_goodput_fraction": 0.5,
+                       "attribution_within_1pct": True}}
+    report = bench.bench_compare(base, cur, threshold_pct=30)
+    assert report["regressions"] == []
+    assert [n["path"] for n in report["new_metrics"]] == [
+        "goodput.fleet_goodput_fraction"]
+    assert all(n["note"] == "new metric, no baseline"
+               for n in report["new_metrics"])
+    # through the CLI: exit 0, note on stderr
+    b, c = tmp_path / "b.json", tmp_path / "c.json"
+    b.write_text(_json.dumps(base))
+    c.write_text(_json.dumps(cur))
+    assert bench._compare_cli([str(b), str(c)]) == 0
+    err = capsys.readouterr().err
+    assert "new metric, no baseline" in err
+    # a baseline-True boolean in the NEW leg of current is not gated
+    # (nothing to compare against) — but dropping a baseline leg fails
+    report = bench.bench_compare(cur, base, threshold_pct=30)
+    assert any(r["path"].startswith("goodput.")
+               for r in report["regressions"])

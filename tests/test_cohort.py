@@ -11,6 +11,7 @@ import time
 import pytest
 
 from elasticdl_tpu.client.local import free_port
+from tests.conftest import requires_multiprocess_backend
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.master.main import Master
 from elasticdl_tpu.master.process_manager import ProcessManager
@@ -114,7 +115,9 @@ def test_cohort_grouped_dispatch_end_to_end(tmp_path):
     assert "distributed world v0 up: process 1/2" in log
 
 
-@pytest.mark.parametrize("num_processes", [1, 2])
+@pytest.mark.parametrize("num_processes", [
+    1, pytest.param(2, marks=requires_multiprocess_backend),
+])
 def test_master_lr_push_applies(tmp_path, num_processes):
     """ReduceLROnPlateau's transport, end-to-end in both worker flavors:
     the master sets an LR override; a heartbeat carries it to the worker
@@ -190,6 +193,7 @@ def test_cohort_prediction_job(tmp_path, num_processes, steps_per_dispatch):
     assert total == 512  # every record predicted exactly once, none padded
 
 
+@requires_multiprocess_backend
 def test_cohort_member_kill_relaunches_and_resumes(tmp_path):
     cfg = job_config(
         tmp_path,
@@ -356,6 +360,7 @@ def test_cohort_aborts_itself_when_master_vanishes(tmp_path):
         manager.stop()
 
 
+@requires_multiprocess_backend
 def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
     """Dynamic world resizing, scale-in: a member dies with the relaunch
     budget already spent — instead of stalling/failing, the cohort re-forms
@@ -418,6 +423,7 @@ def test_cohort_resizes_down_at_exhausted_budget(tmp_path):
     )
 
 
+@requires_multiprocess_backend
 def test_cohort_scales_up_on_add_worker(tmp_path):
     """Dynamic world resizing, scale-out: add_worker mid-job re-forms the
     cohort at N+1 (fresh coordinator, new world version, checkpoint restore)
@@ -450,6 +456,7 @@ def test_cohort_scales_up_on_add_worker(tmp_path):
     assert "up: process 2/3" in log  # the third member joined the new world
 
 
+@requires_multiprocess_backend
 def test_cohort_remove_worker_quiesces_then_resizes(tmp_path):
     """Operator scale-in (round-3, VERDICT #7): remove_worker triggers a
     PRE-TEARDOWN checkpoint (via the heartbeat should_checkpoint bit +

@@ -27,6 +27,10 @@ AUC_TARGET = 0.70   # the learnable synthetic stream passes 0.75 quickly;
                     # 0.70 keeps the assert robust to the short run
 
 
+from tests.conftest import requires_multiprocess_backend
+
+
+@requires_multiprocess_backend
 def test_elastic_time_to_auc_survives_two_kills(tmp_path):
     n_tasks = 8
     cfg = JobConfig(

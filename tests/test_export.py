@@ -7,6 +7,8 @@ where Embedding→keras export had to reproduce the PS table contents exactly.
 import numpy as np
 import pytest
 
+from tests.conftest import requires_spmd_partitioning
+
 from elasticdl_tpu.common.config import JobConfig
 from elasticdl_tpu.training.export import (
     export_model,
@@ -146,7 +148,8 @@ def test_saved_model_export(trained, tmp_path):
 
 @pytest.mark.parametrize("params", [
     {"tp_axis": "model"},
-    {"pp_axis": "pp", "num_layers": 4},
+    pytest.param({"pp_axis": "pp", "num_layers": 4},
+                 marks=requires_spmd_partitioning),
 ])
 def test_export_roundtrip_tp_and_pp_lm(params, tmp_path):
     """Serving completeness for the parallel LM variants: a TP- or

@@ -1,0 +1,510 @@
+"""Fleet goodput ledger (ISSUE 12): per-process wall-clock attribution
+with the total-sum invariant, the dispatcher's journal-durable wasted-work
+ledger, the master-side fleet rollup, and the /goodput + GET / surfaces."""
+
+import json
+import urllib.request
+
+import pytest
+
+from elasticdl_tpu.master.journal import ControlPlaneJournal, replay_lines
+from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+from elasticdl_tpu.observability import goodput
+from elasticdl_tpu.observability import profile as profile_lib
+from elasticdl_tpu.observability.goodput import (
+    CATEGORIES,
+    FleetGoodput,
+    GoodputLedger,
+    aggregate_payloads,
+)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------------- #
+# GoodputLedger
+
+
+def test_ledger_attributes_and_overhead_is_residual():
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    led.add("train_compute", 3.0)
+    led.add("data_wait", 1.0)
+    led.add("lease_wait", 0.5)
+    clock.advance(10.0)
+    snap = led.snapshot()
+    assert snap["wall_s"] == 10.0
+    cats = snap["categories"]
+    assert cats["train_compute"] == 3.0
+    assert cats["data_wait"] == 1.0
+    assert cats["lease_wait"] == 0.5
+    # the invariant: categories ALWAYS sum to wall clock
+    assert sum(cats.values()) == pytest.approx(10.0)
+    assert cats["overhead"] == pytest.approx(5.5)
+    assert snap["overattributed_s"] == 0.0
+    assert snap["goodput_fraction"] == pytest.approx(0.3)
+
+
+def test_ledger_overattribution_is_surfaced_not_hidden():
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    led.add("train_compute", 4.0)
+    clock.advance(2.0)     # attributed more than elapsed: a double-bill
+    snap = led.snapshot()
+    assert snap["categories"]["overhead"] == 0.0   # clamped, not negative
+    assert snap["overattributed_s"] == pytest.approx(2.0)
+
+
+def test_ledger_rescale_subbuckets_and_unknown_categories():
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    led.add("rescale", 1.0, sub="settle")
+    led.add("rescale", 2.0, sub="compile")
+    led.add("rescale", 0.5)                 # no sub: top-level only
+    led.add("nonsense_category", 9.0)       # dropped: vocabulary is schema
+    led.add("overhead", 9.0)                # never added directly
+    clock.advance(5.0)
+    snap = led.snapshot()
+    assert snap["categories"]["rescale"] == pytest.approx(3.5)
+    assert snap["rescale_phases"] == {
+        "settle": 1.0, "handoff": 0.0, "compile": 2.0}
+    assert sum(snap["categories"].values()) == pytest.approx(5.0)
+
+
+def test_ledger_phase_context_and_payload_shape():
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    with led.phase("lease_wait"):
+        clock.advance(2.0)
+    clock.advance(1.0)
+    payload = led.payload(now=clock())
+    assert payload["gp_wall_s"] == 3.0
+    assert payload["gp_lease_wait_s"] == 2.0
+    assert payload["gp_overhead_s"] == 1.0
+    # zero categories stay OFF the wire (payload budget)
+    assert "gp_train_compute_s" not in payload
+    assert all(k.startswith("gp_") for k in payload)
+
+
+def test_profiler_tees_into_ledger_but_not_handoff():
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    prof = profile_lib.StepProfiler(ledger=led)
+    prof.add("data_wait", 1.0)
+    prof.add("h2d", 0.25)
+    prof.add("compute", 2.0)
+    prof.add("handoff", 5.0)    # billed at the rescale sites, NOT teed
+    prof.step_done()
+    clock.advance(4.0)
+    cats = led.snapshot()["categories"]
+    assert cats["data_wait"] == 1.0
+    assert cats["h2d"] == 0.25
+    assert cats["train_compute"] == 2.0
+    assert cats["rescale"] == 0.0
+    # the profiler's own window still carries handoff
+    assert prof.snapshot()["phase_handoff_ms"] > 0
+
+
+# ---------------------------------------------------------------------- #
+# fleet aggregation
+
+
+def _record(now, wall=10.0, train=4.0, updated_age=0.0, **extra):
+    rec = {"updated_at": now - updated_age, "gp_wall_s": wall,
+           "gp_train_compute_s": train}
+    rec.update(extra)
+    return rec
+
+
+def test_aggregate_payloads_sums_fresh_reporters_only():
+    now = 1000.0
+    records = [
+        _record(now, wall=10.0, train=4.0, gp_lease_wait_s=1.0),
+        _record(now, wall=20.0, train=16.0),
+        _record(now, wall=99.0, train=99.0, updated_age=120.0),  # stale
+        {"updated_at": now, "gp_wall_s": "garbage"},             # no ledger
+    ]
+    fleet = aggregate_payloads(records, now=now)
+    assert fleet["reporters"] == 2
+    assert fleet["wall_s"] == 30.0
+    assert fleet["categories"]["train_compute"] == 20.0
+    assert fleet["categories"]["lease_wait"] == 1.0
+    assert fleet["goodput_fraction"] == pytest.approx(20.0 / 30.0)
+    assert set(fleet["categories"]) == set(CATEGORIES)
+
+
+def test_aggregate_payloads_no_reporters_reads_as_no_data():
+    assert aggregate_payloads([], now=0.0) == {}
+    # a fleet with records but no ledgers is no-data too (absence must
+    # not read as zero goodput to the alert rules)
+    assert aggregate_payloads([{"updated_at": 0.0}], now=0.0) == {}
+
+
+class _StubMembership:
+    def __init__(self, records):
+        self.records = records
+
+    def health_snapshot(self):
+        return self.records
+
+
+def test_fleet_goodput_rollup_and_series(tmp_path):
+    import time as _time
+
+    now = _time.time()
+    dispatcher = TaskDispatcher(
+        training_shards=[("s", 0, 100)], records_per_task=100,
+        shuffle=False)
+    t = dispatcher.get(1)
+    dispatcher.report(t.task_id, 1, success=True)
+    fg = FleetGoodput(
+        _StubMembership([_record(now, wall=10.0, train=5.0)]), dispatcher)
+    snap = fg.update(now=now)
+    assert snap["fleet"]["goodput_fraction"] == 0.5
+    assert snap["wasted"]["records_completed"] == 100
+    assert snap["wasted"]["wasted_records"] == 0
+    # series() carries ONLY the windowed values (cumulative ones ride
+    # the registry gauges into the same sample — no double bookkeeping),
+    # and the windowed ones need two rollups (per-interval deltas)
+    assert fg.series() == {}
+    from elasticdl_tpu.observability.registry import default_registry
+
+    prom = default_registry().render_prometheus()
+    assert "edl_goodput_fleet_fraction 0.5" in prom
+    # the windowed series deliberately have NO gauge: absence must read
+    # as no-data, and a never-set/stale gauge would read as 0/frozen
+    assert "edl_goodput_fleet_recent_fraction" not in prom
+    fg._membership = _StubMembership(
+        [_record(now + 5, wall=20.0, train=14.0)])
+    fg.update(now=now + 5)
+    series = fg.series()
+    # delta train 9 / delta wall 10 — the last interval, not lifetime
+    assert series["edl_goodput_fleet_recent_fraction"] == pytest.approx(
+        0.9)
+    assert series["edl_goodput_recent_wasted_ratio"] == 0.0
+    # reporter churn (cumulative sums going backwards) SKIPS the recent
+    # sample instead of emitting garbage
+    fg._membership = _StubMembership(
+        [_record(now + 10, wall=3.0, train=1.0)])
+    snap = fg.update(now=now + 10)
+    assert "recent_fraction" not in snap["fleet"]
+    # ...and the sampler extra goes dark too — a true data gap, which
+    # the rules read as no-data (active alerts carry forward)
+    assert "edl_goodput_fleet_recent_fraction" not in fg.series()
+
+
+def test_fleet_goodput_never_raises():
+    class Broken:
+        def health_snapshot(self):
+            raise RuntimeError("boom")
+
+    fg = FleetGoodput(Broken(), None)
+    snap = fg.update()
+    assert isinstance(snap, dict)
+    assert fg.series() == {}
+
+
+# ---------------------------------------------------------------------- #
+# dispatcher wasted-work ledger (journal-durable)
+
+
+def _mkdispatcher(tmp_path, n_records=400, per_task=100, timeout=600.0):
+    journal = ControlPlaneJournal(str(tmp_path))
+    d = TaskDispatcher(
+        training_shards=[("s", 0, n_records)], records_per_task=per_task,
+        shuffle=False, task_timeout_s=timeout, journal=journal,
+    )
+    return d, journal
+
+
+def test_worker_death_bills_wasted_records(tmp_path):
+    d, journal = _mkdispatcher(tmp_path)
+    t = d.get(7)
+    assert t is not None
+    assert d.recover_tasks(7) == 1
+    w = d.wasted_work()
+    assert w["wasted_records"] == t.num_records
+    assert w["by_reason"]["worker_died"] == {
+        "events": 1, "records": t.num_records}
+    # the bill survives a restart: replay the journal file
+    journal.close()
+    with open(journal.path, encoding="utf-8") as f:
+        replayed = replay_lines(f.readlines()).dispatcher
+    assert replayed.wasted_records == w["wasted_records"]
+    assert replayed.wasted_by_reason == w["by_reason"]
+
+
+def test_lease_expiry_and_failure_retry_bill_wasted(tmp_path):
+    d, journal = _mkdispatcher(tmp_path, timeout=0.0)
+    t = d.get(1)
+    # timeout 0: the next queue pass reaps the lease -> lease_expired
+    d.poke()
+    w = d.wasted_work()
+    assert w["by_reason"]["lease_expired"]["records"] == t.num_records
+    # a failed report requeues with the failure_retry reason
+    d2 = TaskDispatcher(
+        training_shards=[("s", 0, 100)], records_per_task=100,
+        shuffle=False)
+    t2 = d2.get(1)
+    d2.report(t2.task_id, 1, success=False, err="boom")
+    assert d2.wasted_work()["by_reason"]["failure_retry"]["records"] == 100
+    journal.close()
+
+
+def test_stale_report_and_fenced_report_are_evidence_buckets(tmp_path):
+    d, journal = _mkdispatcher(tmp_path)
+    t = d.get(1)
+    d.recover_tasks(1)
+    # the ghost report: rejected AND billed with the claimed records
+    assert d.report(t.task_id, 1, success=True,
+                    records_processed=t.num_records) is False
+    # the servicer's fence hook: bills a credible claim once, clamped
+    d.note_fenced_report(t.task_id, 55)
+    d.note_fenced_report(t.task_id, 55)        # retry: billed ONCE
+    d.note_fenced_report(999999, 10**9)        # unresolvable: unbilled
+    d.note_fenced_report(t.task_id, 0)         # empty claim: unbilled
+    w = d.wasted_work()
+    assert w["by_reason"]["stale_report"]["records"] == t.num_records
+    assert w["by_reason"]["fenced_report"] == {"events": 1, "records": 55}
+    journal.close()
+
+
+def test_stale_billing_requires_a_credible_claim(tmp_path):
+    """Review hardening: the stale_report bucket is evidence of FINISHED
+    work being discarded — a failed/empty stale report discards nothing,
+    and an unresolvable task id is unvalidated remote input. Neither may
+    inflate the wasted ratio (the wasted_work_ratio alert's input)."""
+    d, journal = _mkdispatcher(tmp_path)
+    t = d.get(1)
+    d.recover_tasks(1)
+    # failure report from the dead holder: no completed work claimed
+    assert d.report(t.task_id, 1, success=False, err="crash",
+                    records_processed=0) is False
+    # a task id the dispatcher has never seen, with a huge claim
+    assert d.report(999999, 1, success=True,
+                    records_processed=10**9) is False
+    w = d.wasted_work()
+    assert "stale_report" not in w["by_reason"], w
+    # a CREDIBLE ghost claim bills, clamped to the task's real span
+    assert d.report(t.task_id, 1, success=True,
+                    records_processed=10**9) is False
+    assert d.wasted_work()["by_reason"]["stale_report"] == {
+        "events": 1, "records": t.num_records}
+    # a retry of the SAME rejected report bills once, not per attempt
+    assert d.report(t.task_id, 1, success=True,
+                    records_processed=t.num_records) is False
+    assert d.wasted_work()["by_reason"]["stale_report"]["events"] == 1
+    journal.close()
+
+
+def test_completed_records_counted_and_ratio(tmp_path):
+    d, journal = _mkdispatcher(tmp_path, n_records=200, per_task=100)
+    t1 = d.get(1)
+    d.report(t1.task_id, 1, success=True)
+    t2 = d.get(2)
+    d.recover_tasks(2)
+    w = d.wasted_work()
+    assert w["records_completed"] == 100
+    assert w["wasted_records"] == t2.num_records
+    assert w["wasted_ratio"] == pytest.approx(100 / 200)
+    journal.close()
+
+
+def test_crash_requeue_billed_once_across_restarts(tmp_path):
+    d, journal = _mkdispatcher(tmp_path)
+    leased = d.get(3)
+    journal.abort()   # SIGKILL shape: the lease is in flight on disk
+
+    # restart 1: the successor conservatively requeues the lease and
+    # journals the crash_requeue bill itself
+    j2 = ControlPlaneJournal(str(tmp_path))
+    d2 = TaskDispatcher(
+        training_shards=[("s", 0, 400)], records_per_task=100,
+        shuffle=False, journal=j2,
+    )
+    w2 = d2.wasted_work()
+    assert w2["by_reason"]["crash_requeue"] == {
+        "events": 1, "records": leased.num_records}
+    j2.close()
+
+    # restart 2 (clean close, nothing new in flight): the bill must NOT
+    # double — snapshot totals + appended records replay to the same sum
+    j3 = ControlPlaneJournal(str(tmp_path))
+    d3 = TaskDispatcher(
+        training_shards=[("s", 0, 400)], records_per_task=100,
+        shuffle=False, journal=j3,
+    )
+    assert d3.wasted_work()["by_reason"]["crash_requeue"] == {
+        "events": 1, "records": leased.num_records}
+    assert d3.wasted_work()["wasted_records"] == leased.num_records
+    j3.close()
+
+
+def test_drain_requeue_remainder_and_completed_parity(tmp_path):
+    d, journal = _mkdispatcher(tmp_path, n_records=100, per_task=100)
+    t = d.get(1)
+    # preemption drain: 40 records retired, remainder requeued
+    assert d.report(t.task_id, 1, success=False, preempted=True,
+                    records_processed=40) is True
+    w = d.wasted_work()
+    assert w["records_completed"] == 40
+    assert w["by_reason"]["drain_requeue"]["events"] == 1
+    journal.close()
+    with open(journal.path, encoding="utf-8") as f:
+        replayed = replay_lines(f.readlines()).dispatcher
+    assert replayed.records_completed == 40
+    assert replayed.wasted_by_reason == w["by_reason"]
+    # the remainder is back on todo with the advanced start
+    assert replayed.todo[0]["start"] == 40
+
+
+# ---------------------------------------------------------------------- #
+# http surface
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_goodput_endpoint_and_index(tmp_path):
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    goodput.reset_for_tests()
+    profile_lib.reset_for_tests()
+    goodput.get_ledger().add("train_compute", 1.0)
+
+    fleet_doc = {"ts": 1.0, "fleet": {"goodput_fraction": 0.75}}
+    server = ObservabilityServer(
+        role="test", goodput_fn=lambda: fleet_doc)
+    try:
+        port = server.start()
+        # GET / : the endpoint index (ISSUE 12 satellite)
+        status, body = _get(port, "/")
+        assert status == 200
+        index = json.loads(body)
+        assert index["role"] == "test"
+        assert set(index["endpoints"]) == {
+            "/", "/metrics", "/healthz", "/timeseries", "/alerts",
+            "/goodput", "/debug/flight",
+        }
+        assert all(isinstance(v, str) and v
+                   for v in index["endpoints"].values())
+        # GET /goodput : process ledger + wired fleet rollup
+        status, body = _get(port, "/goodput")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["role"] == "test"
+        assert doc["ledger"]["categories"]["train_compute"] >= 1.0
+        # sum == wall once the surfaced overattribution is backed out
+        # (this test deliberately over-bills a fresh ledger)
+        assert (
+            sum(doc["ledger"]["categories"].values())
+            - doc["ledger"]["overattributed_s"]
+        ) == pytest.approx(doc["ledger"]["wall_s"], abs=1e-3)
+        assert doc["fleet"] == fleet_doc
+    finally:
+        server.stop()
+        goodput.reset_for_tests()
+        profile_lib.reset_for_tests()
+
+
+def test_goodput_endpoint_without_fleet_and_raising_fn():
+    from elasticdl_tpu.observability.http import ObservabilityServer
+
+    goodput.reset_for_tests()
+    server = ObservabilityServer(role="w")
+    try:
+        port = server.start()
+        status, body = _get(port, "/goodput")
+        doc = json.loads(body)
+        assert status == 200 and "fleet" not in doc
+
+        def boom():
+            raise RuntimeError("x")
+
+        server.goodput_fn = boom
+        status, body = _get(port, "/goodput")
+        doc = json.loads(body)
+        assert status == 200 and doc.get("fleet_error") is True
+        assert "ledger" in doc
+    finally:
+        server.stop()
+        goodput.reset_for_tests()
+
+
+# ---------------------------------------------------------------------- #
+# heartbeat ride-along + alert rules
+
+
+def test_payload_survives_the_heartbeat_codec():
+    from elasticdl_tpu.observability.health import decode_stats, encode_stats
+
+    clock = FakeClock()
+    led = GoodputLedger(clock=clock)
+    for cat in CATEGORIES:
+        if cat != "overhead":
+            led.add(cat, 1.0)
+    clock.advance(10.0)
+    payload = led.payload(now=clock())
+    # worst-case worker payload: step stats + control bits + profiler +
+    # emb skew + the full gp_* set must fit the key budget
+    base = {
+        "steps": 1, "step_p50_ms": 1.0, "step_p90_ms": 1.0,
+        "step_max_ms": 1.0, "records_per_s": 1.0, "phase": "train",
+        "breaker_open": 0, "prefetch_depth": 2, "world_version": 1,
+        "phase_data_wait_ms": 1.0, "phase_h2d_ms": 1.0,
+        "phase_compute_ms": 1.0, "phase_handoff_ms": 1.0,
+        "mem_host_mb": 1.0, "mem_dev_mb": 1.0, "profiled_steps": 1,
+        "emb_pull_p99_ms": 1.0, "emb_push_p99_ms": 1.0,
+        "emb_hot_id_share": 0.5, "emb_shard_imbalance": 1.0,
+    }
+    base.update(payload)
+    decoded = decode_stats(encode_stats(base))
+    assert decoded is not None
+    assert decoded["gp_wall_s"] == payload["gp_wall_s"]
+    assert decoded["gp_train_compute_s"] == 1.0
+
+
+def test_default_alert_rules_watch_the_goodput_series():
+    from elasticdl_tpu.observability.alerts import AlertEngine, default_rules
+    from elasticdl_tpu.observability.registry import MetricsRegistry
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+    rules = {r.name: r for r in default_rules()}
+    burn = rules["goodput_burn"]
+    # the rules watch the WINDOWED series (review finding: a lifetime-
+    # cumulative ratio dilutes — a mid-job stall could never fire it)
+    assert burn.series == "edl_goodput_fleet_recent_fraction"
+    assert burn.mode == "burn_rate" and burn.op == "<"
+    ratio = rules["wasted_work_ratio"]
+    assert ratio.series == "edl_goodput_recent_wasted_ratio"
+
+    # a sustained burn fires; the engine reads the same series the
+    # FleetGoodput sampler emits
+    store = TimeSeriesStore(interval_s=0.0, registry=MetricsRegistry())
+    engine = AlertEngine(store, rules=[rules["goodput_burn"]],
+                         flight_dump=lambda reason: None)
+    now = 1000.0
+    for i in range(110):
+        store.sample(now=now + 5 * i,
+                     extra={"edl_goodput_fleet_recent_fraction": 0.2})
+    # for_s=120 rides out boot compiles: the first bad evaluation only
+    # arms the hold timer...
+    snap = engine.evaluate(now=now + 400)
+    assert snap["active"] == []
+    # ...and the burn fires once the condition has held for_s
+    snap = engine.evaluate(now=now + 530)
+    assert [a["rule"] for a in snap["active"]] == ["goodput_burn"]

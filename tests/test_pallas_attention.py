@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import requires_spmd_partitioning
+
 from elasticdl_tpu.ops.attention import full_attention
 from elasticdl_tpu.ops.pallas_attention import (
     can_flash,
@@ -283,7 +285,9 @@ def test_flash_lse_value_and_gradient():
                                    atol=5e-5, rtol=5e-5)
 
 
-@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("causal", [
+    pytest.param(False, marks=requires_spmd_partitioning), True,
+])
 def test_ring_flash_matches_full_attention(monkeypatch, causal):
     """Ring attention with the flash block kernel (EDL_FLASH=1 +
     force_tpu_interpret_mode on the data x seq CPU mesh) must match

@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from tests.conftest import requires_spmd_partitioning
+
 from elasticdl_tpu.parallel.mesh import build_mesh
 from elasticdl_tpu.parallel.pipeline import gpipe, stage_partition_specs
 
@@ -32,7 +34,11 @@ def sequential(params, x):
     return x
 
 
-@pytest.mark.parametrize("mesh_axes", [{"pp": 4}, {"data": 2, "pp": 4}])
+@pytest.mark.parametrize("mesh_axes", [
+    {"pp": 4},
+    pytest.param({"data": 2, "pp": 4},
+                 marks=requires_spmd_partitioning),
+])
 @pytest.mark.usefixtures("mesh8")
 @pytest.mark.parametrize("num_microbatches", [1, 2, 4])
 def test_gpipe_matches_sequential_fwd_and_grad(mesh_axes, num_microbatches):

@@ -3,6 +3,11 @@ mesh, input partitioning honored end to end, loss falls on the synthetic
 bigram stream."""
 
 import numpy as np
+
+from tests.conftest import (
+    requires_spmd_partitioning,
+    requires_tp_exact_backend,
+)
 import pytest
 
 from elasticdl_tpu.common.config import JobConfig
@@ -126,6 +131,7 @@ def test_remat_accum_with_flash_kernel(reader, monkeypatch):
     assert knobs == pytest.approx(plain, rel=1e-4), (plain, knobs)
 
 
+@requires_tp_exact_backend
 def test_tensor_parallel_matches_replicated(reader):
     """Megatron-style TP (tp_axis=model): same seed, same batch, one train
     step — loss and (gathered) params must match the replicated run, with
@@ -199,6 +205,7 @@ def test_tensor_parallel_inserts_model_axis_collectives(reader):
     assert n_tp > n_base, (n_tp, n_base)
 
 
+@requires_spmd_partitioning
 def test_pipeline_parallel_lm_matches_no_pp_mesh(reader):
     """pp_axis=pp: the SAME module + params run pipelined on a data x pp
     mesh and sequentially on a data-only mesh (gpipe's fallback) — one
