@@ -52,6 +52,20 @@ EDL206 per-row-embedding-rpc-in-hot-loop
     matched by name (tier/client/emb/transport/store) so unrelated
     `.push` methods stay quiet; one batched call directly in the
     dispatch loop body is the sanctioned shape.
+
+EDL207 blocking-pull-with-pipeline-available
+    a blocking tier `.pull(...)`/`.pull_unique(...)` DIRECTLY in the
+    step-dispatch hot loop (EDL201/EDL206's hot-loop definition) while
+    a pull pipeline is available in the enclosing scope — a parameter
+    or binding named `*pipeline(s)`, or anything constructed from a
+    `*PullPipeline(...)` ctor. EDL206's sanctioned shape (one batched
+    call in the loop body) becomes the anti-pattern the moment the
+    overlap machinery is in hand: the blocking pull serializes the
+    owner RPC behind the step it could have hidden under. Route it
+    through `pipeline.submit()` ahead / `pipeline.get()` in the loop
+    (embedding/tier.EmbeddingPullPipeline, or
+    EmbeddingTierSession.run's windowed form). `.push` stays exempt —
+    writes are the step's own output and cannot be issued ahead.
 """
 
 from __future__ import annotations
@@ -337,10 +351,11 @@ _TIER_RECEIVER = re.compile(r"tier|client|emb|transport|store", re.IGNORECASE)
 
 
 def _tier_call(node: ast.AST) -> Optional[str]:
-    """'pull'/'push' when `node` is an embedding-tier data-plane call."""
+    """'pull'/'pull_unique'/'push' when `node` is an embedding-tier
+    data-plane call."""
     if not (isinstance(node, ast.Call)
             and isinstance(node.func, ast.Attribute)
-            and node.func.attr in ("pull", "push")):
+            and node.func.attr in ("pull", "pull_unique", "push")):
         return None
     recv = node.func.value
     names = []
@@ -416,6 +431,123 @@ class PerRowEmbeddingRpcRule(Rule):
                     "per row; dedupe the batch and issue one batched "
                     "call per shard (tier.EmbeddingTierClient does this)",
                 )
+
+
+#: a binding that makes the pull pipeline "available in scope":
+#: parameters/assignments named like the thing, or anything constructed
+#: from a *PullPipeline(...) ctor
+_PIPELINE_NAME = re.compile(r"(^|_)pipelines?$", re.IGNORECASE)
+_PIPELINE_CTOR = re.compile(r"PullPipeline")
+
+
+def _scope_nodes(scope: ast.AST) -> Iterator[ast.AST]:
+    """Walk one lexical scope: never descends into nested function
+    defs (they are their own scopes — a pipeline bound in a helper must
+    not police its caller)."""
+    stack = [scope]
+    while stack:
+        node = stack.pop()
+        if (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node is not scope):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _pipeline_in_scope(scope: ast.AST) -> bool:
+    if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        a = scope.args
+        params = (list(getattr(a, "posonlyargs", ())) + list(a.args)
+                  + list(a.kwonlyargs))
+        if a.vararg:
+            params.append(a.vararg)
+        if a.kwarg:
+            params.append(a.kwarg)
+        if any(_PIPELINE_NAME.search(p.arg) for p in params):
+            return True
+    for sub in _scope_nodes(scope):
+        if isinstance(sub, ast.Assign):
+            v = sub.value
+            if isinstance(v, ast.Call):
+                f = v.func
+                name = (f.attr if isinstance(f, ast.Attribute)
+                        else f.id if isinstance(f, ast.Name) else "")
+                if _PIPELINE_CTOR.search(name):
+                    return True
+            for t in sub.targets:
+                if isinstance(t, ast.Name) and _PIPELINE_NAME.search(t.id):
+                    return True
+    return False
+
+
+def _direct_body_calls(stmts) -> Iterator[ast.Call]:
+    """Calls at the loop's OWN depth: nested loops/comprehensions are
+    EDL206's territory, nested defs their own scope."""
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.For, ast.While, ast.ListComp,
+                             ast.SetComp, ast.DictComp, ast.GeneratorExp,
+                             ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class BlockingPullWithPipelineRule(Rule):
+    id = "EDL207"
+    name = "blocking-pull-with-pipeline-available"
+    doc = (
+        "blocking tier .pull/.pull_unique in the step-dispatch hot loop "
+        "while a pull pipeline is in scope — the owner RPC serializes "
+        "behind compute it could overlap; route it through "
+        "pipeline.submit()/get()"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        reported: Set[int] = set()
+        for scope in scopes:
+            if not _pipeline_in_scope(scope):
+                continue
+            for node in _scope_nodes(scope):
+                if not isinstance(node, (ast.For, ast.While)):
+                    continue
+                loop_body = list(node.body) + list(node.orelse)
+                called = set()
+                for stmt in loop_body:
+                    called |= _called_attr_names(stmt)
+                if not (called & _DISPATCH_METHODS):
+                    # shares EDL201/EDL206's hot-loop definition
+                    continue
+                if any(
+                    isinstance(n, (ast.For, ast.While))
+                    and _called_attr_names(n) & _DISPATCH_METHODS
+                    for stmt in loop_body for n in ast.walk(stmt)
+                ):
+                    # an INNER loop is the real dispatch loop (epoch
+                    # wrapper): scan at that depth (EDL206's scoping)
+                    continue
+                for cand in _direct_body_calls(loop_body):
+                    what = _tier_call(cand)
+                    if what in (None, "push") or id(cand) in reported:
+                        # pushes are the step's own OUTPUT — they cannot
+                        # be issued ahead of the compute that makes them
+                        continue
+                    reported.add(id(cand))
+                    yield self.finding(
+                        ctx, cand,
+                        f"blocking tier .{what}() in the step-dispatch "
+                        "hot loop while a pull pipeline is in scope: the "
+                        "owner RPC serializes behind compute it could "
+                        "overlap — submit() the next batch ahead and "
+                        "get() here (EmbeddingPullPipeline)",
+                    )
 
 
 def _is_set_expr(node: ast.AST) -> bool:

@@ -236,6 +236,30 @@ class JobConfig:
     # sizing". 0 = off (tables live in HBM inside the jitted step, the
     # default single-host path).
     embedding_shards: int = 0
+    # --- serving-grade embedding reads (ISSUE 13), three switchable
+    # layers on top of the tier (each independently attributable in
+    # `bench.py embedding_tier`):
+    # worker-local hot-row cache capacity in ROWS PER TABLE (0 = off).
+    # Size from the measured hot set: `hot_id_share` in tier_stats()
+    # says what fraction of pull traffic the sketch's top-K ids carry —
+    # see docs/performance.md "Embedding read path".
+    embedding_cache_rows: int = 0
+    # staleness bound in PUSH-WATERMARK units (shard pushes, not
+    # seconds): a cached row / replica answer more than this many
+    # applied pushes behind the observed owner watermark is refetched.
+    # 0 = always revalidate against the owner's watermark; larger
+    # trades convergence freshness for hit rate.
+    embedding_cache_staleness: int = 1
+    # read replicas per shard (0 = off): the master assigns and
+    # journal-commits replica owners next to primaries; replicas sync
+    # by watermark-tagged deltas, reads fan out to the least-loaded
+    # fresh-enough copy, writes stay primary-only, and a dead owner's
+    # shard promotes a surviving replica.
+    embedding_read_replicas: int = 0
+    # pull pipeline lookahead (0 = off): overlap the NEXT batch's
+    # deduped pull with the current step's compute; drained (batches
+    # re-issued) across rescale/reshard.
+    embedding_pull_pipeline: int = 0
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
@@ -314,6 +338,25 @@ class JobConfig:
             raise ValueError("task_lease_batch must be >= 1")
         if self.embedding_shards < 0:
             raise ValueError("embedding_shards must be >= 0 (0 = tier off)")
+        if self.embedding_cache_rows < 0:
+            raise ValueError(
+                "embedding_cache_rows must be >= 0 (0 = cache off)")
+        if self.embedding_cache_staleness < 0:
+            raise ValueError(
+                "embedding_cache_staleness must be >= 0 (watermark "
+                "units: pushes a cached row may lag the owner)")
+        if self.embedding_read_replicas < 0:
+            raise ValueError(
+                "embedding_read_replicas must be >= 0 (0 = no replicas)")
+        if self.embedding_pull_pipeline < 0:
+            raise ValueError(
+                "embedding_pull_pipeline must be >= 0 (0 = blocking "
+                "pulls)")
+        if (self.embedding_read_replicas > 0
+                and self.embedding_shards <= 0):
+            raise ValueError(
+                "embedding_read_replicas requires the tier "
+                "(embedding_shards > 0)")
         if self.flight_ring < 16:
             # a ring too small to hold even one incident's records would
             # silently produce useless bundles; fail at submit time

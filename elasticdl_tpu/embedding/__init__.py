@@ -20,17 +20,28 @@ shape as mesh rescale: shards migrate via `reshard.apply_moves`
 exactly-once update semantics fenced by shard-map version + master
 generation.
 
+The serving-grade READ path (ISSUE 13) stacks three switchable layers
+on the tier: a worker-local staleness-bounded hot-row cache fenced by
+per-shard push watermarks (`cache.HotRowCache`), journal-committed read
+replicas with watermark-delta sync and owner-death promotion, and a
+pull/compute overlap pipeline (`tier.EmbeddingPullPipeline`).
+
 See docs/architecture.md "Embedding tier" and docs/performance.md
-"Embedding tier sizing".
+"Embedding tier sizing" / "Embedding read path".
 """
 
+from elasticdl_tpu.embedding.cache import HotRowCache  # noqa: F401
 from elasticdl_tpu.embedding.sharding import (  # noqa: F401
     ShardMapOwner,
     ShardMapView,
     TableSpec,
+    assign_replicas,
     plan_moves,
     shard_of,
 )
 from elasticdl_tpu.embedding.store import EmbeddingShardStore  # noqa: F401
-from elasticdl_tpu.embedding.tier import EmbeddingTierClient  # noqa: F401
+from elasticdl_tpu.embedding.tier import (  # noqa: F401
+    EmbeddingPullPipeline,
+    EmbeddingTierClient,
+)
 from elasticdl_tpu.embedding.transport import LocalTransport  # noqa: F401

@@ -104,12 +104,23 @@ class ShardMapView:
     owners: Tuple[int, ...]                 # shard id -> owner worker id
     tables: Tuple[TableSpec, ...] = ()
     resharding: bool = False                # a move plan is in flight
+    # shard id -> READ-replica worker ids (possibly empty): replicas
+    # serve pulls within the staleness bound; writes stay primary-only.
+    # Committed next to `owners` in the same journal records, so a
+    # successor master replays the replica map identically.
+    replicas: Tuple[Tuple[int, ...], ...] = ()
 
     def owner_of(self, shard: int) -> int:
         return self.owners[shard]
 
+    def replicas_of(self, shard: int) -> Tuple[int, ...]:
+        return self.replicas[shard] if shard < len(self.replicas) else ()
+
     def shards_owned_by(self, owner: int) -> List[int]:
         return [s for s, o in enumerate(self.owners) if o == owner]
+
+    def shards_replicated_on(self, owner: int) -> List[int]:
+        return [s for s, r in enumerate(self.replicas) if owner in r]
 
 
 @dataclass(frozen=True)
@@ -142,6 +153,7 @@ def assign_round_robin(num_shards: int, owners: Sequence[int]) -> List[int]:
 def plan_moves(
     current: Sequence[int], new_owners: Sequence[int],
     dead: Sequence[int] = (),
+    prefer: Optional[Dict[int, int]] = None,
 ) -> List[ShardMove]:
     """Minimal-movement rebalance of `current` (shard -> owner) onto the
     surviving/new owner set.
@@ -157,11 +169,17 @@ def plan_moves(
     LEAVING the set (planned shrink) stays the live donor — its shards
     transfer device-to-device before it goes; if it turns out
     unreachable anyway, reshard.apply_moves falls back to the
-    checkpoint/seed restore path per shard."""
+    checkpoint/seed restore path per shard.
+
+    `prefer` maps a stranded shard to the survivor that should take it
+    when the balance allows — the replica-promotion hint (ISSUE 13): a
+    dead owner's shard lands on a worker already holding a synced read
+    replica, so recovery installs by promotion instead of copy."""
     new_owners = sorted(set(new_owners))
     if not new_owners:
         raise ValueError("cannot rebalance onto an empty owner set")
     dead = set(dead)
+    prefer = prefer or {}
     n = len(current)
     target_cap = -(-n // len(new_owners))
     load: Dict[int, int] = {o: 0 for o in new_owners}
@@ -183,7 +201,11 @@ def plan_moves(
     def least_loaded() -> int:
         return min(new_owners, key=lambda o: (load[o], o))
     for s, src in stranded:
-        dst = least_loaded()
+        pref = prefer.get(s)
+        if pref is not None and pref in load and load[pref] < target_cap:
+            dst = pref
+        else:
+            dst = least_loaded()
         load[dst] += 1
         moves.append(ShardMove(shard=s, src=src, dst=dst))
     for s, src in overflow:
@@ -191,6 +213,33 @@ def plan_moves(
         load[dst] += 1
         moves.append(ShardMove(shard=s, src=src, dst=dst))
     return moves
+
+
+def assign_replicas(
+    owners: Sequence[int], pool: Sequence[int], replica_count: int,
+    current: Sequence[Sequence[int]] = (),
+) -> List[List[int]]:
+    """Per-shard read-replica assignment: up to `replica_count` workers
+    per shard drawn from `pool`, never the shard's own primary,
+    deterministic (sorted pool, shard-rotated) so every process planning
+    from the same inputs lands the same map. Replicas already holding
+    the shard (`current`, the pre-transition assignment) are kept when
+    still eligible — a synced copy is worth more than a balanced one."""
+    pool = sorted(set(pool))
+    out: List[List[int]] = []
+    for s, p in enumerate(owners):
+        cands = [o for o in pool if o != p]
+        rc = min(replica_count, len(cands))
+        if rc <= 0:
+            out.append([])
+            continue
+        prior = list(current[s]) if s < len(current) else []
+        kept = [o for o in prior if o in cands][:rc]
+        rest = [o for o in cands if o not in kept]
+        start = s % len(rest) if rest else 0
+        rot = rest[start:] + rest[:start]
+        out.append(kept + rot[: rc - len(kept)])
+    return out
 
 
 def apply_moves_to_assignment(
@@ -225,10 +274,14 @@ class ShardMapOwner:
     the transition is acknowledged to any caller.
     """
 
-    def __init__(self, num_shards: int, journal=None):
+    def __init__(self, num_shards: int, journal=None,
+                 replica_count: int = 0):
         if num_shards < 1:
             raise ValueError("num_shards must be >= 1")
+        if replica_count < 0:
+            raise ValueError("replica_count must be >= 0")
         self.num_shards = num_shards
+        self.replica_count = replica_count
         self._journal = journal
         self._lock = threading.Lock()
         # tables enter ONLY via register_table (journaled) or
@@ -236,6 +289,7 @@ class ShardMapOwner:
         # journal and lose the table specs at master takeover
         self._tables: Dict[str, TableSpec] = {}  # guarded_by: _lock
         self._owners: List[int] = []             # guarded_by: _lock
+        self._replicas: List[List[int]] = []     # guarded_by: _lock
         self._version = 0                        # guarded_by: _lock
         self._pending: Optional[Dict[str, Any]] = None  # guarded_by: _lock
         self._interrupted = False                # guarded_by: _lock
@@ -253,6 +307,9 @@ class ShardMapOwner:
         with self._lock:
             self.num_shards = state.num_shards or self.num_shards
             self._owners = list(state.owners)
+            self._replicas = [
+                list(r) for r in getattr(state, "replicas", [])
+            ]
             self._version = state.version
             self._tables = {
                 t["name"]: TableSpec.from_wire(t) for t in state.tables
@@ -299,12 +356,15 @@ class ShardMapOwner:
             if self._owners:
                 return self._view_locked()
             self._owners = assign_round_robin(self.num_shards, owners)
+            self._replicas = assign_replicas(
+                self._owners, sorted(set(owners)), self.replica_count)
             self._version = 1
             self._interrupted = False
             if self._journal is not None:
                 commit = self._journal.append(
                     "emb_shard_map", version=self._version,
                     num_shards=self.num_shards, owners=list(self._owners),
+                    replicas=[list(r) for r in self._replicas],
                 )
             view = self._view_locked()
         if commit is not None:
@@ -332,22 +392,43 @@ class ShardMapOwner:
                     "resharding already in flight (version "
                     f"{self._pending['version']})"
                 )
-            moves = plan_moves(self._owners, new_owners, dead)
+            # replica-promotion preference: a dead owner's shard goes to
+            # a surviving replica holder when the balance allows — the
+            # recipient promotes its synced copy instead of copying
+            alive = set(new_owners)
+            prefer: Dict[int, int] = {}
+            for s, o in enumerate(self._owners):
+                if o in alive:
+                    continue
+                for r in (self._replicas[s]
+                          if s < len(self._replicas) else []):
+                    if r in alive:
+                        prefer[s] = r
+                        break
+            moves = plan_moves(self._owners, new_owners, dead, prefer)
             if not moves:
                 return self._view_locked(), []
             version = self._version + 1
+            new_assignment = apply_moves_to_assignment(self._owners, moves)
+            new_replicas = assign_replicas(
+                new_assignment, sorted(alive), self.replica_count,
+                current=self._replicas,
+            )
             self._pending = {
                 "version": version,
                 "moves": moves,
                 "confirmed": set(),
                 "prior_owners": list(self._owners),
+                "prior_replicas": [list(r) for r in self._replicas],
             }
-            self._owners = apply_moves_to_assignment(self._owners, moves)
+            self._owners = new_assignment
+            self._replicas = new_replicas
             self._version = version
             if self._journal is not None:
                 commit = self._journal.append(
                     "emb_reshard_begin", version=version,
                     owners=list(self._owners),
+                    replicas=[list(r) for r in self._replicas],
                     moves=[m.to_wire() for m in moves],
                 )
             view = self._view_locked()
@@ -424,6 +505,7 @@ class ShardMapOwner:
             owners=tuple(self._owners),
             tables=tuple(self._tables.values()),
             resharding=self._pending is not None or self._interrupted,
+            replicas=tuple(tuple(r) for r in self._replicas),
         )
 
     def _notify(self, view: ShardMapView) -> None:

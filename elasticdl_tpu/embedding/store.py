@@ -39,6 +39,20 @@ requeues after an interrupted resharding) come back ``applied=False``
 without touching the table. The seq watermarks TRAVEL with the shard
 (`extract_shard` / `install_shard` / checkpoint files), so migration and
 restore preserve the fence.
+
+Push watermarks (ISSUE 13, the read path): every APPLIED push also bumps
+a per-(table, shard) **watermark** — a dense counter of writes the shard
+has absorbed. Pulls and push acks can carry it (``with_watermark=True``),
+which is what fences the worker-local hot-row cache (tier.py: a cached
+row tagged with watermark W is a miss once the owner is known to be past
+``W + staleness_bound``) and what tags the **delta log**: the store keeps
+a bounded log of recent applied pushes so a read replica can sync by
+fetching only the deltas past its own watermark (`fetch_delta` /
+`apply_replica_delta`) instead of re-copying the shard. Replica copies
+are resident in a SEPARATE namespace (`install_replica`): they serve
+pulls (``replica=True``) but reject pushes — writes stay primary-only —
+and can be promoted to primary wholesale (`promote_replica`) when the
+owner dies, watermark and exactly-once seq fence included.
 """
 
 from __future__ import annotations
@@ -49,6 +63,7 @@ import os
 import threading
 import time
 import zlib
+from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -84,6 +99,20 @@ _SHARD_ROWS = _reg.counter(
 _OP_S = _reg.histogram(
     "edl_embedding_store_op_seconds",
     "owner-side serve wall time per call", labels=("op",))
+_REPLICA_SYNCS = _reg.counter(
+    "edl_embedding_replica_delta_syncs_total",
+    "delta batches applied to resident replica shards")
+_REPLICA_RESYNCS = _reg.counter(
+    "edl_embedding_replica_full_resyncs_total",
+    "replica syncs that fell back to a full shard copy (delta log "
+    "did not reach back to the replica's watermark)")
+_REPLICA_PROMOTIONS = _reg.counter(
+    "edl_embedding_replica_promotions_total",
+    "replica shards promoted to primary (owner death recovery)")
+
+#: delta-log depth per resident shard: how many applied pushes a replica
+#: may lag before its sync falls back to a full shard copy
+DELTA_LOG = int(os.environ.get("EDL_EMB_DELTA_LOG", "64") or 64)
 
 
 class StaleShardMapError(RuntimeError):
@@ -96,15 +125,25 @@ class _Shard:
     per-client sequence watermarks (mutations guarded by the store lock
     at the serving layer; the apply itself runs outside it)."""
 
-    __slots__ = ("rows", "applied", "lock")
+    __slots__ = ("rows", "applied", "lock", "wm", "deltas")
 
-    def __init__(self, rows, applied: Optional[Dict[str, int]] = None):
+    def __init__(self, rows, applied: Optional[Dict[str, int]] = None,
+                 wm: int = 0):
         self.rows = rows                      # jax.Array (num_rows, dim)
         self.applied: Dict[str, int] = dict(applied or {})
         # per-shard leaf lock: pull/push on DIFFERENT shards never
         # serialize behind each other (the store lock only guards the
         # shard directory)
         self.lock = threading.Lock()
+        # push watermark: +1 per APPLIED push. The hot-row cache's
+        # staleness fence and the replica delta protocol both count in
+        # these units — "N pushes behind", not wall time, so a quiet
+        # shard never goes stale and a hot one ages fast.
+        self.wm = int(wm)
+        # recent applied pushes, watermark-tagged, for replica delta
+        # sync (guarded by `lock`; bounded — a replica further behind
+        # than the log re-copies the shard)
+        self.deltas: "deque" = deque(maxlen=DELTA_LOG)
 
 
 def _init_shard_rows(spec: sharding.TableSpec, shard: int,
@@ -147,6 +186,16 @@ class EmbeddingShardStore:
         self._num_shards = 0                              # guarded_by: _lock
         self._map_version = 0                             # guarded_by: _lock
         self._shards: Dict[Tuple[str, int], _Shard] = {}  # guarded_by: _lock
+        # read-replica copies, SEPARATE namespace: a worker may be
+        # primary for shard 3 and replica for shard 5 in the same store;
+        # replicas serve pulls only and are promotable wholesale
+        self._replicas: Dict[Tuple[str, int], _Shard] = {}  # guarded_by: _lock
+        # replica delta logging is OFF until a shard map carrying
+        # replica assignments shows up (attach/set_delta_logging):
+        # without replicas nothing ever consumes the log, and buffering
+        # 64 pushes of gradient rows per shard is real memory + two
+        # array copies per push on the hot path
+        self._log_deltas = False                          # guarded_by: _lock
         if device is None:
             device = _default_device_mode()
         # None = decide lazily at the first shard materialization (the
@@ -191,6 +240,8 @@ class EmbeddingShardStore:
         with self._lock:
             self._num_shards = view.num_shards
             self._map_version = view.version
+            self._log_deltas = any(
+                view.replicas_of(s) for s in range(view.num_shards))
             for spec in view.tables:
                 self._tables[spec.name] = spec
             owned = [s for s, o in enumerate(view.owners)
@@ -207,6 +258,7 @@ class EmbeddingShardStore:
                             self._shards[(spec.name, s)] = _Shard(
                                 self._place(payload["rows"]),
                                 payload["applied"],
+                                wm=int(payload.get("wm", 0)),
                             )
                             created.append(s)
                             continue
@@ -220,6 +272,14 @@ class EmbeddingShardStore:
         with self._lock:
             self._map_version = version
 
+    def set_delta_logging(self, enabled: bool) -> None:
+        """Replica-map reaction (WorkerTierRuntime): start/stop keeping
+        the per-shard push delta log. A log that starts mid-history is
+        safe — fetch_delta's contiguity check routes a too-far-behind
+        replica to the full-copy path."""
+        with self._lock:
+            self._log_deltas = bool(enabled)
+
     @property
     def map_version(self) -> int:
         with self._lock:
@@ -231,7 +291,8 @@ class EmbeddingShardStore:
                     if table is None or k[0] == table]
 
     def _get_shard(self, table: str, shard: int,
-                   map_version: Optional[int]) -> _Shard:
+                   map_version: Optional[int],
+                   replica: bool = False) -> _Shard:
         with self._lock:
             if (map_version is not None
                     and map_version != self._map_version):
@@ -240,11 +301,14 @@ class EmbeddingShardStore:
                     f"shard map v{map_version} (store at "
                     f"v{self._map_version})"
                 )
-            sh = self._shards.get((table, shard))
+            pool = self._replicas if replica else self._shards
+            sh = pool.get((table, shard))
         if sh is None:
             _STALE.inc()
             raise StaleShardMapError(
-                f"shard {table}/{shard} not resident on owner {self.owner}"
+                f"shard {table}/{shard} not "
+                f"{'replica-' if replica else ''}resident on owner "
+                f"{self.owner}"
             )
         return sh
 
@@ -252,15 +316,21 @@ class EmbeddingShardStore:
     # data plane
 
     def pull(self, table: str, shard: int, local_ids: np.ndarray,
-             map_version: Optional[int] = None) -> np.ndarray:
+             map_version: Optional[int] = None,
+             with_watermark: bool = False, replica: bool = False):
         """One fused gather: (n,) local row ids -> (n, dim) rows.
         Out-of-range ids (the client's pow2 padding sentinels) return
-        zero rows."""
+        zero rows. ``with_watermark=True`` returns ``(rows, wm)`` — the
+        shard's push watermark as of the serve, the hot-row cache's
+        freshness tag. ``replica=True`` serves from this store's replica
+        copy of the shard (its watermark is wherever the last delta sync
+        left it — the client's staleness fence decides acceptability)."""
         t0 = time.perf_counter()
-        sh = self._get_shard(table, shard, map_version)
+        sh = self._get_shard(table, shard, map_version, replica=replica)
         ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
         with sh.lock:
             rows = sh.rows
+            wm = sh.wm
         if self._use_device():
             out = np.asarray(
                 self._pull_fn(rows.shape, ids.shape[0])(rows, ids))
@@ -275,17 +345,35 @@ class EmbeddingShardStore:
         _PULLED.inc(real, table=table)
         _SHARD_ROWS.inc(real, table=table, shard=str(shard), op="pull")
         _OP_S.observe(time.perf_counter() - t0, op="pull")
+        if with_watermark:
+            return out, wm
         return out
 
     def push(self, table: str, shard: int, local_ids: np.ndarray,
              rows: np.ndarray, *, client_id: str, seq: int,
              map_version: Optional[int] = None,
-             scale: float = 1.0) -> bool:
+             scale: float = 1.0, with_watermark: bool = False):
         """One deduped scatter-add: ``shard_table += scale * sum(rows at
         local_ids)``. Returns False (without touching the table) when the
         exactly-once fence says ``(client_id, seq)`` was already applied
-        — the ack a retried/requeued push gets."""
+        — the ack a retried/requeued push gets. ``with_watermark=True``
+        returns ``(applied, wm)`` with the post-apply watermark (a
+        duplicate returns the CURRENT watermark — the fence held, the
+        caller's freshness knowledge still advances)."""
         t0 = time.perf_counter()
+        with self._lock:
+            is_replica = ((table, shard) in self._replicas
+                          and (table, shard) not in self._shards)
+            log_deltas = self._log_deltas
+        if is_replica:
+            # writes are primary-only: a client pushing here holds a map
+            # that predates (or misread) the replica split — same remedy
+            # as any stale-map write: refresh and re-route
+            _STALE.inc()
+            raise StaleShardMapError(
+                f"shard {table}/{shard} on owner {self.owner} is a READ "
+                "replica; pushes go to the primary"
+            )
         sh = self._get_shard(table, shard, map_version)
         ids = np.ascontiguousarray(np.asarray(local_ids, np.int32))
         vals = np.ascontiguousarray(np.asarray(rows, np.float32))
@@ -293,18 +381,32 @@ class EmbeddingShardStore:
             last = sh.applied.get(client_id, -1)
             if seq <= last:
                 _DUP_PUSHES.inc()
-                return False
+                return (False, sh.wm) if with_watermark else False
             if self._use_device():
                 sh.rows = self._apply_fn(sh.rows.shape, ids.shape[0])(
                     sh.rows, ids, vals, np.float32(scale))
             else:
                 self._host_apply(sh.rows, ids, vals, scale)
             sh.applied[client_id] = seq
+            sh.wm += 1
+            wm = sh.wm
+            if log_deltas:
+                # delta log (replica sync): real rows only — a replica
+                # re-applies through the same sentinel-dropping path,
+                # and the log should not hold the pow2 padding
+                keep = ids >= 0
+                sh.deltas.append({
+                    "wm": wm, "ids": ids[keep].copy(),
+                    "rows": vals[keep].copy(), "scale": float(scale),
+                    "client_id": client_id, "seq": int(seq),
+                })
         # real (non-sentinel) rows only — see the pull counter note
         real = int((ids >= 0).sum())
         _PUSHED.inc(real, table=table)
         _SHARD_ROWS.inc(real, table=table, shard=str(shard), op="push")
         _OP_S.observe(time.perf_counter() - t0, op="push")
+        if with_watermark:
+            return True, wm
         return True
 
     @staticmethod
@@ -383,11 +485,12 @@ class EmbeddingShardStore:
     # -------------------------------------------------------------- #
     # migration / checkpoint payloads
 
-    def extract_shard(self, table: str, shard: int) -> Dict[str, Any]:
-        """The migration payload: rows + exactly-once watermarks. The
-        shard stays resident (the donor serves reads until the move
-        commits); `release_shard` drops it afterwards."""
-        sh = self._get_shard(table, shard, None)
+    def extract_shard(self, table: str, shard: int,
+                      replica: bool = False) -> Dict[str, Any]:
+        """The migration payload: rows + exactly-once watermarks + push
+        watermark. The shard stays resident (the donor serves reads until
+        the move commits); `release_shard` drops it afterwards."""
+        sh = self._get_shard(table, shard, None, replica=replica)
         with sh.lock:
             return {
                 # copy, not a view: in host mode the live array mutates
@@ -395,6 +498,7 @@ class EmbeddingShardStore:
                 # point-in-time snapshot
                 "rows": np.array(sh.rows, np.float32, copy=True),
                 "applied": dict(sh.applied),
+                "wm": int(sh.wm),
             }
 
     def install_shard(self, table: str, shard: int,
@@ -403,6 +507,7 @@ class EmbeddingShardStore:
             self._shards[(table, shard)] = _Shard(
                 self._place(np.asarray(payload["rows"], np.float32)),
                 {str(k): int(v) for k, v in payload["applied"].items()},
+                wm=int(payload.get("wm", 0)),
             )
             _SHARDS.set(len(self._shards))
 
@@ -410,6 +515,145 @@ class EmbeddingShardStore:
         with self._lock:
             self._shards.pop((table, shard), None)
             _SHARDS.set(len(self._shards))
+
+    # -------------------------------------------------------------- #
+    # read replicas (ISSUE 13): pull-only copies + watermark delta sync
+
+    def install_replica(self, table: str, shard: int,
+                        payload: Dict[str, Any]) -> None:
+        """Adopt a replica copy of a shard this store does NOT own
+        (payload = the primary's `extract_shard`). Serves pulls with
+        ``replica=True``; never pushes."""
+        with self._lock:
+            self._replicas[(table, shard)] = _Shard(
+                self._place(np.asarray(payload["rows"], np.float32)),
+                {str(k): int(v) for k, v in payload["applied"].items()},
+                wm=int(payload.get("wm", 0)),
+            )
+
+    def release_replica(self, table: str, shard: int) -> None:
+        with self._lock:
+            self._replicas.pop((table, shard), None)
+
+    def resident_replicas(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._replicas)
+
+    def replica_watermark(self, table: str, shard: int) -> int:
+        sh = self._get_shard(table, shard, None, replica=True)
+        with sh.lock:
+            return sh.wm
+
+    def promote_replica(self, table: str, shard: int) -> int:
+        """Owner-death recovery: this store's replica copy BECOMES the
+        primary — rows, exactly-once seq fence, and push watermark move
+        wholesale, so a client push retried across the promotion still
+        dedupes. Returns the promoted copy's watermark."""
+        with self._lock:
+            sh = self._replicas.pop((table, shard), None)
+            if sh is None:
+                raise StaleShardMapError(
+                    f"no replica of {table}/{shard} resident on owner "
+                    f"{self.owner} to promote"
+                )
+            self._shards[(table, shard)] = sh
+            _SHARDS.set(len(self._shards))
+        _REPLICA_PROMOTIONS.inc()
+        with sh.lock:
+            return sh.wm
+
+    def shard_watermark(self, table: str, shard: int) -> int:
+        """The primary's push watermark — the hot-row cache's freshness
+        probe (a fully-cache-served client must still learn the owner
+        moved on; tier.py probes this on a lookup cadence)."""
+        sh = self._get_shard(table, shard, None)
+        with sh.lock:
+            return sh.wm
+
+    def fetch_delta(self, table: str, shard: int,
+                    since_wm: int) -> Optional[Dict[str, Any]]:
+        """Primary side of replica sync: every applied push past
+        ``since_wm``, watermark-tagged and in order — or None when the
+        bounded delta log no longer reaches back that far (the replica
+        falls back to a full `extract_shard` copy)."""
+        sh = self._get_shard(table, shard, None)
+        with sh.lock:
+            wm = sh.wm
+            if since_wm >= wm:
+                return {"wm": wm, "entries": []}
+            entries = [d for d in sh.deltas if d["wm"] > since_wm]
+            # contiguity: the log must hold EVERY watermark in
+            # (since_wm, wm] or the replica would silently skip pushes
+            if len(entries) != wm - since_wm:
+                return None
+            return {
+                "wm": wm,
+                "entries": [dict(d, ids=d["ids"].copy(),
+                                 rows=d["rows"].copy())
+                            for d in entries],
+            }
+
+    def apply_replica_delta(self, table: str, shard: int,
+                            delta: Dict[str, Any]) -> int:
+        """Replica side of sync: apply the primary's delta batch in
+        watermark order (idempotent — entries at or below the replica's
+        watermark are skipped). Returns the replica's new watermark."""
+        sh = self._get_shard(table, shard, None, replica=True)
+        with sh.lock:
+            for e in sorted(delta["entries"], key=lambda d: d["wm"]):
+                if e["wm"] <= sh.wm:
+                    continue
+                if e["wm"] != sh.wm + 1:
+                    raise StaleShardMapError(
+                        f"replica {table}/{shard} delta gap: at wm "
+                        f"{sh.wm}, next entry {e['wm']} — full resync "
+                        "required"
+                    )
+                raw_ids = np.asarray(e["ids"], np.int32)
+                raw_vals = np.asarray(e["rows"], np.float32)
+                # pow2-pad like the client's push protocol (sentinel -1
+                # rows drop in the apply) so device-mode replicas land on
+                # the same handful of compiled programs as primaries
+                n = 256
+                while n < raw_ids.shape[0]:
+                    n <<= 1
+                ids = np.full((n,), -1, np.int32)
+                ids[: raw_ids.shape[0]] = raw_ids
+                vals = np.zeros((n, raw_vals.shape[1]
+                                 if raw_vals.ndim == 2 else sh.rows.shape[1]),
+                                np.float32)
+                vals[: raw_vals.shape[0]] = raw_vals
+                if self._use_device():
+                    sh.rows = self._apply_fn(sh.rows.shape, ids.shape[0])(
+                        sh.rows, ids, vals, np.float32(e["scale"]))
+                else:
+                    self._host_apply(sh.rows, ids, vals, e["scale"])
+                sh.wm = e["wm"]
+                cid = str(e.get("client_id", ""))
+                if cid:
+                    sh.applied[cid] = max(
+                        sh.applied.get(cid, -1), int(e.get("seq", -1)))
+            new_wm = sh.wm
+        _REPLICA_SYNCS.inc()
+        return new_wm
+
+    def sync_replica_from(self, transport, primary: int, table: str,
+                          shard: int) -> int:
+        """One replica sync round against the primary over the
+        transport: delta when the log reaches, full copy otherwise.
+        Returns the replica's post-sync watermark."""
+        try:
+            since = self.replica_watermark(table, shard)
+        except StaleShardMapError:
+            since = -1
+        if since >= 0:
+            delta = transport.fetch_delta(primary, table, shard, since)
+            if delta is not None:
+                return self.apply_replica_delta(table, shard, delta)
+            _REPLICA_RESYNCS.inc()
+        payload = transport.fetch_shard(primary, table, shard)
+        self.install_replica(table, shard, payload)
+        return int(payload.get("wm", 0))
 
     # -------------------------------------------------------------- #
     # sharded save/restore (training/checkpoint.py delegates here)
@@ -469,6 +713,7 @@ def save_shard_file(directory: str, table: str, shard: int,
         buf, rows=np.asarray(payload["rows"], np.float32),
         applied=np.frombuffer(
             json.dumps(payload["applied"]).encode(), np.uint8),
+        wm=np.asarray(int(payload.get("wm", 0)), np.int64),
     )
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
@@ -482,6 +727,23 @@ def save_shard_file(directory: str, table: str, shard: int,
     return path
 
 
+def peek_shard_watermark(directory: str, table: str,
+                         shard: int) -> Optional[int]:
+    """The checkpoint file's push watermark WITHOUT materializing the
+    rows (npz members load lazily) — the replica-vs-checkpoint
+    freshness arbitration on the recovery critical path must not pay a
+    full shard read per candidate."""
+    path = _shard_path(directory, table, shard)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path) as z:
+            return int(z["wm"]) if "wm" in z.files else 0
+    except (OSError, ValueError, KeyError):
+        logger.exception("embedding shard file %s unreadable; ignored", path)
+        return None
+
+
 def load_shard_file(directory: str, table: str,
                     shard: int) -> Optional[Dict[str, Any]]:
     path = _shard_path(directory, table, shard)
@@ -491,8 +753,11 @@ def load_shard_file(directory: str, table: str,
         with np.load(path) as z:
             rows = z["rows"]
             applied = json.loads(bytes(z["applied"]).decode())
+            # pre-watermark files (PR 10) load at wm 0 — conservative:
+            # every cached row fetched before the restore reads stale
+            wm = int(z["wm"]) if "wm" in z.files else 0
     except (OSError, ValueError, KeyError):
         logger.exception("embedding shard file %s unreadable; ignored", path)
         return None
-    return {"rows": rows, "applied": {str(k): int(v)
-                                      for k, v in applied.items()}}
+    return {"rows": rows, "wm": wm,
+            "applied": {str(k): int(v) for k, v in applied.items()}}

@@ -22,6 +22,29 @@ mid-resharding, owner handoff — re-sends the SAME seq, and the store's
 per-(shard, client) watermark turns duplicates into acked no-ops. A
 push returns only when every shard acked, so a client that returns from
 `push()` KNOWS the update landed exactly once.
+
+The serving-grade READ path (ISSUE 13) stacks three switchable layers
+on top, each taking traffic off the owner RPC:
+
+1. **hot-row cache** (`cache_rows > 0`): a worker-local staleness-
+   bounded LRU over unique ids (embedding/cache.py) consulted before
+   any shard call — only misses travel; responses carry the shard push
+   watermark that fences freshness, the worker's own pushes write
+   through, and any shard-map change drops the cache whole.
+2. **read replicas** (`read_replicas=True` + a master map carrying
+   replica assignments): misses fan out to the least-loaded replica of
+   each shard; a replica answering from further back than the staleness
+   bound is rejected and the primary serves. Writes NEVER go to
+   replicas.
+3. **pull pipeline** (`EmbeddingPullPipeline`): step N+1's pull issued
+   while step N computes — `get()` blocks only on what compute did not
+   already cover, which is the only part that still bills the goodput
+   ledger's `emb_pull_blocked`. `drain()` hands back in-flight id
+   batches on rescale/reshard so they re-issue under the fresh map.
+
+tier_stats() reports the two latencies the split creates: `emb_pull_
+p99_ms` (owner RPC rounds only — what the embedding_pull_p99 alert
+pages on) vs `emb_read_p99_ms` (effective reads, cache included).
 """
 
 from __future__ import annotations
@@ -31,13 +54,14 @@ import time
 import uuid
 from collections import deque
 from concurrent.futures import ThreadPoolExecutor
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.embedding import sharding
+from elasticdl_tpu.embedding.cache import HotRowCache
 from elasticdl_tpu.embedding.sketch import SpaceSaving
 from elasticdl_tpu.embedding.store import StaleShardMapError
 from elasticdl_tpu.embedding.transport import OwnerUnavailableError
@@ -88,21 +112,47 @@ _SHARD_LOAD = _reg.gauge(
     "edl_embedding_client_shard_load_rows",
     "deduped rows this client pulled per shard (rolling window)",
     labels=("shard",))
+# read-path telemetry (ISSUE 13): per-shard replica serves are bounded
+# by --embedding_shards (config, not data): edl-lint: disable=EDL405
+_REPLICA_READS = _reg.counter(
+    "edl_embedding_replica_reads_total",
+    "per-shard pulls served by a read replica (within the staleness "
+    "bound) instead of the primary", labels=("shard",))
+_REPLICA_STALE = _reg.counter(
+    "edl_embedding_replica_stale_rejects_total",
+    "replica answers rejected for exceeding the staleness bound "
+    "(primary re-served the shard)")
+_PIPE_DEPTH = _reg.gauge(
+    "edl_embedding_pull_pipeline_depth",
+    "configured lookahead of the newest pull pipeline (0 = pipeline off)")
+_PIPE_BLOCKED_S = _reg.histogram(
+    "edl_embedding_pull_pipeline_blocked_seconds",
+    "time get() actually waited on a pipelined pull — the residual the "
+    "compute overlap did not cover")
 
 
 _GOODPUT_LEDGER = None
+#: set on pipeline worker threads: a background pull overlaps compute,
+#: so its wall time must NOT bill the goodput ledger's emb_pull_blocked
+#: (only the get()-side residual wait does) nor the effective-read
+#: latency window
+_BILL_TLS = threading.local()
 
 
 def _goodput_pull(seconds: float) -> None:
     """Tee pull wall time into the process goodput ledger: client pulls
-    block the step (ROADMAP 1's pipeline item exists to change that), so
-    they are the `emb_pull_blocked` category — distinct from compute,
-    which times only the jitted step dispatch. The ledger reference is
-    cached after the first pull (same idiom as StepProfiler's tee): this
-    runs per pull on the step path and must not pay the singleton lock
-    every time. (Tests calling goodput.reset_for_tests may leave a
-    stale cached ledger here — adds then land on a detached ledger,
-    which is harmless; nothing asserts on it across resets.)"""
+    block the step (the pull pipeline exists to change that), so they
+    are the `emb_pull_blocked` category — distinct from compute, which
+    times only the jitted step dispatch. Pipeline worker threads are
+    exempt (their pulls overlap compute; the residual `get()` wait
+    bills instead). The ledger reference is cached after the first pull
+    (same idiom as StepProfiler's tee): this runs per pull on the step
+    path and must not pay the singleton lock every time. (Tests calling
+    goodput.reset_for_tests may leave a stale cached ledger here — adds
+    then land on a detached ledger, which is harmless; nothing asserts
+    on it across resets.)"""
+    if getattr(_BILL_TLS, "off", False):
+        return
     global _GOODPUT_LEDGER
     if _GOODPUT_LEDGER is None:
         from elasticdl_tpu.observability import goodput
@@ -163,6 +213,10 @@ class EmbeddingTierClient:
         retry_backoff_s: float = 0.05,
         fanout_workers: int = 0,
         sketch_k: int = 0,
+        sketch_every: int = 1,
+        cache_rows: int = 0,
+        cache_staleness: int = 1,
+        read_replicas: bool = False,
     ):
         self._map_fetch = map_fetch
         self._transport = transport
@@ -189,9 +243,53 @@ class EmbeddingTierClient:
         # tier_stats sort both take _lock: iterating a deque while
         # another thread appends raises "mutated during iteration")
         self.sketch = SpaceSaving(sketch_k if sketch_k > 0 else 128)
+        # sketch feed sampling (ISSUE 13): the Space-Saving update is
+        # per-unique-id PYTHON heap work — at serving rates it becomes
+        # the pull's dominant cost (profiled ~75% of a cached pull) and,
+        # being GIL-bound, the one thing a background pipeline pull
+        # cannot overlap. hot_share is a traffic statistic: feeding
+        # every Nth batch estimates it unbiasedly over the stream.
+        # Default 1 (every batch — ISSUE 11's exact-telemetry contract);
+        # serving-grade read paths sample (bench uses the staleness
+        # stride; docs/performance.md "Embedding read path").
+        self.sketch_every = max(1, int(sketch_every))
+        self._sketch_tick = 0                                # guarded_by: _lock
         self._shard_loads: Optional[np.ndarray] = None      # guarded_by: _lock
+        # LATENCY SPLIT (ISSUE 13 bugfix): `_pull_times` records OWNER
+        # RPC rounds only — what the embedding_pull_p99 alert pages on;
+        # a cache serving most reads must not dilute it. `_read_times`
+        # records the effective read the step saw (cache included, and
+        # pipelined reads record only their residual get() wait).
         self._pull_times: "deque[float]" = deque(maxlen=LATENCY_WINDOW)  # guarded_by: _lock
+        self._read_times: "deque[float]" = deque(maxlen=LATENCY_WINDOW)  # guarded_by: _lock
         self._push_times: "deque[float]" = deque(maxlen=LATENCY_WINDOW)  # guarded_by: _lock
+        # read path (ISSUE 13): hot-row cache + per-(table, shard)
+        # OBSERVED owner push watermarks (the staleness fence's "what
+        # the owner is known to have absorbed") + replica read-target
+        # rolling loads + pipeline lookahead (the newest pipeline's)
+        self.staleness_bound = max(0, int(cache_staleness))
+        if cache_rows > 0 and not dedupe:
+            # the cache (and its write-through) assumes the sorted-
+            # unique deduped protocol; a non-deduping client is the
+            # reference-PS baseline shape and gets no cache
+            raise ValueError(
+                "embedding cache requires the deduping client "
+                "(dedupe=True)")
+        self.cache: Optional[HotRowCache] = (
+            HotRowCache(cache_rows, self.staleness_bound)
+            if cache_rows > 0 else None)
+        self.read_replicas = bool(read_replicas)
+        self._owner_wm: Dict[str, np.ndarray] = {}          # guarded_by: _lock
+        self._target_loads: Dict[int, int] = {}             # guarded_by: _lock
+        self._pipeline_depth = 0
+        # freshness probes: a FULLY cache-served pull touches no shard,
+        # so the observed watermark would never advance and the
+        # staleness fence would never fire for a read-mostly client —
+        # every `wm_probe_every` consecutive full-hit lookups per table,
+        # ask each primary for its bare watermark (no rows on the wire).
+        # The worker's own push acks make this a no-op in training.
+        self.wm_probe_every = 16
+        self._full_hits: Dict[str, int] = {}                # guarded_by: _lock
         self.refresh()
         # fanout_workers > 0: per-shard calls to distinct owners run
         # concurrently — right for REMOTE transports, where the calls
@@ -222,9 +320,40 @@ class EmbeddingTierClient:
 
     def refresh(self) -> sharding.ShardMapView:
         view = self._map_fetch()
+        invalidate = False
         with self._lock:
+            old = self._view
             self._view = view
+            if old is not None and (old.version != view.version
+                                    or old.num_shards != view.num_shards):
+                # shard-map change: ownership AND watermark history are
+                # re-keyed (a migrated shard's watermark traveled, but a
+                # promoted/restored one may not line up) — drop the
+                # whole cache and the observed-watermark state rather
+                # than reason per entry. Reshards are rare; staleness
+                # bugs are forever.
+                invalidate = True
+                self._owner_wm.clear()
+                self._target_loads.clear()
+        if invalidate and self.cache is not None:
+            self.cache.invalidate_all()
         return view
+
+    def _owner_wm_locked(self, table: str, num_shards: int) -> np.ndarray:
+        arr = self._owner_wm.get(table)
+        if arr is None or arr.shape[0] != num_shards:
+            arr = np.zeros(num_shards, np.int64)
+            self._owner_wm[table] = arr
+        return arr
+
+    def _note_wm(self, table: str, num_shards: int, shard: int,
+                 wm: int) -> None:
+        """Advance the observed owner watermark (monotonic: a replica's
+        lagging answer never walks freshness knowledge backwards)."""
+        with self._lock:
+            arr = self._owner_wm_locked(table, num_shards)
+            if wm > arr[shard]:
+                arr[shard] = wm
 
     @property
     def view(self) -> sharding.ShardMapView:
@@ -266,9 +395,11 @@ class EmbeddingTierClient:
                 uniq, inverse, id_counts = vids, None, None
             _PULL_UNIQUE.inc(int(uniq.shape[0]))
             # skew measurement: the sketch sees every id's true
-            # occurrence weight (one dict op per UNIQUE id)
-            self.sketch.update_batch(uniq, id_counts)
-            vectors = self._pull_unique(table, spec, uniq)
+            # occurrence weight (one dict op per UNIQUE id), sampled at
+            # the configured batch stride
+            if self._sketch_due():
+                self.sketch.update_batch(uniq, id_counts)
+            vectors = self._pull_unique(table, spec, uniq, id_counts)
             expanded = vectors if inverse is None else vectors[inverse]
             if all_valid:
                 out = expanded
@@ -278,25 +409,102 @@ class EmbeddingTierClient:
         dt = time.perf_counter() - t0
         _PULL_S.observe(dt)
         _goodput_pull(dt)
-        with self._lock:
-            self._pull_times.append(dt)
+        self._note_read_time(dt)
         return out.reshape(*np.asarray(ids).shape, spec.dim)
 
-    def _pull_unique(self, table: str, spec, uniq: np.ndarray) -> np.ndarray:
-        """One call per owning shard over the deduped stream; retried
-        whole against a refreshed map on stale/dead-owner errors (reads
-        are idempotent)."""
-        for attempt in range(self._max_retries + 1):
-            view = self.view
+    def _sketch_due(self) -> bool:
+        if self.sketch_every == 1:
+            return True
+        with self._lock:
+            due = self._sketch_tick % self.sketch_every == 0
+            self._sketch_tick += 1
+        return due
+
+    def _note_read_time(self, dt: float) -> None:
+        """Effective-read latency window — skipped on pipeline worker
+        threads (the step never saw that wall; get()'s residual wait is
+        recorded instead)."""
+        if getattr(_BILL_TLS, "off", False):
+            return
+        with self._lock:
+            self._read_times.append(dt)
+
+    def _pull_unique(self, table: str, spec, uniq: np.ndarray,
+                     counts: Optional[np.ndarray] = None) -> np.ndarray:
+        """The read path over a sorted-unique in-range id stream: hot-row
+        cache first (watermark-fenced), owner/replica shard calls for
+        the misses only, miss rows admitted to the cache tagged with the
+        watermark their serving response carried."""
+        if self.cache is None:
+            rows, _ = self._pull_owner(table, spec, uniq)
+            return rows
+        view = self.view
+        with self._lock:
+            owner_arr = self._owner_wm_locked(
+                table, view.num_shards).copy()
+        hit_mask, hit_rows = self.cache.lookup(
+            table, spec.vocab, spec.dim, uniq, owner_arr,
+            view.num_shards, counts)
+        out = np.empty((uniq.shape[0], spec.dim), np.float32)
+        if hit_rows is not None:
+            out[hit_mask] = hit_rows
+        miss = ~hit_mask
+        if miss.any():
+            miss_ids = uniq[miss]
+            rows_m, wms_m = self._pull_owner(table, spec, miss_ids)
+            out[miss] = rows_m
+            self.cache.insert(
+                table, spec.vocab, spec.dim, miss_ids, rows_m, wms_m)
+            with self._lock:
+                self._full_hits[table] = 0
+        else:
+            self._maybe_probe_watermarks(table, view)
+        return out
+
+    def _maybe_probe_watermarks(self, table: str, view) -> None:
+        """Bound a read-mostly client's staleness: after
+        `wm_probe_every` consecutive fully-cache-served lookups, fetch
+        each primary's bare watermark so the next lookup's fence sees
+        how far the owners really moved. Best-effort — a dead owner's
+        probe is the retry path's problem, not the hit path's."""
+        with self._lock:
+            n = self._full_hits.get(table, 0) + 1
+            self._full_hits[table] = 0 if n >= self.wm_probe_every else n
+        if n < self.wm_probe_every:
+            return
+        for shard in range(view.num_shards):
             try:
-                return self._pull_once(view, table, uniq)
+                wm = self._transport.shard_watermark(
+                    view.owner_of(shard), table, shard)
             except (StaleShardMapError, OwnerUnavailableError,
-                    faults.FaultInjected) as e:
-                self._note_retry("pull", attempt, e)
-        raise OwnerUnavailableError(
-            f"embedding pull for {table!r} failed after "
-            f"{self._max_retries} retries"
-        )
+                    faults.FaultInjected):
+                continue
+            self._note_wm(table, view.num_shards, shard, int(wm))
+
+    def _pull_owner(self, table: str, spec,
+                    uniq: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """One call per owning shard (or its freshest-enough replica)
+        over the deduped miss stream; retried whole against a refreshed
+        map on stale/dead-owner errors (reads are idempotent). Returns
+        ``(rows, per_id_watermarks)``. The wall across ALL rounds lands
+        in the owner-RPC latency window — an outage pull records the
+        outage, which is exactly what the pull-p99 alert needs to see."""
+        t0 = time.perf_counter()
+        try:
+            for attempt in range(self._max_retries + 1):
+                view = self.view
+                try:
+                    return self._pull_once(view, table, uniq)
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected) as e:
+                    self._note_retry("pull", attempt, e)
+            raise OwnerUnavailableError(
+                f"embedding pull for {table!r} failed after "
+                f"{self._max_retries} retries"
+            )
+        finally:
+            with self._lock:
+                self._pull_times.append(time.perf_counter() - t0)
 
     def pull_unique(self, table: str, ids: np.ndarray):
         """The deduped-end-to-end lookup: returns ``(unique_rows,
@@ -331,20 +539,53 @@ class EmbeddingTierClient:
         real = uniq.shape[0] - int(has_pad)
         if real:
             # the sentinel slot never reaches the sketch — padding is
-            # protocol, not traffic
-            self.sketch.update_batch(uniq[:real], id_counts[:real])
-            rows[:real] = self._pull_unique(table, spec, uniq[:real])
+            # protocol, not traffic (feed sampled at the batch stride)
+            if self._sketch_due():
+                self.sketch.update_batch(uniq[:real], id_counts[:real])
+            rows[:real] = self._pull_unique(
+                table, spec, uniq[:real], id_counts[:real])
         dt = time.perf_counter() - t0
         _PULL_S.observe(dt)
         _goodput_pull(dt)
-        with self._lock:
-            self._pull_times.append(dt)
+        self._note_read_time(dt)
         return rows, inverse.reshape(np.asarray(ids).shape), uniq
 
-    def _pull_once(self, view, table: str, uniq: np.ndarray) -> np.ndarray:
+    def _pick_read_target(self, view, shard: int) -> Tuple[int, bool]:
+        """(worker id, is_replica) for one shard read: the least-loaded
+        of primary + replicas (rolling client-side counts), primary-only
+        while a reshard is in flight (replica copies may be mid-move).
+        Writes never come through here."""
+        primary = view.owner_of(shard)
+        if not self.read_replicas or view.resharding:
+            return primary, False
+        reps = view.replicas_of(shard)
+        if not reps:
+            return primary, False
+        with self._lock:
+            loads = dict(self._target_loads)
+        target = min(
+            (primary,) + tuple(reps),
+            key=lambda o: (loads.get(o, 0), o))
+        return target, target != primary
+
+    def _note_target_load(self, target: int, n: int) -> None:
+        with self._lock:
+            self._target_loads[target] = (
+                self._target_loads.get(target, 0) + n)
+            if self._target_loads[target] > (1 << 20):
+                for k in self._target_loads:
+                    self._target_loads[k] //= 2
+
+    def _pull_once(
+        self, view, table: str, uniq: np.ndarray,
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One serving round: ``(rows, per_id_watermarks)`` — each id's
+        watermark is its shard's push watermark as carried by whichever
+        store (primary or accepted replica) served it."""
         shards = sharding.shard_of(uniq, view.num_shards)
         local = sharding.local_rows(uniq, view.num_shards)
         out = np.empty((uniq.shape[0], self.table(table).dim), np.float32)
+        wms = np.zeros(uniq.shape[0], np.int64)
         errs = []
         errs_lock = threading.Lock()
 
@@ -354,17 +595,47 @@ class EmbeddingTierClient:
             n = pad_pow2(ids_s.shape[0])
             padded = np.full((n,), -1, np.int32)
             padded[: ids_s.shape[0]] = ids_s
-            try:
-                rows = self._transport.pull(
-                    view.owner_of(shard), table, shard, padded,
-                    map_version=view.version,
-                )
-            except (StaleShardMapError, OwnerUnavailableError,
-                    faults.FaultInjected) as e:
-                with errs_lock:
-                    errs.append(e)
-                return
+            target, is_replica = self._pick_read_target(view, shard)
+            rows = wm = None
+            if is_replica:
+                with self._lock:
+                    known = int(self._owner_wm_locked(
+                        table, view.num_shards)[shard])
+                try:
+                    rows, wm = self._transport.pull(
+                        target, table, shard, padded,
+                        map_version=view.version,
+                        with_watermark=True, replica=True,
+                    )
+                    if wm + self.staleness_bound < known:
+                        # the replica is further behind the owner than
+                        # the bound allows — the primary serves, and the
+                        # lagging answer is discarded (never cached)
+                        _REPLICA_STALE.inc()
+                        rows = wm = None
+                    else:
+                        _REPLICA_READS.inc(shard=str(shard))
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected):
+                    # replica miss/death is never an error round: the
+                    # primary is the fallback within the SAME attempt
+                    rows = wm = None
+            if rows is None:
+                try:
+                    rows, wm = self._transport.pull(
+                        view.owner_of(shard), table, shard, padded,
+                        map_version=view.version, with_watermark=True,
+                    )
+                    target = view.owner_of(shard)
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected) as e:
+                    with errs_lock:
+                        errs.append(e)
+                    return
             out[sel] = rows[: ids_s.shape[0]]
+            wms[sel] = int(wm)
+            self._note_wm(table, view.num_shards, shard, int(wm))
+            self._note_target_load(target, int(ids_s.shape[0]))
 
         self._fanout([
             (lambda s=int(shard): one(s, shards == s))
@@ -377,7 +648,7 @@ class EmbeddingTierClient:
         # never pulled — skewing the imbalance signal exactly when the
         # shard-imbalance alert reads it (mid-resharding)
         self._note_shard_loads(shards, view.num_shards)
-        return out
+        return out, wms
 
     # -------------------------------------------------------------- #
     # skew telemetry (ISSUE 11)
@@ -407,12 +678,22 @@ class EmbeddingTierClient:
         p99s (a bounded window, not the job-lifetime histogram — a fresh
         owner-loss spike must not be diluted by a quiet past). Also the
         ONE place the skew gauges refresh — heartbeat/scrape cadence,
-        never per pull (the sketch's hot_share sorts its counters)."""
+        never per pull (the sketch's hot_share sorts its counters).
+
+        Latency split (ISSUE 13 bugfix): `emb_pull_p99_ms` is OWNER RPC
+        rounds only — the embedding_pull_p99 alert keeps paging on real
+        shard trouble instead of being diluted once a cache serves most
+        reads — while `emb_read_p99_ms` is the effective read the step
+        saw (cache included; pipelined reads contribute their residual
+        get() wait). The cache hit rate and pipeline depth ride along —
+        the fleet series' hot-set-migration sensor."""
         with self._lock:
             loads = (None if self._shard_loads is None
                      else self._shard_loads.copy())
             pulls = sorted(self._pull_times)
+            reads = sorted(self._read_times)
             pushes = sorted(self._push_times)
+            pipe_depth = self._pipeline_depth
         hot_share = round(self.sketch.hot_share(), 4)
         _HOT_SHARE.set(hot_share)
         out: Dict[str, float] = {"emb_hot_id_share": hot_share}
@@ -429,9 +710,16 @@ class EmbeddingTierClient:
         if pulls:
             out["emb_pull_p99_ms"] = round(
                 1e3 * quantile_sorted(pulls, 0.99), 3)
+        if reads:
+            out["emb_read_p99_ms"] = round(
+                1e3 * quantile_sorted(reads, 0.99), 3)
         if pushes:
             out["emb_push_p99_ms"] = round(
                 1e3 * quantile_sorted(pushes, 0.99), 3)
+        if self.cache is not None:
+            out["emb_cache_hit_rate"] = round(self.cache.hit_rate(), 4)
+        if pipe_depth:
+            out["emb_pipeline_depth"] = float(pipe_depth)
         return out
 
     # -------------------------------------------------------------- #
@@ -485,8 +773,26 @@ class EmbeddingTierClient:
         seq. Unacked shards are conservatively re-sent whole against a
         refreshed map (interrupted resharding, lost acks); the store's
         watermark makes re-applied shards no-ops, so the update lands
-        exactly once no matter how many rounds this takes."""
+        exactly once no matter how many rounds this takes.
+
+        With the hot-row cache on, acks carry the post-apply push
+        watermark and the pushed rows WRITE THROUGH: an entry that was
+        fresh as of the pre-push watermark (and whose shard advanced by
+        exactly our push) gets the delta applied in place — the worker's
+        own training loop keeps its hot set warm without re-pulling."""
+        # watermark acks feed BOTH fences: the cache's freshness tag and
+        # the replica-read staleness check (a replica-reading client
+        # without a cache still needs to know the owners moved on)
+        want_wm = self.cache is not None or self.read_replicas
+        prev_wm = None
+        if want_wm:
+            with self._lock:
+                prev_wm = self._owner_wm_locked(
+                    table, self._view.num_shards).copy()
+        ack_wms: Dict[int, int] = {}
+        alock = threading.Lock()
         pending = None   # shard ids still unacked (None = all)
+        view = self.view
         for attempt in range(self._max_retries + 1):
             view = self.view
             shards = sharding.shard_of(uniq, view.num_shards)
@@ -505,11 +811,16 @@ class EmbeddingTierClient:
                 padded_rows = np.zeros((n, sums.shape[1]), np.float32)
                 padded_rows[: ids_s.shape[0]] = sums[sel]
                 try:
-                    self._transport.push(
+                    ack = self._transport.push(
                         view.owner_of(shard), table, shard,
                         padded_ids, padded_rows, client_id=self.client_id,
                         seq=seq, map_version=view.version, scale=scale,
+                        with_watermark=want_wm,
                     )
+                    if want_wm:
+                        _, wm = ack
+                        with alock:
+                            ack_wms[int(shard)] = int(wm)
                 except (StaleShardMapError, OwnerUnavailableError,
                         faults.FaultInjected) as e:
                     with flock:
@@ -522,6 +833,9 @@ class EmbeddingTierClient:
             ])
             err = errbox[0] if errbox else None
             if not failed:
+                if want_wm:
+                    self._write_through(
+                        table, view, uniq, sums, scale, prev_wm, ack_wms)
                 return
             # NOTE: after a map refresh the ids of a failed shard may hash
             # to the same shard id but a NEW owner — recomputing shards
@@ -534,6 +848,27 @@ class EmbeddingTierClient:
             f"{len(pending)} unacked shard(s) after {self._max_retries} "
             "retries"
         )
+
+    def _write_through(self, table: str, view, uniq, sums, scale: float,
+                       prev_wm: np.ndarray,
+                       ack_wms: Dict[int, int]) -> None:
+        """Patch the worker's own push into its cache and advance the
+        observed watermarks. `prev_wm` may be sized for an older map
+        (refresh mid-retry re-keyed everything and dropped the cache —
+        the patch is then a no-op by construction)."""
+        if prev_wm is None or prev_wm.shape[0] != view.num_shards:
+            return
+        new_wm = prev_wm.copy()
+        for s, wm in ack_wms.items():
+            if s < new_wm.shape[0]:
+                new_wm[s] = wm
+            self._note_wm(table, view.num_shards, s, wm)
+        if self.cache is None:
+            return
+        self.cache.write_through(
+            table, np.asarray(uniq, np.int64),
+            np.asarray(scale, np.float32) * np.asarray(sums, np.float32),
+            view.num_shards, prev_wm, new_wm)
 
     def close(self) -> None:
         if self._pool is not None:
@@ -551,11 +886,126 @@ class EmbeddingTierClient:
         self.refresh()
 
 
+class EmbeddingPullPipeline:
+    """Read layer 3: overlap step N+1's deduped pull with step N's
+    compute (templated on DevicePrefetcher's depth/drain shape — the
+    host->device lookahead's tier twin).
+
+    The caller keeps up to `depth` id batches submitted ahead
+    (`submit`), and `get()` returns pulls IN SUBMIT ORDER, blocking only
+    on whatever the overlapped compute did not already cover — that
+    residual wait is the only part that still bills the goodput ledger's
+    `emb_pull_blocked` and the client's effective-read window (the
+    background pull's own wall is exempt via the billing thread-local).
+
+    One puller thread: the pulls themselves are GIL-holding numpy over
+    small deduped arrays (measured: thread fan-in LOSES in-process, see
+    EmbeddingTierClient.fanout note), so the pipeline buys pull-vs-
+    compute overlap, not pull-vs-pull parallelism.
+
+    Elasticity contract (the DevicePrefetcher `drain()` semantics): on
+    rescale/reshard the caller drains — in-flight and queued id batches
+    come BACK as host arrays to resubmit under the refreshed map — and
+    `get()` itself re-issues synchronously when a completed result was
+    pulled under a map the client has since abandoned, so a pipelined
+    step can never consume rows routed by a stale map."""
+
+    def __init__(self, client: EmbeddingTierClient, table: str,
+                 depth: int = 2):
+        if depth < 1:
+            raise ValueError("pipeline depth must be >= 1")
+        self.client = client
+        self.table = table
+        self.depth = int(depth)
+        self._pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix=f"emb-pipe-{table}")
+        self._q: "deque" = deque()     # (ids, future) in submit order
+        self._lock = threading.Lock()
+        self._closed = False
+        client._pipeline_depth = self.depth
+        _PIPE_DEPTH.set(float(self.depth))
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._q)
+
+    def submit(self, ids: np.ndarray) -> None:
+        """Queue the next batch's pull (non-blocking). The ids are
+        copied — the caller's buffer may be reused."""
+        ids = np.array(ids, np.int64, copy=True)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("pipeline is closed")
+            if len(self._q) >= self.depth:
+                raise RuntimeError(
+                    f"pipeline depth {self.depth} exceeded: get() before "
+                    "submitting further batches")
+            self._q.append((ids, self._pool.submit(self._pull, ids)))
+
+    def _pull(self, ids: np.ndarray):
+        _BILL_TLS.off = True
+        try:
+            rows, inverse, uniq = self.client.pull_unique(self.table, ids)
+            return rows, inverse, uniq, self.client.view.version
+        finally:
+            _BILL_TLS.off = False
+
+    def get(self):
+        """Next submitted batch's ``(rows, inverse, unique_ids)``,
+        blocking on the residual the compute overlap did not cover."""
+        with self._lock:
+            if not self._q:
+                raise RuntimeError("pipeline is empty: submit() first")
+            ids, fut = self._q.popleft()
+        t0 = time.perf_counter()
+        rows, inverse, uniq, version = fut.result()
+        blocked = time.perf_counter() - t0
+        _PIPE_BLOCKED_S.observe(blocked)
+        _goodput_pull(blocked)
+        self.client._note_read_time(blocked)
+        if version != self.client.view.version:
+            # pulled under a map the client has since abandoned (reshard
+            # landed between completion and consumption): re-issue under
+            # the fresh map — this one blocks for real and bills as such
+            rows, inverse, uniq = self.client.pull_unique(self.table, ids)
+        return rows, inverse, uniq
+
+    def drain(self) -> List[np.ndarray]:
+        """Rescale/reshard: hand back every queued/in-flight id batch
+        (submit order) for re-submission under the refreshed map.
+        Unstarted pulls are cancelled; the in-flight one (if any) is
+        abandoned — its result is discarded, never served."""
+        with self._lock:
+            pending = [(ids, fut) for ids, fut in self._q]
+            self._q.clear()
+        for _, fut in pending:
+            fut.cancel()
+        return [ids for ids, _ in pending]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.clear()
+        self._pool.shutdown(wait=False)
+        self.client._pipeline_depth = 0
+        _PIPE_DEPTH.set(0.0)
+
+
 def view_from_response(resp) -> Optional[sharding.ShardMapView]:
     """GetEmbeddingShardMapResponse -> ShardMapView (None when the
     master has no map yet — version 0)."""
     if not resp.version:
         return None
+    rc = int(getattr(resp, "replica_count", 0) or 0)
+    flat = list(getattr(resp, "shard_replicas", ()) or ())
+    replicas: Tuple[Tuple[int, ...], ...] = ()
+    if rc and flat:
+        replicas = tuple(
+            tuple(int(o) for o in flat[s * rc:(s + 1) * rc] if int(o) >= 0)
+            for s in range(int(resp.num_shards))
+        )
     return sharding.ShardMapView(
         version=int(resp.version),
         num_shards=int(resp.num_shards),
@@ -568,6 +1018,7 @@ def view_from_response(resp) -> Optional[sharding.ShardMapView]:
             for t in resp.tables
         ),
         resharding=bool(resp.resharding),
+        replicas=replicas,
     )
 
 
@@ -644,12 +1095,15 @@ class WorkerTierRuntime:
     push."""
 
     def __init__(self, stub, worker_id: int, checkpoint_dir: str = "",
-                 transport=None):
+                 transport=None, cache_rows: int = 0,
+                 cache_staleness: int = 1, read_replicas: bool = False,
+                 pipeline_depth: int = 0):
         from elasticdl_tpu.embedding.store import EmbeddingShardStore
 
         self._stub = stub
         self.worker_id = worker_id
         self.checkpoint_dir = checkpoint_dir
+        self.pipeline_depth = max(0, int(pipeline_depth))
         self.transport = transport if transport is not None \
             else default_transport()
         self.store = EmbeddingShardStore(worker_id)
@@ -657,11 +1111,14 @@ class WorkerTierRuntime:
         self.client = EmbeddingTierClient(
             stub_map_fetch(stub, worker_id), self.transport,
             client_id=f"worker-{worker_id}",
+            cache_rows=cache_rows, cache_staleness=cache_staleness,
+            read_replicas=read_replicas,
         )
         created = self.store.attach(self.client.view, checkpoint_dir)
         if created and self.client.view.resharding:
             confirm_reshard(
                 stub, worker_id, self.client.view.version, created)
+        self._install_replicas(self.client.view)
 
     def on_world_change(self) -> int:
         """Re-fetch the map; install shards newly assigned here (live
@@ -672,6 +1129,12 @@ class WorkerTierRuntime:
 
         old = self.client.view
         view = self.client.refresh()
+        # replica PROMOTION first (owner-death fast recovery): a shard
+        # newly mine for which I hold a replica copy becomes primary in
+        # place — rows, seq fence, and watermark move wholesale — unless
+        # a drained checkpoint is FRESHER (its watermark outranks the
+        # replica's last sync; bit-exactness beats warmth)
+        promoted = self._promote_replicas(view)
         # residency, not version delta, decides what to install: the
         # client may have refreshed mid-push-retry already, so an equal
         # version can still mean shards are missing here
@@ -682,8 +1145,9 @@ class WorkerTierRuntime:
                 (t.name, s) not in resident for t in view.tables
             )
         ]
-        if not mine:
+        if not mine and not promoted:
             self.store.adopt_version(view.version)
+            self._install_replicas(view)
             return 0
         moves = [
             sh.ShardMove(
@@ -693,7 +1157,7 @@ class WorkerTierRuntime:
                      and old.owners[s] != self.worker_id else -1),
                 dst=self.worker_id,
             )
-            for s in mine
+            for s in sorted(set(mine) | promoted)
         ]
         reshard.apply_moves(
             view, moves, self.transport,
@@ -701,7 +1165,107 @@ class WorkerTierRuntime:
             confirm=lambda v, shards: confirm_reshard(
                 self._stub, self.worker_id, v, shards),
         )
+        self._install_replicas(view)
         return len(moves)
+
+    def _promote_replicas(self, view) -> set:
+        """Promote resident replica copies of shards this view newly
+        assigns here. Returns the promoted shard ids (they still ride
+        the move/confirm round so the master's plan commits)."""
+        from elasticdl_tpu.embedding import store as store_lib
+
+        promoted = set()
+        replica_resident = set(self.store.resident_replicas())
+        if not replica_resident:
+            return promoted
+        resident = set(self.store.resident_shards())
+        for s, o in enumerate(view.owners):
+            if o != self.worker_id:
+                continue
+            for t in view.tables:
+                if (t.name, s) in resident or (t.name, s) not in replica_resident:
+                    continue
+                rep_wm = self.store.replica_watermark(t.name, s)
+                ckpt_wm = -1
+                if self.checkpoint_dir:
+                    peeked = store_lib.peek_shard_watermark(
+                        self.checkpoint_dir, t.name, s)
+                    if peeked is not None:
+                        ckpt_wm = peeked
+                if ckpt_wm > rep_wm:
+                    # the drained checkpoint saw pushes the replica
+                    # never synced: let apply_moves restore from it
+                    continue
+                self.store.promote_replica(t.name, s)
+                promoted.add(s)
+                logger.warning(
+                    "embedding shard %s/%d promoted from replica at "
+                    "watermark %d (map v%d)", t.name, s, rep_wm,
+                    view.version,
+                )
+        return promoted
+
+    def _install_replicas(self, view) -> int:
+        """Adopt this view's replica assignments: install copies for
+        shards newly replicated here (full fetch from the primary; the
+        sync loop keeps them fresh by delta), drop copies no longer
+        assigned. Best-effort — a dead primary just defers the install
+        to the next sync round."""
+        # primaries only pay the per-push delta log while the map
+        # actually carries replicas to consume it
+        self.store.set_delta_logging(
+            any(view.replicas_of(s) for s in range(view.num_shards)))
+        assigned = {
+            (t.name, s)
+            for s in view.shards_replicated_on(self.worker_id)
+            for t in view.tables
+        }
+        resident = set(self.store.resident_replicas())
+        for (table, s) in resident - assigned:
+            self.store.release_replica(table, s)
+        installed = 0
+        for (table, s) in assigned - resident:
+            try:
+                self.store.sync_replica_from(
+                    self.transport, view.owner_of(s), table, s)
+                installed += 1
+            except Exception:
+                logger.warning(
+                    "replica install %s/%d from owner %d failed; will "
+                    "retry on the next sync round", table, s,
+                    view.owner_of(s), exc_info=True,
+                )
+        return installed
+
+    def sync_replicas(self) -> int:
+        """One delta-sync round over every replica copy resident here
+        (worker run loop, task boundaries; cheap when nothing is
+        assigned). Also retries any ASSIGNED-but-missing install — a
+        replica whose primary was not up yet at assignment time lands
+        on a later round. Returns shards synced. Never raises — a dead
+        primary mid-recovery is the reshard reaction's problem, not the
+        sync loop's."""
+        view = self.client.view
+        synced = 0
+        if set(self.store.resident_replicas()) != {
+            (t.name, s)
+            for s in view.shards_replicated_on(self.worker_id)
+            for t in view.tables
+        }:
+            synced += self._install_replicas(view)
+        for (table, s) in self.store.resident_replicas():
+            if s >= len(view.owners) or view.owner_of(s) == self.worker_id:
+                continue
+            try:
+                self.store.sync_replica_from(
+                    self.transport, view.owner_of(s), table, s)
+                synced += 1
+            except Exception:
+                logger.debug(
+                    "replica sync %s/%d failed (primary down?)", table, s,
+                    exc_info=True,
+                )
+        return synced
 
     def drain(self) -> int:
         """Persist this worker's resident shards (rows + seq watermarks)
@@ -729,7 +1293,8 @@ class EmbeddingTierSession:
     which is what lets the table exceed one host's memory)."""
 
     def __init__(self, client: EmbeddingTierClient,
-                 tables: Dict[str, str], compile_cache=None):
+                 tables: Dict[str, str], compile_cache=None,
+                 pipeline_depth: int = 0):
         self.client = client
         self.tables = dict(tables)
         if compile_cache is None:
@@ -737,12 +1302,98 @@ class EmbeddingTierSession:
 
             compile_cache = cc.global_cache()
         self._cache = compile_cache
+        # pull/compute overlap (ISSUE 13 layer 3): one pipeline per
+        # table; run() keeps `pipeline_depth` batches of pulls in
+        # flight behind the current step's compute
+        self.pipeline_depth = max(0, int(pipeline_depth))
+        self._pipes: Dict[str, EmbeddingPullPipeline] = {}
 
     def pull_batch(self, batch: Dict[str, Any]) -> Dict[str, np.ndarray]:
         return {
             name: self.client.pull(name, np.asarray(batch[key]))
             for name, key in self.tables.items()
         }
+
+    def _pipe(self, name: str) -> EmbeddingPullPipeline:
+        p = self._pipes.get(name)
+        if p is None:
+            p = EmbeddingPullPipeline(
+                self.client, name, depth=self.pipeline_depth)
+            self._pipes[name] = p
+        return p
+
+    def drain_pipelines(self) -> int:
+        """Rescale/reshard hook: abandon in-flight pulls (they requeue
+        inside run(); a direct driver resubmits what this returns)."""
+        n = 0
+        for p in self._pipes.values():
+            n += len(p.drain())
+        return n
+
+    def close(self) -> None:
+        for p in self._pipes.values():
+            p.close()
+        self._pipes.clear()
+
+    def run(self, loss_fn, batches, lr: float = 0.0):
+        """Pipelined step stream: yields ``(loss, push_stats)`` per
+        batch with up to `pipeline_depth` NEXT batches' pulls in flight
+        while the current batch computes and pushes (depth 0 degrades to
+        the plain blocking `step`). If a reshard/rescale lands mid-
+        stream, get() re-issues under the fresh map — no drained batch
+        is lost and none is served stale."""
+        if self.pipeline_depth <= 0:
+            for batch in batches:
+                yield self.step(loss_fn, batch, lr)
+            return
+        it = iter(batches)
+        window: "deque" = deque()      # batches whose pulls are in flight
+
+        def _submit(batch) -> None:
+            window.append(batch)
+            for name, key in self.tables.items():
+                self._pipe(name).submit(np.asarray(batch[key]))
+
+        def _get_all():
+            # a drain_pipelines() from a rescale hook mid-run empties
+            # the pipes while `window` still holds their batches: heal
+            # by re-submitting the window IN ORDER under the (by then
+            # refreshed) map — the docstring's "no drained batch is
+            # lost" is this re-issue, not a hope
+            for name, key in self.tables.items():
+                p = self._pipe(name)
+                if len(p) != len(window):
+                    p.drain()
+                    for b in window:
+                        p.submit(np.asarray(b[key]))
+            return {name: self._pipe(name).get() for name in self.tables}
+
+        try:
+            for batch in it:           # prime the lookahead window
+                _submit(batch)
+                if len(window) >= self.pipeline_depth:
+                    break
+            for batch in it:
+                pulled = _get_all()
+                done = window.popleft()
+                # submit the NEXT batch before computing this one — the
+                # whole point: its pull rides under our compute+push
+                # (submitting after the step would serialize them)
+                _submit(batch)
+                yield self._finish_pulled(loss_fn, done, pulled, lr)
+            while window:
+                pulled = _get_all()
+                done = window.popleft()
+                yield self._finish_pulled(loss_fn, done, pulled, lr)
+        finally:
+            self.drain_pipelines()
+
+    def _finish_pulled(self, loss_fn, batch, pulled, lr: float):
+        vectors = {n: p[0] for n, p in pulled.items()}
+        inverses = {n: p[1] for n, p in pulled.items()}
+        uniq_ids = {n: p[2] for n, p in pulled.items()}
+        return self._finish_step(
+            loss_fn, batch, vectors, inverses, uniq_ids, lr)
 
     def step(self, loss_fn, batch: Dict[str, Any],
              lr: float = 0.0) -> Tuple[float, Dict[str, Dict[str, float]]]:
@@ -762,6 +1413,11 @@ class EmbeddingTierSession:
                 name, np.asarray(batch[key]))
             vectors[name], inverses[name], uniq_ids[name] = (
                 rows, inverse, uniq)
+        return self._finish_step(
+            loss_fn, batch, vectors, inverses, uniq_ids, lr)
+
+    def _finish_step(self, loss_fn, batch, vectors, inverses, uniq_ids,
+                     lr: float) -> Tuple[float, Dict[str, Dict[str, float]]]:
         loss, grads = self._grad_fn(loss_fn, vectors, batch)(
             vectors, inverses, batch)
         stats = {}

@@ -67,20 +67,23 @@ class LocalTransport:
 
     def pull(self, owner: int, table: str, shard: int,
              local_ids: np.ndarray,
-             map_version: Optional[int] = None) -> np.ndarray:
+             map_version: Optional[int] = None,
+             with_watermark: bool = False, replica: bool = False):
         faults.fire("emb.pull")
         store = self.store_of(owner)
-        return store.pull(table, shard, local_ids, map_version=map_version)
+        return store.pull(table, shard, local_ids, map_version=map_version,
+                          with_watermark=with_watermark, replica=replica)
 
     def push(self, owner: int, table: str, shard: int,
              local_ids: np.ndarray, rows: np.ndarray, *, client_id: str,
              seq: int, map_version: Optional[int] = None,
-             scale: float = 1.0) -> bool:
+             scale: float = 1.0, with_watermark: bool = False):
         faults.fire("emb.push")
         store = self.store_of(owner)
         applied = store.push(
             table, shard, local_ids, rows, client_id=client_id, seq=seq,
             map_version=map_version, scale=scale,
+            with_watermark=with_watermark,
         )
         # lost-ack injection: the store DID apply; the caller never hears
         # back and must re-send — the store's seq fence absorbs the dup
@@ -91,3 +94,17 @@ class LocalTransport:
                     shard: int) -> Dict[str, Any]:
         faults.fire("emb.fetch_shard")
         return self.store_of(owner).extract_shard(table, shard)
+
+    def shard_watermark(self, owner: int, table: str, shard: int) -> int:
+        """Watermark-only freshness probe (no rows cross the wire) —
+        what bounds a fully-cache-served client's staleness."""
+        faults.fire("emb.watermark")
+        return self.store_of(owner).shard_watermark(table, shard)
+
+    def fetch_delta(self, owner: int, table: str, shard: int,
+                    since_wm: int) -> Optional[Dict[str, Any]]:
+        """Replica sync: the primary's applied pushes past ``since_wm``
+        (watermark-tagged, contiguous) or None when its bounded delta log
+        no longer reaches back — the replica then re-copies the shard."""
+        faults.fire("emb.fetch_delta")
+        return self.store_of(owner).fetch_delta(table, shard, since_wm)

@@ -173,6 +173,10 @@ class EmbeddingState:
     version: int = 0
     num_shards: int = 0
     owners: List[int] = field(default_factory=list)
+    # shard id -> read-replica worker ids (ISSUE 13): committed beside
+    # the primaries in the same records, replayed with the same
+    # begin-without-commit rollback semantics
+    replicas: List[List[int]] = field(default_factory=list)
     tables: List[Dict[str, Any]] = field(default_factory=list)
     reshard_interrupted: bool = False
 
@@ -348,12 +352,16 @@ def replay_lines(lines: List[str]) -> ReplayResult:
             e.version = int(rec["version"])
             e.num_shards = int(rec["num_shards"])
             e.owners = [int(o) for o in rec["owners"]]
+            e.replicas = [[int(o) for o in r]
+                          for r in rec.get("replicas", [])]
             e.reshard_interrupted = False
             pending_reshard = None
         elif rtype == "emb_reshard_begin":
             pending_reshard = {
                 "version": int(rec["version"]),
                 "owners": [int(o) for o in rec["owners"]],
+                "replicas": [[int(o) for o in r]
+                             for r in rec.get("replicas", [])],
             }
         elif rtype == "emb_reshard_commit":
             e = emb()
@@ -361,6 +369,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                     and pending_reshard["version"] == int(rec["version"])):
                 e.version = pending_reshard["version"]
                 e.owners = pending_reshard["owners"]
+                e.replicas = pending_reshard["replicas"]
                 e.reshard_interrupted = False
                 pending_reshard = None
             else:

@@ -198,6 +198,7 @@ class Master:
 
             self.embedding = ShardMapOwner(
                 cfg.embedding_shards, journal=self.journal,
+                replica_count=cfg.embedding_read_replicas,
             )
             if (
                 self.journal is not None

@@ -306,6 +306,17 @@ class MasterServicer:
             shard_owners=list(view.owners),
             resharding=view.resharding,
         )
+        # read replicas ride the same response as a flat -1-padded
+        # stride of replica_count per shard (see the .proto note)
+        rc = max((len(view.replicas_of(s))
+                  for s in range(view.num_shards)), default=0)
+        if rc:
+            resp.replica_count = rc
+            flat = []
+            for s in range(view.num_shards):
+                r = list(view.replicas_of(s))
+                flat.extend(r + [-1] * (rc - len(r)))
+            resp.shard_replicas.extend(flat)
         for t in view.tables:
             resp.tables.add(
                 name=t.name, vocab=t.vocab, dim=t.dim, seed=t.seed,

@@ -188,6 +188,22 @@ def default_rules() -> List[AlertRule]:
             description="one embedding shard serves >3x the mean load — "
                         "the hot-row-cache / replica signal (ROADMAP 1)",
         ),
+        # ISSUE 13 (embedding read path): the fleet series is the WORST
+        # (minimum) reporter's recent-window hit rate, present only when
+        # a cache is actually running — no cache, no data, no page. A
+        # sustained collapse means the hot set migrated out from under
+        # the cache (campaign launch, day/night id shift): re-seed from
+        # the sketch / grow --embedding_cache_rows before owner RPC load
+        # multiplies by 1/(1-hit_rate).
+        AlertRule(
+            "embedding_cache_hit_collapse",
+            series="edl_fleet_emb_cache_hit_rate",
+            threshold=0.2, op="<", mode="avg", window_s=60.0,
+            for_s=30.0, severity="warn",
+            description="hot-row cache hit rate collapsed on at least "
+                        "one worker — hot-set migration; owner shards "
+                        "are about to absorb the uncached read load",
+        ),
         # ISSUE 12 (observability/goodput.py): the two rules that watch
         # the bill itself. Both series come from the master's
         # FleetGoodput rollup riding the fleet sampler.

@@ -67,6 +67,9 @@ _PROFILE_KEYS = (
     "phase_data_wait_ms", "phase_h2d_ms", "phase_compute_ms",
     "phase_handoff_ms", "mem_host_mb", "mem_dev_mb",
     "emb_pull_p99_ms", "emb_hot_id_share", "emb_shard_imbalance",
+    # ISSUE 13 read path: effective (cache-included) read p99, recent
+    # cache hit rate (the hot-set-migration sensor), pipeline lookahead
+    "emb_read_p99_ms", "emb_cache_hit_rate", "emb_pipeline_depth",
 )
 
 # cluster rollup gauges (master-side; docs/observability.md)

@@ -430,9 +430,14 @@ def fleet_series(health_records: List[Dict],
     - `edl_fleet_backlog_per_worker`      dispatcher todo / alive workers
     - `edl_fleet_data_wait_frac`          median fraction of step time
                                           spent blocked on input
-    - `edl_fleet_emb_pull_p99_ms`         worst client pull p99 (tier)
+    - `edl_fleet_emb_pull_p99_ms`         worst client OWNER-RPC pull p99
+    - `edl_fleet_emb_read_p99_ms`         worst effective read p99
+                                          (cache/pipeline included)
     - `edl_fleet_emb_hot_id_share`        worst hot-id traffic share
     - `edl_fleet_emb_shard_imbalance`     worst shard load imbalance
+    - `edl_fleet_emb_cache_hit_rate`      WORST (lowest) recent hot-row
+                                          cache hit rate — the hot-set
+                                          migration / collapse sensor
 
     Embedding series appear only when at least one worker's payload
     carried them (the tier is optional). Absence of a series is visible
@@ -474,6 +479,7 @@ def fleet_series(health_records: List[Dict],
         out["edl_fleet_data_wait_frac"] = round(_median(fracs), 4)
     for key, series in (
         ("emb_pull_p99_ms", "edl_fleet_emb_pull_p99_ms"),
+        ("emb_read_p99_ms", "edl_fleet_emb_read_p99_ms"),
         ("emb_hot_id_share", "edl_fleet_emb_hot_id_share"),
         ("emb_shard_imbalance", "edl_fleet_emb_shard_imbalance"),
     ):
@@ -482,6 +488,15 @@ def fleet_series(health_records: List[Dict],
             # the WORST reporter: alerting on the max is what catches one
             # melting owner in an otherwise-healthy fleet
             out[series] = round(max(vals), 4)
+    hit_rates = [v for v in (num(r, "emb_cache_hit_rate") for r in fresh)
+                 if v is not None]
+    if hit_rates:
+        # worst here is the MINIMUM: one worker whose hot set migrated
+        # out from under its cache must not hide behind the fleet's
+        # still-warm average (the embedding_cache_hit_collapse rule
+        # reads this series). Absent when no worker runs a cache — the
+        # rule sees "no data" and stays quiet, never a fake zero.
+        out["edl_fleet_emb_cache_hit_rate"] = round(min(hit_rates), 4)
     return out
 
 

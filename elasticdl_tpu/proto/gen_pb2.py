@@ -157,6 +157,17 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
     changed += _new_msg("ReportEmbeddingReshardResponse", [
         ("accepted", 1, "bool", {}),
     ])
+
+    # Read replicas (ISSUE 13): per-shard replica assignments ride the
+    # same map response, flattened row-major at `replica_count` slots
+    # per shard with -1 padding (proto3 has no repeated-of-repeated
+    # without a message per row; a flat stride keeps old workers
+    # oblivious — they skip unknown fields and read primaries only).
+    changed += _add_field(
+        msgs["GetEmbeddingShardMapResponse"], "replica_count", 6, "int32")
+    changed += _add_field(
+        msgs["GetEmbeddingShardMapResponse"], "shard_replicas", 7, "int32",
+        repeated=True)
     return changed
 
 

@@ -327,6 +327,10 @@ class CohortWorker:
             self._tier = WorkerTierRuntime(
                 self._stub, self.worker_id,
                 checkpoint_dir=self.cfg.checkpoint_dir,
+                cache_rows=self.cfg.embedding_cache_rows,
+                cache_staleness=self.cfg.embedding_cache_staleness,
+                read_replicas=self.cfg.embedding_read_replicas > 0,
+                pipeline_depth=self.cfg.embedding_pull_pipeline,
             )
             logger.info(
                 "cohort leader joined embedding tier: map v%d, %d "
@@ -1225,6 +1229,14 @@ class CohortWorker:
                 )
                 ctrl = [int(x) for x in self.ctx.broadcast_ints(leader_ctrl)]
                 op = ctrl[0]
+                if self.ctx.is_leader and self._tier is not None:
+                    # replica delta sync at the collective poll boundary
+                    # (leader-only — the tier is the leader's; cheap
+                    # no-op when this cohort replicates nothing)
+                    try:
+                        self._tier.sync_replicas()
+                    except Exception:
+                        logger.exception("embedding replica sync failed")
                 if op == OP_NOOP:
                     # jittered on the LEADER only (followers just follow
                     # the broadcast), so idle cohorts de-phase their

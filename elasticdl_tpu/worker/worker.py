@@ -1082,6 +1082,10 @@ class Worker:
             self._tier = WorkerTierRuntime(
                 self._stub, self.worker_id,
                 checkpoint_dir=self.cfg.checkpoint_dir,
+                cache_rows=self.cfg.embedding_cache_rows,
+                cache_staleness=self.cfg.embedding_cache_staleness,
+                read_replicas=self.cfg.embedding_read_replicas > 0,
+                pipeline_depth=self.cfg.embedding_pull_pipeline,
             )
             logger.info(
                 "joined embedding tier: map v%d, %d shard(s) resident",
@@ -1184,12 +1188,23 @@ class Worker:
                     logger.exception("in-place rescale failed; mesh kept")
             if self._tier is not None and self._tier_refresh_pending:
                 # resharding reaction at a clean task boundary: refetch
-                # the map, install newly-owned shards, confirm the moves
+                # the map, promote/install newly-owned shards (replica
+                # promotion first — see WorkerTierRuntime), confirm the
+                # moves, adopt new replica assignments
                 self._tier_refresh_pending = False
                 try:
                     self._tier.on_world_change()
                 except Exception:
                     logger.exception("embedding tier refresh failed")
+            elif self._tier is not None:
+                # replica delta sync rides the task boundary (cheap
+                # no-op when this worker replicates nothing): replicas
+                # stay within the staleness bound of their primaries
+                # without a dedicated thread contending with the step
+                try:
+                    self._tier.sync_replicas()
+                except Exception:
+                    logger.exception("embedding replica sync failed")
             if task.type == pb.WAIT:
                 # jittered so an idle swarm does not re-poll in phase
                 # (epoch boundaries unblock every worker at once).

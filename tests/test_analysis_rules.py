@@ -405,6 +405,99 @@ def test_per_row_tier_rpc_suppressible():
 
 
 # ------------------------------------------------------------------ #
+# EDL207 blocking-pull-with-pipeline-available
+
+
+def test_blocking_pull_with_pipeline_param_fires():
+    bad = """
+        def run(trainer, tier_client, pipeline, batches):
+            for batch in batches:
+                rows, inv, uniq = tier_client.pull_unique("u", batch["cat"])
+                state, m = trainer.train_step(state, batch)
+    """
+    fs = findings_for(bad, select={"EDL207"})
+    assert len(fs) == 1 and fs[0].rule == "EDL207"
+    assert "submit()" in fs[0].message
+
+
+def test_blocking_pull_with_pipeline_ctor_in_scope_fires():
+    bad = """
+        from elasticdl_tpu.embedding.tier import EmbeddingPullPipeline
+
+        def run(trainer, client, batches):
+            lookahead = EmbeddingPullPipeline(client, "u", depth=2)
+            for batch in batches:
+                vecs = client.pull("u", batch["cat"])     # BAD: blocking
+                state, m = trainer.train_step(state, batch)
+    """
+    assert len(findings_for(bad, select={"EDL207"})) == 1
+
+
+def test_pipelined_get_and_no_pipeline_scope_are_quiet():
+    # the sanctioned pipelined shape: get() in the loop, submit() ahead
+    good = """
+        def run(trainer, tier_client, pipeline, batches):
+            for batch in batches:
+                rows, inv, uniq = pipeline.get()
+                state, m = trainer.train_step(state, batch)
+                pipeline.submit(batch["cat"])
+    """
+    assert findings_for(good, select={"EDL207"}) == []
+    # no pipeline in scope: EDL206's sanctioned batched call stays legal
+    good2 = """
+        def run(trainer, tier_client, batches):
+            for batch in batches:
+                rows, inv, uniq = tier_client.pull_unique("u", batch["cat"])
+                state, m = trainer.train_step(state, batch)
+    """
+    assert findings_for(good2, select={"EDL207"}) == []
+
+
+def test_push_in_loop_with_pipeline_stays_legal():
+    """Writes are the step's own output — they cannot be issued ahead,
+    so a batched push next to a pipeline is the correct shape."""
+    good = """
+        def run(trainer, tier_client, pipeline, batches, grads):
+            for batch in batches:
+                rows, inv, uniq = pipeline.get()
+                state, m = trainer.train_step(state, batch)
+                tier_client.push("u", uniq, grads)
+                pipeline.submit(batch["cat"])
+    """
+    assert findings_for(good, select={"EDL207"}) == []
+
+
+def test_pipeline_scope_is_per_function_and_cold_loops_quiet():
+    """A pipeline in ANOTHER function's scope does not police this one,
+    and a non-dispatch loop is never a hot loop."""
+    good = """
+        def make(client):
+            pipeline = build_pipeline(client)
+            return pipeline
+
+        def run(trainer, tier_client, batches):
+            for batch in batches:
+                vecs = tier_client.pull("u", batch["cat"])
+                state, m = trainer.train_step(state, batch)
+
+        def warm(tier_client, pipeline, all_batches):
+            for batch in all_batches:
+                tier_client.pull("u", batch)     # no dispatch: cold loop
+    """
+    assert findings_for(good, select={"EDL207"}) == []
+
+
+def test_blocking_pull_with_pipeline_suppressible():
+    bad = """
+        def run(trainer, tier_client, pipeline, batches):
+            for batch in batches:
+                vecs = tier_client.pull("u", batch["cat"])  # edl-lint: disable=EDL207
+                state, m = trainer.train_step(state, batch)
+    """
+    assert findings_for(bad, select={"EDL207"}) == []
+
+
+# ------------------------------------------------------------------ #
 # EDL301 / EDL302 bare stub + deadlines
 
 
@@ -1144,8 +1237,9 @@ def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
-                "EDL206", "EDL301", "EDL302", "EDL303", "EDL304", "EDL305",
-                "EDL401", "EDL402", "EDL403", "EDL404", "EDL405", "EDL406"):
+                "EDL206", "EDL207", "EDL301", "EDL302", "EDL303", "EDL304",
+                "EDL305", "EDL401", "EDL402", "EDL403", "EDL404", "EDL405",
+                "EDL406"):
         assert rid in out
 
 

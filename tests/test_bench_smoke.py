@@ -172,6 +172,19 @@ def test_embedding_tier_leg_smoke(bench, monkeypatch, tmp_path):
     # (hot_id_share is a guaranteed LOWER bound, so the gate is one-sided)
     assert 0.3 < res["hot_id_share"] <= 1.0, res["hot_id_share"]
     assert res["shard_load_imbalance"] >= 1.0
+    # read path (ISSUE 13): all four layer-toggle legs ran, the cache
+    # absorbed traffic, replicas served reads, and the pipeline leg
+    # took pull-blocked time off the critical path (the >=2x / <20%
+    # gates themselves are sized for the full bench run, not the smoke)
+    rp = res["read_path"]
+    assert set(rp["legs"]) == {"off", "cache", "cache_replicas",
+                               "cache_replicas_pipeline"}, rp
+    assert rp["cache_hit_rate"] > 0, rp
+    assert rp["legs"]["cache_replicas"]["replica_reads"] > 0, rp
+    assert rp["pull_blocked_vs_off"] < 1.0, rp
+    for leg in rp["legs"].values():
+        assert leg["rows_per_sec"] > 0
+        assert leg["effective_read_rows_per_sec"] > 0
     rs = res["reshard"]
     assert rs["bit_exact"] is True, rs
     assert rs["exactly_once"] is True, rs
@@ -182,6 +195,10 @@ def test_embedding_tier_leg_smoke(bench, monkeypatch, tmp_path):
     assert rs["reshard_compile_misses"] == 0, rs
     assert rs["journal_map_consistent"] is True, rs
     assert rs["recovery_s"] > 0
+    # an in-flight pipelined pull rode the kill: consumed consistent
+    # with the committed map, and drained batches re-issued cleanly
+    assert rs["pipelined_pull_consistent_across_reshard"] is True, rs
+    assert rs["drained_batches_reissued"] is True, rs
     # the kill raised exactly one alert onset (edge-triggered), of the
     # embedding sensor pair
     al = rs["alert"]

@@ -1,0 +1,661 @@
+"""Serving-grade embedding reads (ISSUE 13): the staleness-bounded
+hot-row cache (watermark fencing, write-through, full invalidation),
+read replicas (delta sync, primary-only writes, stale rejection,
+owner-death promotion), the pull/compute overlap pipeline (ordering,
+drain/re-issue), the journal-replayed replica map, and the
+pull-vs-read latency split in tier_stats()."""
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding import sharding, tier, transport
+from elasticdl_tpu.embedding.cache import HotRowCache
+from elasticdl_tpu.embedding.store import (
+    EmbeddingShardStore,
+    StaleShardMapError,
+    load_shard_file,
+)
+from elasticdl_tpu.embedding.transport import LocalTransport
+
+SPEC = sharding.TableSpec("users", vocab=4096, dim=8, seed=3)
+
+
+def make_read_tier(num_shards=4, owners=(0, 1), replicas_per_shard=0,
+                   cache_rows=0, staleness=1, read_replicas=False,
+                   client_id="rp", sync=True):
+    assignment = sharding.assign_round_robin(num_shards, list(owners))
+    rep_map = sharding.assign_replicas(
+        assignment, list(owners), replicas_per_shard)
+    view = sharding.ShardMapView(
+        version=1, num_shards=num_shards, owners=tuple(assignment),
+        tables=(SPEC,), replicas=tuple(tuple(r) for r in rep_map),
+    )
+    tr = LocalTransport()
+    stores = {}
+    for o in owners:
+        st = EmbeddingShardStore(o, device=False)
+        st.attach(view)
+        tr.register(st)
+        stores[o] = st
+    if sync and replicas_per_shard:
+        for s in range(num_shards):
+            for rep in view.replicas_of(s):
+                stores[rep].sync_replica_from(
+                    tr, view.owner_of(s), "users", s)
+    client = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id=client_id, retry_backoff_s=0.001,
+        cache_rows=cache_rows, cache_staleness=staleness,
+        read_replicas=read_replicas,
+    )
+    return view, tr, stores, client
+
+
+def oracle_pull(tr, view, ids):
+    c = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="oracle", retry_backoff_s=0.001)
+    return c.pull("users", ids)
+
+
+# ------------------------------------------------------------------ #
+# store watermarks + delta log
+
+
+def test_push_watermark_counts_applied_pushes_and_travels():
+    view, tr, stores, client = make_read_tier(num_shards=2, owners=(0,))
+    st = stores[0]
+    assert st.shard_watermark("users", 0) == 0
+    for i in range(3):
+        client.push("users", np.array([0, 2, 4]),
+                    np.ones((3, 8), np.float32), scale=0.1)
+    assert st.shard_watermark("users", 0) == 3
+    # a duplicate (re-sent seq) does NOT bump the watermark
+    ok, wm = st.push("users", 0, np.array([0], np.int32),
+                     np.ones((1, 8), np.float32),
+                     client_id=client.client_id, seq=1,
+                     with_watermark=True)
+    assert ok is False and wm == 3
+    # the watermark rides extract/install payloads
+    payload = st.extract_shard("users", 0)
+    assert payload["wm"] == 3
+    other = EmbeddingShardStore(9, device=False)
+    other.install_shard("users", 0, payload)
+    assert other.shard_watermark("users", 0) == 3
+
+
+def test_watermark_rides_checkpoint_files(tmp_path):
+    view, tr, stores, client = make_read_tier(num_shards=2, owners=(0,))
+    client.push("users", np.array([1, 3]), np.ones((2, 8), np.float32))
+    stores[0].save(str(tmp_path))
+    payload = load_shard_file(str(tmp_path), "users", 1)
+    assert payload is not None and payload["wm"] == 1
+
+
+def test_delta_log_disabled_without_replicas_in_map():
+    """A map with no replica assignments must not buffer gradient
+    history per push — the log is pure memory/copy cost until something
+    consumes it."""
+    view, tr, stores, client = make_read_tier(num_shards=1, owners=(0,))
+    client.push("users", np.arange(4), np.ones((4, 8), np.float32))
+    sh = stores[0]._get_shard("users", 0, None)
+    assert len(sh.deltas) == 0
+    assert tr.fetch_delta(0, "users", 0, 0) is None  # full-copy path
+
+
+def test_delta_log_sync_and_gap_fallback():
+    view, tr, stores, client = make_read_tier(num_shards=1, owners=(0,))
+    primary = stores[0]
+    primary.set_delta_logging(True)
+    replica = EmbeddingShardStore(7, device=False)
+    replica.install_replica("users", 0, primary.extract_shard("users", 0))
+    tr.register(replica)
+    for i in range(4):
+        client.push("users", np.arange(6) * 1 + i,
+                    np.full((6, 8), 0.5, np.float32), scale=0.1)
+    # delta sync lands the replica exactly on the primary
+    wm = replica.sync_replica_from(tr, 0, "users", 0)
+    assert wm == 4
+    np.testing.assert_array_equal(
+        replica.extract_shard("users", 0, replica=True)["rows"],
+        primary.extract_shard("users", 0)["rows"])
+    # exactly-once seq fence traveled via the delta entries: promoting
+    # this replica dedupes a re-sent pre-sync push
+    assert replica.extract_shard("users", 0, replica=True)["applied"] \
+        == primary.extract_shard("users", 0)["applied"]
+    # a replica further behind than the bounded log triggers the full
+    # resync path (fetch_delta returns None)
+    from elasticdl_tpu.embedding import store as store_lib
+
+    stale = EmbeddingShardStore(8, device=False)
+    stale.install_replica(
+        "users", 0, {"rows": primary.extract_shard("users", 0)["rows"],
+                     "applied": {}, "wm": 0})
+    log_depth = store_lib.DELTA_LOG
+    for i in range(log_depth + 2):
+        client.push("users", np.array([2]),
+                    np.ones((1, 8), np.float32), scale=0.01)
+    assert tr.fetch_delta(0, "users", 0, 0) is None
+    wm2 = stale.sync_replica_from(tr, 0, "users", 0)
+    assert wm2 == primary.shard_watermark("users", 0)
+    np.testing.assert_array_equal(
+        stale.extract_shard("users", 0, replica=True)["rows"],
+        primary.extract_shard("users", 0)["rows"])
+
+
+def test_replica_rejects_pushes():
+    view, tr, stores, client = make_read_tier(num_shards=1, owners=(0,))
+    replica = EmbeddingShardStore(7, device=False)
+    replica.install_replica("users", 0,
+                            stores[0].extract_shard("users", 0))
+    with pytest.raises(StaleShardMapError, match="READ replica"):
+        replica.push("users", 0, np.array([0], np.int32),
+                     np.ones((1, 8), np.float32), client_id="x", seq=1)
+
+
+# ------------------------------------------------------------------ #
+# hot-row cache: staleness fencing, write-through, invalidation
+
+
+def test_cache_staleness_bound_honored_under_concurrent_pushes():
+    """The watermark fencing contract: once the client OBSERVES the
+    owner watermark past `entry_wm + bound`, the cached row is a miss —
+    a foreign writer's pushes can never be hidden past the bound."""
+    view, tr, stores, client = make_read_tier(
+        num_shards=2, owners=(0, 1), cache_rows=256, staleness=1)
+    ids = np.arange(32)
+    client.pull("users", ids)                      # cache at wm 0
+    writer = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="writer", retry_backoff_s=0.001)
+    for _ in range(3):                             # foreign pushes
+        writer.push("users", np.array([2, 4, 6]),
+                    np.ones((3, 8), np.float32), scale=0.5)
+    # the client's own push ack carries the advanced watermark: every
+    # cached row of that shard now exceeds the bound -> refetch
+    client.push("users", np.array([8]),
+                np.zeros((1, 8), np.float32), scale=1.0)
+    got = client.pull("users", ids)
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+    assert client.cache.stale_evictions > 0
+
+
+def test_cache_watermark_probe_bounds_read_only_staleness():
+    """A fully-cache-served client never touches a shard, so its
+    watermark knowledge would freeze — the probe cadence refreshes it
+    and the fence then fires."""
+    view, tr, stores, client = make_read_tier(
+        num_shards=2, owners=(0, 1), cache_rows=256, staleness=1)
+    client.wm_probe_every = 2
+    ids = np.arange(24)
+    client.pull("users", ids)
+    writer = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="w2", retry_backoff_s=0.001)
+    for _ in range(3):
+        writer.push("users", np.array([1, 2, 3]),
+                    np.ones((3, 8), np.float32), scale=0.5)
+    for _ in range(4):                 # full-hit pulls tick the probe
+        client.pull("users", ids)
+    got = client.pull("users", ids)    # post-probe: fence fires
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+
+
+def test_cache_write_through_keeps_own_pushes_warm():
+    view, tr, stores, client = make_read_tier(
+        num_shards=2, owners=(0, 1), cache_rows=256, staleness=0)
+    ids = np.arange(16)
+    client.pull("users", ids)
+    h0 = client.cache.hits
+    # single writer: our own push write-through re-tags the rows fresh
+    # even at staleness 0 — the next pull is all hits and CORRECT
+    client.push("users", ids, np.ones((16, 8), np.float32), scale=-0.5)
+    got = client.pull("users", ids)
+    assert client.cache.hits > h0
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+
+
+def test_cache_interleaved_foreign_push_drops_instead_of_patching():
+    """Write-through is only sound when OUR push was the shard's sole
+    advance; an interleaved foreign push must drop the entry, not patch
+    it fresh-but-wrong."""
+    view, tr, stores, client = make_read_tier(
+        num_shards=1, owners=(0,), cache_rows=256, staleness=0)
+    ids = np.arange(8)
+    client.pull("users", ids)
+    writer = tier.EmbeddingTierClient(
+        lambda: view, tr, client_id="w3", retry_backoff_s=0.001)
+    writer.push("users", np.array([3]),
+                np.full((1, 8), 7.0, np.float32), scale=1.0)
+    client.push("users", ids, np.ones((16 // 2, 8), np.float32),
+                scale=-0.25)
+    got = client.pull("users", ids)
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+
+
+def test_cache_invalidated_on_map_epoch_change_and_reshard_commit():
+    views = {}
+
+    def fetch():
+        return views["v"]
+
+    assignment = sharding.assign_round_robin(4, [0, 1])
+    v1 = sharding.ShardMapView(
+        version=1, num_shards=4, owners=tuple(assignment), tables=(SPEC,))
+    tr = LocalTransport()
+    for o in (0, 1):
+        st = EmbeddingShardStore(o, device=False)
+        st.attach(v1)
+        tr.register(st)
+    views["v"] = v1
+    client = tier.EmbeddingTierClient(
+        fetch, tr, client_id="inv", retry_backoff_s=0.001,
+        cache_rows=256, cache_staleness=4)
+    ids = np.arange(40)
+    client.pull("users", ids)
+    assert client.cache.stats()["resident_rows"] > 0
+    # shard-map epoch change (reshard commit bumps version the same
+    # way): refresh drops the WHOLE cache + watermark state
+    views["v"] = sharding.ShardMapView(
+        version=2, num_shards=4, owners=tuple(assignment), tables=(SPEC,))
+    for o in (0, 1):
+        tr.store_of(o).adopt_version(2)
+    client.refresh()
+    assert client.cache.stats()["resident_rows"] == 0
+    got = client.pull("users", ids)
+    np.testing.assert_allclose(got, oracle_pull(tr, views["v"], ids))
+
+
+# ------------------------------------------------------------------ #
+# replica reads
+
+
+def test_replica_reads_fan_out_and_stay_consistent():
+    """Least-loaded routing: once the primary carries more read load
+    than its replica, reads go to the replica — and serve identical
+    rows (single-shard tier makes the decision deterministic)."""
+    view, tr, stores, client = make_read_tier(
+        num_shards=1, owners=(0, 1), replicas_per_shard=1,
+        read_replicas=True)
+    assert view.replicas_of(0) == (1,)
+    counter = tier._REPLICA_READS
+    tot0 = counter.value(shard="0")
+    ids = np.arange(64)
+    got = client.pull("users", ids)      # tie -> primary, loads it
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+    assert counter.value(shard="0") == tot0
+    got = client.pull("users", ids)      # primary loaded -> replica
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+    assert counter.value(shard="0") > tot0
+
+
+def test_stale_replica_rejected_primary_serves():
+    view, tr, stores, client = make_read_tier(
+        num_shards=1, owners=(0, 1), replicas_per_shard=1,
+        read_replicas=True, staleness=1)
+    ids = np.arange(32)
+    client.pull("users", ids)
+    # advance the primary WITHOUT syncing the replica: the client's
+    # own push acks tell it the owner moved on, so a lagging replica
+    # answer must be discarded and the primary re-serve
+    for _ in range(3):
+        client.push("users", ids, np.ones((32, 8), np.float32),
+                    scale=0.25)
+    rejects0 = tier._REPLICA_STALE.value()
+    # load the primary's rolling read count so routing picks the replica
+    with client._lock:
+        client._target_loads[view.owner_of(0)] = 10_000
+    got = client.pull("users", ids)
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+    assert tier._REPLICA_STALE.value() > rejects0
+    # once the replica catches up by delta sync, it serves again
+    stores[1].sync_replica_from(tr, 0, "users", 0)
+    reads0 = tier._REPLICA_READS.value(shard="0")
+    got = client.pull("users", ids)
+    np.testing.assert_allclose(got, oracle_pull(tr, view, ids))
+    assert tier._REPLICA_READS.value(shard="0") > reads0
+
+
+def test_replica_promoted_on_owner_death_bit_exact():
+    """The ISSUE acceptance: kill the primary after a delta sync; the
+    replica holder — preferred by the re-plan — promotes its copy and
+    serves BIT-EXACT rows, seq fence included."""
+    from elasticdl_tpu.master.journal import replay_lines
+
+    owner = sharding.ShardMapOwner(4, replica_count=1)
+    owner.register_table(SPEC)
+    view = owner.bootstrap([0, 1])
+    tr = LocalTransport()
+    stores = {}
+    for o in (0, 1):
+        st = EmbeddingShardStore(o, device=False)
+        st.attach(view)
+        tr.register(st)
+        stores[o] = st
+    for s in range(4):
+        for rep in view.replicas_of(s):
+            stores[rep].sync_replica_from(tr, view.owner_of(s), "users", s)
+    client = tier.EmbeddingTierClient(
+        owner.view, tr, client_id="promo", retry_backoff_s=0.001)
+    ids = np.arange(0, 128, 3)
+    client.push("users", ids, np.full((ids.size, 8), 0.3, np.float32),
+                scale=-1.0)
+    # keep replicas synced to the last push, then kill worker 0
+    for s in range(4):
+        for rep in view.replicas_of(s):
+            stores[rep].sync_replica_from(tr, view.owner_of(s), "users", s)
+    victim = 0
+    victim_shards = view.shards_owned_by(victim)
+    expect = {
+        s: stores[victim].extract_shard("users", s)["rows"]
+        for s in victim_shards
+    }
+    tr.deregister(victim)
+    new_view, moves = owner.begin_resharding([1], dead=[victim])
+    # promotion preference: every stranded shard lands on the surviving
+    # replica holder
+    assert all(m.dst == 1 for m in moves)
+    for s in victim_shards:
+        assert new_view.owner_of(s) == 1
+        wm = stores[1].promote_replica("users", s)
+        assert wm == 1
+    owner.confirm_moves(new_view.version, [m.shard for m in moves])
+    for s in victim_shards:
+        np.testing.assert_array_equal(
+            stores[1].extract_shard("users", s)["rows"], expect[s])
+    # a pre-kill push re-sent across the promotion still dedupes (the
+    # seq fence traveled with the replica copy)
+    stores[1].adopt_version(owner.view().version)
+    assert stores[1].push(
+        "users", victim_shards[0],
+        np.array([0], np.int32), np.ones((1, 8), np.float32),
+        client_id=client.client_id, seq=1) is False
+
+
+def test_runtime_promotes_replica_and_installs_assignments(tmp_path):
+    """WorkerTierRuntime half of promotion: on_world_change prefers the
+    freshest copy (replica vs drained checkpoint by watermark) and
+    adopts new replica assignments."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import bench as bench_mod
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+    from elasticdl_tpu.proto.service import MasterStub, make_channel
+
+    m = bench_mod._et_master(str(tmp_path), 4, replicas=1)
+    try:
+        m["owner"].register_table(SPEC)
+        channel = make_channel(f"localhost:{m['port']}")
+        stub = MasterStub(channel)
+        wids = [
+            stub.RegisterWorker(
+                pb.RegisterWorkerRequest(worker_name=f"rp-{i}")).worker_id
+            for i in range(2)
+        ]
+        shared = LocalTransport()
+        runtimes = {
+            w: tier.WorkerTierRuntime(
+                stub, w, checkpoint_dir=str(tmp_path), transport=shared,
+                read_replicas=True)
+            for w in wids
+        }
+        view = runtimes[wids[0]].client.view
+        # replica assignments came over the WIRE (flat stride fields)
+        assert any(view.replicas_of(s) for s in range(4))
+        for rt in runtimes.values():
+            # the first runtime's install ran before the second store
+            # existed — the sync round picks up the deferred install
+            rt.sync_replicas()
+            assert set(rt.store.resident_replicas()) == {
+                ("users", s) for s in view.shards_replicated_on(rt.worker_id)
+            }
+        client = runtimes[wids[0]].client
+        ids = np.arange(64)
+        client.push("users", ids, np.full((64, 8), 0.2, np.float32),
+                    scale=-1.0)
+        sync_count = runtimes[wids[1]].sync_replicas()
+        assert sync_count >= len(runtimes[wids[1]].store.resident_replicas())
+        victim = wids[0]
+        survivor = wids[1]
+        expect = bench_mod._et_full_table(SPEC, view, shared)
+        runtimes[victim].drain()
+        shared.deregister(victim)
+        m["membership"].mark_dead(victim, reason="test kill")
+        promoted = runtimes[survivor].on_world_change()
+        assert promoted >= 1
+        final = m["owner"].view()
+        assert all(final.owner_of(s) == survivor for s in range(4))
+        np.testing.assert_array_equal(
+            bench_mod._et_full_table(SPEC, final, shared), expect)
+    finally:
+        m["server"].stop(None)
+        if m["journal"]._fh is not None:
+            m["journal"].close()
+
+
+# ------------------------------------------------------------------ #
+# journal: the replica map replays identically
+
+
+def test_journal_replays_replica_map_and_rollback(tmp_path):
+    from elasticdl_tpu.master.journal import (
+        ControlPlaneJournal,
+        replay_lines,
+    )
+
+    j = ControlPlaneJournal(str(tmp_path))
+    owner = sharding.ShardMapOwner(4, journal=j, replica_count=1)
+    owner.register_table(SPEC)
+    owner.bootstrap([0, 1])
+    v1 = owner.view()
+    assert any(v1.replicas_of(s) for s in range(4))
+    j.close()
+    with open(j.path) as f:
+        replay = replay_lines(f.readlines())
+    assert replay.embedding is not None
+    assert [list(r) for r in v1.replicas] == replay.embedding.replicas
+    # begin WITHOUT commit: the pending replica map rolls back with the
+    # owners (the successor re-plans; clients requeue)
+    j2 = ControlPlaneJournal(str(tmp_path))
+    owner2 = sharding.ShardMapOwner(4, journal=j2, replica_count=1)
+    owner2.restore_from_replay(j2.embedding_snapshot())
+    assert [list(r) for r in owner2.view().replicas] \
+        == replay.embedding.replicas
+    owner2.begin_resharding([1], dead=[0])
+    j2.close()
+    with open(j2.path) as f:
+        replay2 = replay_lines(f.readlines())
+    assert replay2.embedding.reshard_interrupted is True
+    assert replay2.embedding.replicas == replay.embedding.replicas
+    assert replay2.embedding.owners == [int(o) for o in v1.owners]
+
+
+# ------------------------------------------------------------------ #
+# pull pipeline
+
+
+def test_pipeline_orders_overlaps_and_drains():
+    view, tr, stores, client = make_read_tier(num_shards=2, owners=(0, 1))
+    pipe = tier.EmbeddingPullPipeline(client, "users", depth=2)
+    a, b = np.arange(16), np.arange(16, 48)
+    pipe.submit(a)
+    pipe.submit(b)
+    rows_a, inv_a, _ = pipe.get()
+    rows_b, inv_b, _ = pipe.get()
+    np.testing.assert_allclose(
+        rows_a[inv_a.reshape(-1)], oracle_pull(tr, view, a))
+    np.testing.assert_allclose(
+        rows_b[inv_b.reshape(-1)], oracle_pull(tr, view, b))
+    with pytest.raises(RuntimeError, match="empty"):
+        pipe.get()
+    pipe.submit(a)
+    pipe.submit(b)
+    with pytest.raises(RuntimeError, match="depth"):
+        pipe.submit(a)
+    drained = pipe.drain()
+    assert [d.tolist() for d in drained] == [a.tolist(), b.tolist()]
+    pipe.submit(a)                      # resubmission after drain works
+    rows, inv, _ = pipe.get()
+    np.testing.assert_allclose(
+        rows[inv.reshape(-1)], oracle_pull(tr, view, a))
+    pipe.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        pipe.submit(a)
+
+
+def test_pipeline_reissues_when_map_changed_between_pull_and_get():
+    """A completed background pull from an ABANDONED map version is
+    never served: get() re-pulls under the fresh map."""
+    views = {}
+    assignment = sharding.assign_round_robin(2, [0, 1])
+    v1 = sharding.ShardMapView(
+        version=1, num_shards=2, owners=tuple(assignment), tables=(SPEC,))
+    tr = LocalTransport()
+    for o in (0, 1):
+        st = EmbeddingShardStore(o, device=False)
+        st.attach(v1)
+        tr.register(st)
+    views["v"] = v1
+    client = tier.EmbeddingTierClient(
+        lambda: views["v"], tr, client_id="pr", retry_backoff_s=0.001)
+    pipe = tier.EmbeddingPullPipeline(client, "users", depth=1)
+    ids = np.arange(24)
+    pipe.submit(ids)
+    _ = pipe._q[0][1].result()          # background pull completed at v1
+    views["v"] = sharding.ShardMapView(
+        version=2, num_shards=2, owners=tuple(assignment), tables=(SPEC,))
+    for o in (0, 1):
+        tr.store_of(o).adopt_version(2)
+    client.refresh()
+    rows, inv, _ = pipe.get()           # re-issued under v2
+    np.testing.assert_allclose(
+        rows[inv.reshape(-1)], oracle_pull(tr, views["v"], ids))
+    pipe.close()
+
+
+def test_session_run_pipelined_matches_blocking_steps():
+    """EmbeddingTierSession.run with a pipeline produces the same
+    losses and the same final table as the blocking step-by-step path.
+    Batches use DISJOINT id ranges: a pipelined pull is by design up to
+    `pipeline_depth` pushes stale (the convergence tradeoff
+    docs/performance.md documents), so only non-overlapping batches are
+    bitwise-comparable across the two schedules."""
+    batches = [{"cat": np.arange(i * 64, i * 64 + 32)} for i in range(6)]
+
+    def loss_fn(vectors, inverses, batch):
+        import jax.numpy as jnp
+
+        emb = vectors["users"][inverses["users"]]
+        return jnp.mean(emb * emb)
+
+    def run(depth):
+        view, tr, stores, client = make_read_tier(
+            num_shards=2, owners=(0, 1), client_id=f"sess{depth}")
+        sess = tier.EmbeddingTierSession(
+            client, {"users": "cat"}, pipeline_depth=depth)
+        losses = [loss for loss, _ in sess.run(loss_fn, batches, lr=0.1)]
+        sess.close()
+        table = np.zeros((SPEC.vocab, SPEC.dim), np.float32)
+        for s in range(view.num_shards):
+            rows = tr.store_of(view.owners[s]).extract_shard(
+                "users", s)["rows"]
+            idx = np.arange(s, SPEC.vocab, view.num_shards)
+            table[idx] = rows[: len(idx)]
+        return losses, table
+
+    losses_blocking, table_blocking = run(0)
+    losses_piped, table_piped = run(2)
+    np.testing.assert_allclose(losses_blocking, losses_piped, rtol=1e-6)
+    np.testing.assert_allclose(table_blocking, table_piped, atol=1e-6)
+
+
+# ------------------------------------------------------------------ #
+# tier_stats latency split (the ISSUE 13 bugfix)
+
+
+def test_tier_stats_splits_owner_pull_from_effective_read():
+    view, tr, stores, client = make_read_tier(
+        num_shards=2, owners=(0, 1), cache_rows=512, staleness=4)
+    ids = np.arange(64)
+    client.pull("users", ids)           # cold: owner round recorded
+    owner_rounds = len(client._pull_times)
+    for _ in range(3):                  # warm: cache-served, NO owner RPC
+        client.pull("users", ids)
+    stats = client.tier_stats()
+    assert "emb_pull_p99_ms" in stats and "emb_read_p99_ms" in stats
+    # cache-served pulls must not add owner-RPC samples (the alert's
+    # series is undiluted) but DO land in the effective-read window
+    assert len(client._pull_times) == owner_rounds
+    assert len(client._read_times) == 4
+    assert stats["emb_cache_hit_rate"] > 0
+    # a pipeline advertises its lookahead through the same payload
+    pipe = tier.EmbeddingPullPipeline(client, "users", depth=3)
+    assert client.tier_stats()["emb_pipeline_depth"] == 3.0
+    pipe.close()
+    assert "emb_pipeline_depth" not in client.tier_stats()
+
+
+def test_fleet_series_carries_cache_hit_rate_min():
+    from elasticdl_tpu.observability.timeseries import fleet_series
+
+    now = 100.0
+    records = [
+        {"updated_at": now, "emb_cache_hit_rate": 0.9,
+         "emb_read_p99_ms": 2.0},
+        {"updated_at": now, "emb_cache_hit_rate": 0.1,
+         "emb_read_p99_ms": 9.0},
+    ]
+    out = fleet_series(records, now=now)
+    # worst reporter: MIN for hit rate (collapse sensor), MAX for p99
+    assert out["edl_fleet_emb_cache_hit_rate"] == 0.1
+    assert out["edl_fleet_emb_read_p99_ms"] == 9.0
+    # absent when nobody runs a cache — the alert rule sees no-data
+    out2 = fleet_series([{"updated_at": now}], now=now)
+    assert "edl_fleet_emb_cache_hit_rate" not in out2
+
+
+def test_config_read_path_flags_validate():
+    from elasticdl_tpu.common.config import JobConfig
+
+    MD = "mnist.mnist_cnn.custom_model"
+    cfg = JobConfig(model_def=MD, embedding_shards=4,
+                    embedding_cache_rows=1024,
+                    embedding_cache_staleness=4,
+                    embedding_read_replicas=1,
+                    embedding_pull_pipeline=2)
+    cfg.validate()
+    with pytest.raises(ValueError, match="cache_rows"):
+        JobConfig(model_def=MD, embedding_cache_rows=-1).validate()
+    with pytest.raises(ValueError, match="staleness"):
+        JobConfig(model_def=MD, embedding_shards=4,
+                  embedding_cache_staleness=-1).validate()
+    with pytest.raises(ValueError, match="requires the tier"):
+        JobConfig(model_def=MD, embedding_read_replicas=1).validate()
+    with pytest.raises(ValueError, match="pull_pipeline"):
+        JobConfig(model_def=MD, embedding_shards=2,
+                  embedding_pull_pipeline=-1).validate()
+    with pytest.raises(ValueError, match="capacity_rows"):
+        HotRowCache(0)
+    # the cache requires the deduping client (write-through and the
+    # slot store assume sorted-unique streams)
+    view, tr, _stores, _c = make_read_tier()
+    with pytest.raises(ValueError, match="dedupe"):
+        tier.EmbeddingTierClient(
+            lambda: view, tr, client_id="nd", dedupe=False, cache_rows=16)
+
+
+def test_cache_lru_eviction_at_capacity():
+    cache = HotRowCache(capacity_rows=8, staleness_bound=4)
+    wm = np.zeros(1, np.int64)
+    ids1 = np.arange(8)
+    cache.insert("t", 64, 4, ids1, np.ones((8, 4), np.float32),
+                 np.zeros(8, np.int64))
+    cache.lookup("t", 64, 4, ids1[:4], wm, 1)      # touch 0-3
+    ids2 = np.arange(8, 12)
+    cache.insert("t", 64, 4, ids2, np.ones((4, 4), np.float32),
+                 np.zeros(4, np.int64))
+    hit, _ = cache.lookup("t", 64, 4, ids1[:4], wm, 1)
+    assert hit.all()                    # recently-touched survived
+    hit2, _ = cache.lookup("t", 64, 4, ids2, wm, 1)
+    assert hit2.all()                   # new entries resident
+    assert cache.stats()["resident_rows"] == 8
