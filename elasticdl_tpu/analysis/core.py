@@ -193,6 +193,7 @@ def all_rules() -> List[Rule]:
     """Every registered rule (importing the rule modules registers them)."""
     # imported lazily so `core` has no import cycle with the rule modules
     from elasticdl_tpu.analysis import (  # noqa: F401
+        elasticity_rules,
         jax_rules,
         locks,
         observability_rules,

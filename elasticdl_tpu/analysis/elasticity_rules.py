@@ -1,0 +1,140 @@
+"""EDL5xx: elasticity / closed-loop-autoscaler discipline.
+
+EDL501 rescale-action-outside-policy
+    A direct instance-manager resize/evict call — `.add_worker()`,
+    `.remove_worker(...)`, `.evict_worker(...)`, or
+    `.kill_worker(..., relaunch=False)` (the permanent-eviction
+    spelling) — outside the sanctioned modules: the autoscaler policy
+    engine (master/autoscaler.py), the operator entry points
+    (client/local.py, client/api.py), and the manager implementations
+    themselves. ISSUE 14 made every rescale decision cost-gated,
+    cooldown-bounded, and journal-replayed; an ad-hoc call site
+    bypasses all three at once — it can flap against the policy's own
+    actions, double-fire after a master restart (nothing journaled it),
+    and spend recovery cost the goodput ledger attributes to nobody.
+    Route the action through `Autoscaler`/its target adapters, or carry
+    a reviewed `# edl-lint: disable=EDL501` with justification.
+
+    Receiver gating (so unrelated `.add_worker` methods stay quiet):
+    the call's receiver must be manager-ish — a name (or attribute)
+    matching `manager`/`mgr`, or a local name assigned from a
+    `ProcessManager(...)` / `K8sInstanceManager(...)` construction in
+    the same module. `kill_worker` with `relaunch=True` (or omitted) is
+    the chaos/test hook — an in-place relaunch, not a resize — and is
+    not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator, Set
+
+from elasticdl_tpu.analysis.core import Finding, ModuleContext, Rule, register
+
+#: always resize/evict, whatever the arguments
+_RESIZE_METHODS = {"add_worker", "remove_worker", "evict_worker"}
+
+#: the manager classes whose constructions track receivers
+_MANAGER_CLASSES = {"ProcessManager", "K8sInstanceManager"}
+
+#: modules where direct calls are the sanctioned path: the policy
+#: engine, the operator entry points, and the implementations
+_ALLOWED_SUFFIXES = (
+    "master/autoscaler.py",
+    "master/process_manager.py",
+    "master/k8s_instance_manager.py",
+    "client/local.py",
+    "client/api.py",
+)
+
+_MANAGERISH = re.compile(r"(manager|mgr)", re.IGNORECASE)
+
+
+def _receiver_name(expr: ast.AST) -> str:
+    """The receiver's trailing name: `manager` -> manager,
+    `self.instance_manager` -> instance_manager, `a.b.mgr` -> mgr."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return ""
+
+
+def _is_manager_construction(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name in _MANAGER_CLASSES
+
+
+def _relaunch_false(call: ast.Call) -> bool:
+    """kill_worker's eviction spelling: relaunch=False, literally."""
+    for kw in call.keywords:
+        if kw.arg == "relaunch" and isinstance(kw.value, ast.Constant):
+            return kw.value.value is False
+    # positional: kill_worker(wid, False, ...)
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        return call.args[1].value is False
+    return False
+
+
+@register
+class RescaleActionOutsidePolicyRule(Rule):
+    id = "EDL501"
+    name = "rescale-action-outside-policy"
+    doc = (
+        "direct instance-manager resize/evict call outside the "
+        "autoscaler policy / client entry points — bypasses the cost "
+        "gate, cooldown, and decision journal"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.rel_path.endswith(_ALLOWED_SUFFIXES):
+            return
+        tracked = self._constructed_managers(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            method = node.func.attr
+            if method in _RESIZE_METHODS:
+                evictish = True
+            elif method == "kill_worker" and _relaunch_false(node):
+                evictish = True
+            else:
+                evictish = False
+            if not evictish:
+                continue
+            recv = _receiver_name(node.func.value)
+            if not (
+                recv in tracked
+                or _MANAGERISH.search(recv)
+                or _is_manager_construction(node.func.value)
+            ):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"direct {method}() on an instance manager bypasses the "
+                "autoscaler's cost gate, cooldown, and decision journal; "
+                "route the rescale through master/autoscaler.py (or carry "
+                "a reviewed disable)",
+            )
+
+    @staticmethod
+    def _constructed_managers(ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _is_manager_construction(
+                node.value
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+        return names

@@ -103,6 +103,32 @@ def run_local(
             manager.request_flight_dump(worker_id)
 
     master.health.add_hook(_offender_flight_hook)
+    # Closed-loop autoscaler (--autoscale): the ACTION surface lives
+    # here — only the launcher owns worker processes. EDL501 allowlists
+    # exactly this wiring (plus the autoscaler module itself): every
+    # other resize path must go through the policy so cooldown and
+    # journaling cannot be bypassed.
+    if master.autoscaler is not None:
+        from elasticdl_tpu.master.autoscaler import ProcessManagerTarget
+
+        autoscale_target = ProcessManagerTarget(
+            manager, servicer=master.servicer,
+            membership=master.membership,
+        )
+        master.autoscaler.bind_target(autoscale_target)
+        # measured re-formation durations feed the cost model's EWMA —
+        # the bench-seeded estimate converges to THIS deployment's real
+        # recovery cost. The lambda reads the `master` LOCAL by
+        # reference (reassigned on --master_restarts recovery), so a
+        # successor's cost model keeps receiving observations; capturing
+        # the autoscaler by value would feed the dead predecessor's EWMA
+        # forever while the live gate ran on the static seed.
+        manager.add_reform_observer(
+            lambda seconds, old, new:
+                master.autoscaler.cost.observe_recovery(seconds)
+        )
+    else:
+        autoscale_target = None
     master.start()
     manager.start_workers()
     deadline = time.time() + timeout_s if timeout_s else None
@@ -133,6 +159,18 @@ def run_local(
                     lambda m=master: m.servicer.request_checkpoint(0),
                     journal=master.journal,
                 )
+                if master.autoscaler is not None and autoscale_target:
+                    # the successor's policy engine replayed its cooldown/
+                    # budget state from the journal; rebind the action
+                    # surface (manager survives, servicer/membership
+                    # moved). The reform observer needs no re-pointing —
+                    # it closes over this function's `master`, which was
+                    # just reassigned to the successor.
+                    autoscale_target.rebind(
+                        servicer=master.servicer,
+                        membership=master.membership,
+                    )
+                    master.autoscaler.bind_target(autoscale_target)
                 master.start()
     finally:
         # final fleet rollup before teardown (ClusterHealth.update never

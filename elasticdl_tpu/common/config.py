@@ -157,6 +157,39 @@ class JobConfig:
     # shard imbalance), "off" = disabled, else a path to a JSON list of
     # rule objects (see docs/observability.md "Alert rules").
     alert_rules: str = ""
+    # Straggler-scorer quorum (observability/health.py ClusterHealth):
+    # minimum workers with fresh telemetry before robust-z scoring runs.
+    # Floor 2 — a 2-worker fleet can still flag a straggler through the
+    # min_ratio gate; below that "who is slow" is undecidable. The old
+    # hard-coded 3 is the default.
+    straggler_quorum: int = 3
+
+    # --- closed-loop autoscaler (master/autoscaler.py; ROADMAP 3) ---
+    # false (default) = every rescale stays human-initiated (the
+    # pre-autoscaler behavior; also the way to DISABLE the loop). true =
+    # the master turns health signals into journaled, fenced rescale
+    # actions: evict confirmed stragglers (drain-first), grow on
+    # sustained dispatcher backlog, shrink when data_wait dominates.
+    autoscale: bool = False
+    # world bounds the policy may move within (max 0 = unbounded)
+    autoscale_min_workers: int = 1
+    autoscale_max_workers: int = 0
+    # minimum seconds between APPLIED actions (anti-flap; inherited
+    # across master restarts via the journal's autoscale records)
+    autoscale_cooldown_s: float = 120.0
+    # hysteresis: a signal must persist this long before it is acted on
+    autoscale_hold_s: float = 30.0
+    # per-job action budget — the blast-radius cap; once spent, every
+    # further decision suppresses with `budget_exhausted`
+    autoscale_actions_max: int = 8
+    # cost-model seed: projected per-worker rescale cost in seconds.
+    # Seed it from YOUR deployment's measured `bench.py rescale`
+    # `time_to_recovery_s` (bench-baselines/bench-rescale.json); the
+    # model then updates online from observed re-formation durations.
+    autoscale_rescale_cost_s: float = 10.0
+    # horizon the projected goodput gain accrues over: an action is
+    # taken only when gain(horizon) > rescale_cost x world
+    autoscale_horizon_s: float = 300.0
 
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
@@ -367,6 +400,44 @@ class JobConfig:
             # a ring shorter than any alert window is a rule engine
             # evaluating over nothing; fail at submit time
             raise ValueError("timeseries_samples must be >= 8")
+        if self.straggler_quorum < 2:
+            # with 1 reporter the median IS the reporter and scoring is
+            # vacuous; 2 works through the min_ratio gate (the satellite
+            # unlock for 2-worker fleets)
+            raise ValueError("straggler_quorum must be >= 2")
+        if self.autoscale:
+            if self.autoscale_min_workers < 1:
+                raise ValueError("autoscale_min_workers must be >= 1")
+            if (self.autoscale_max_workers
+                    and self.autoscale_max_workers
+                    < self.autoscale_min_workers):
+                raise ValueError(
+                    "autoscale_max_workers must be 0 (unbounded) or >= "
+                    "autoscale_min_workers")
+            if self.autoscale_cooldown_s < 0:
+                raise ValueError("autoscale_cooldown_s must be >= 0")
+            if self.autoscale_hold_s < 0:
+                raise ValueError("autoscale_hold_s must be >= 0")
+            if self.autoscale_actions_max < 1:
+                raise ValueError(
+                    "autoscale_actions_max must be >= 1 (use "
+                    "--autoscale false to disable the loop)")
+            if self.autoscale_rescale_cost_s <= 0:
+                raise ValueError(
+                    "autoscale_rescale_cost_s must be > 0 (seed it from "
+                    "bench.py rescale's time_to_recovery_s)")
+            if self.autoscale_horizon_s <= 0:
+                raise ValueError("autoscale_horizon_s must be > 0")
+            if not self.checkpoint_dir:
+                # decisions are journaled and replayed at takeover; a
+                # journal-less autoscaler would re-fire after every
+                # master restart — the same reason master_restarts
+                # requires a checkpoint_dir
+                raise ValueError(
+                    "autoscale requires checkpoint_dir: decisions are "
+                    "journaled under <checkpoint_dir>/control/ and "
+                    "replayed at master takeover"
+                )
         if self.master_restarts > 0 and not self.checkpoint_dir:
             # a journal-less successor rebuilds the dispatcher from scratch
             # — every already-finished task would be recreated and re-run,

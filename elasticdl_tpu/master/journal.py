@@ -25,6 +25,12 @@ mutation order):
     stop_training                      — dispatcher task lifecycle
     member_join / member_death         — membership transitions
     world_version                      — cohort world-version bumps
+    autoscale                          — every closed-loop rescale decision
+                                         (master/autoscaler.py), APPLIED and
+                                         SUPPRESSED alike; applied actions
+                                         replay into AutoscaleState so a
+                                         restarted master inherits cooldown
+                                         and budget instead of re-firing
     emb_table / emb_shard_map /
     emb_reshard_begin / emb_reshard_commit
                                        — embedding tier shard-map
@@ -182,6 +188,23 @@ class EmbeddingState:
 
 
 @dataclass
+class AutoscaleState:
+    """Replayed closed-loop autoscaler state (master/autoscaler.py
+    restores from this). The invariant: `last_action_ts` (wall clock —
+    the only clock that survives a process restart) and
+    `actions_applied` reflect every APPLIED action ever journaled, so a
+    successor master inherits the cooldown window and the spent action
+    budget instead of immediately re-firing on the same signal its
+    predecessor just acted on. Suppressed decisions replay into
+    `records` only — they are forensic, not state."""
+
+    actions_applied: int = 0
+    last_action_ts: float = 0.0
+    by_kind: Dict[str, int] = field(default_factory=dict)
+    records: int = 0
+
+
+@dataclass
 class ReplayResult:
     prior_generation: int = 0
     records: int = 0
@@ -190,6 +213,7 @@ class ReplayResult:
     membership: Optional[MembershipState] = None
     world_version: int = 0
     embedding: Optional[EmbeddingState] = None
+    autoscale: Optional[AutoscaleState] = None
 
 
 def _replay_dispatcher(
@@ -283,6 +307,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     dispatcher: Optional[DispatcherState] = None
     membership: Optional[MembershipState] = None
     embedding: Optional[EmbeddingState] = None
+    autoscale: Optional[AutoscaleState] = None
     # an emb_reshard_begin whose commit has not replayed yet:
     # {"version": v, "owners": [...]} — promoted to the committed map by
     # emb_reshard_commit, rolled back (reshard_interrupted) at the end
@@ -298,6 +323,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
 
     def apply(rec: Dict[str, Any]) -> None:
         nonlocal dispatcher, membership, embedding, pending_reshard
+        nonlocal autoscale
         rtype = rec["t"]
         result.records += 1
         if rtype == "header":
@@ -309,6 +335,8 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                 membership = MembershipState(**rec["membership"])
             if rec.get("embedding") is not None:
                 embedding = EmbeddingState(**rec["embedding"])
+            if rec.get("autoscale") is not None:
+                autoscale = AutoscaleState(**rec["autoscale"])
             result.world_version = int(rec.get("world_version", 0))
         elif rtype in _DISPATCHER_RECORDS:
             if dispatcher is None:
@@ -339,6 +367,17 @@ def replay_lines(lines: List[str]) -> ReplayResult:
             membership.version = max(membership.version, int(rec.get("version", 0)))
         elif rtype == "world_version":
             result.world_version = max(result.world_version, int(rec["version"]))
+        elif rtype == "autoscale":
+            if autoscale is None:
+                autoscale = AutoscaleState()
+            autoscale.records += 1
+            if rec.get("decision") == "applied":
+                autoscale.actions_applied += 1
+                autoscale.last_action_ts = max(
+                    autoscale.last_action_ts, float(rec.get("ts") or 0.0)
+                )
+                kind = str(rec.get("kind", "?"))
+                autoscale.by_kind[kind] = autoscale.by_kind.get(kind, 0) + 1
         elif rtype == "emb_table":
             e = emb()
             if not any(t["name"] == rec["name"] for t in e.tables):
@@ -463,6 +502,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     result.dispatcher = dispatcher
     result.membership = membership
     result.embedding = embedding
+    result.autoscale = autoscale
     return result
 
 
@@ -687,6 +727,7 @@ class ControlPlaneJournal:
                 self.replay.dispatcher is not None
                 or self.replay.membership is not None
                 or self.replay.embedding is not None
+                or self.replay.autoscale is not None
                 or self.replay.world_version
             ):
                 f.write(json.dumps({
@@ -702,6 +743,10 @@ class ControlPlaneJournal:
                     "embedding": (
                         asdict(self.replay.embedding)
                         if self.replay.embedding is not None else None
+                    ),
+                    "autoscale": (
+                        asdict(self.replay.autoscale)
+                        if self.replay.autoscale is not None else None
                     ),
                     "world_version": self.replay.world_version,
                 }) + "\n")
@@ -731,6 +776,11 @@ class ControlPlaneJournal:
         if self.replay is None:
             return None
         return self.replay.embedding
+
+    def autoscale_snapshot(self) -> Optional[AutoscaleState]:
+        if self.replay is None:
+            return None
+        return self.replay.autoscale
 
     @property
     def world_version(self) -> int:

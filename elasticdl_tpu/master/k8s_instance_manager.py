@@ -398,7 +398,15 @@ class K8sInstanceManager:
             )
 
     def all_failed(self) -> bool:
+        # DELETED (deliberately removed/evicted — K8sInstanceTarget's
+        # eviction path lands here) and SUCCEEDED pods are retirements,
+        # not failures: excluded, or one eviction pins this False while
+        # the rest of the fleet dies (process_manager.all_failed's twin)
         with self._lock:
-            return bool(self._status) and all(
-                s == PodStatus.FAILED for s in self._status.values()
+            tracked = [
+                s for s in self._status.values()
+                if s not in (PodStatus.DELETED, PodStatus.SUCCEEDED)
+            ]
+            return bool(tracked) and all(
+                s == PodStatus.FAILED for s in tracked
             )

@@ -150,7 +150,12 @@ class Master:
         # — the seam where elasticity decisions will plug in.
         from elasticdl_tpu.observability.health import ClusterHealth
 
-        self.health = ClusterHealth(self.membership)
+        # --straggler_quorum (floor 2, validated at boot): a 2-worker
+        # fleet can flag its straggler through the min_ratio gate; the
+        # old hard-coded 3 stays the default
+        self.health = ClusterHealth(
+            self.membership, min_workers=cfg.straggler_quorum,
+        )
         # the PR 6 straggler hook's first real consumer: onset cuts the
         # MASTER's black box (fleet view, journal state, recent control-
         # plane events at the moment the fleet went ragged). The OFFENDER
@@ -185,6 +190,23 @@ class Master:
         from elasticdl_tpu.observability.goodput import FleetGoodput
 
         self.goodput = FleetGoodput(self.membership, self.dispatcher)
+
+        # Closed-loop autoscaler (ISSUE 14, master/autoscaler.py): turns
+        # the two decision seams above — ClusterHealth straggler onsets
+        # and the backlog/data-wait alert rules — into journaled, fenced
+        # rescale actions, evaluated on the wait poll below. None when
+        # --autoscale is off (the default: rescales stay human-
+        # initiated). The ACTION surface binds later: client/local.py
+        # wires the ProcessManagerTarget (only the launcher owns worker
+        # processes); start() wires the k8s flavor. Until a target is
+        # bound every decision suppresses — journaled — with no_target.
+        from elasticdl_tpu.master import autoscaler as autoscaler_lib
+
+        self.autoscaler = autoscaler_lib.from_config(
+            cfg, journal=self.journal,
+        )
+        if self.autoscaler is not None:
+            self.autoscaler.subscribe(health=self.health, alerts=self.alerts)
 
         # Elastic sharded embedding tier (ROADMAP 1): the master owns the
         # id-sharded table map, durable through the same journal as task
@@ -268,6 +290,9 @@ class Master:
                 if hasattr(cb, "on_job_end"):
                     self.dispatcher.add_job_end_callback(cb.on_job_end)
             logger.info("wired %d zoo callback(s)", len(callbacks))
+        # a completed eviction (or any death) prunes the sticky drain-
+        # handshake bit — a revived worker id must not inherit it
+        self.membership.add_death_callback(self.servicer.clear_evict)
         add_master_servicer(self.server, self.servicer)
 
     def _release_on_bind_failure(self) -> None:
@@ -319,6 +344,15 @@ class Master:
                 job_finished_fn=self.dispatcher.finished,
             )
             self.instance_manager.start_workers()
+            if self.autoscaler is not None:
+                # master-owned pods: the action surface binds here (the
+                # local-subprocess flavor binds in client/local.py)
+                from elasticdl_tpu.master.autoscaler import K8sInstanceTarget
+
+                self.autoscaler.bind_target(K8sInstanceTarget(
+                    self.instance_manager, servicer=self.servicer,
+                    membership=self.membership,
+                ))
         if self.evaluation is not None and self.cfg.job_type == JobType.EVALUATION_ONLY:
             self.evaluation.trigger(0)
 
@@ -379,6 +413,12 @@ class Master:
             # snapshots too, so chaos artifacts (and the incident CLI
             # reading them) carry the incident's bill
             "goodput": self.goodput.snapshot(),
+            # the closed-loop rescale policy's state (budget, cooldown,
+            # last decision); absent key = autoscaler off
+            **(
+                {"autoscale": self.autoscaler.snapshot()}
+                if self.autoscaler is not None else {}
+            ),
         }
 
     def _fleet_series(self) -> dict:
@@ -433,6 +473,11 @@ class Master:
             # flight-ring dump on page severity. Neither ever raises.
             self.timeseries.maybe_sample(extra_fn=self._fleet_series)
             self.alerts.evaluate()
+            if self.autoscaler is not None:
+                # the decision pass: pending signals (recorded by the
+                # hooks above) -> at most one journaled, cost-gated,
+                # cooldown-bounded rescale action. Never raises.
+                self.autoscaler.evaluate()
             if self.summary is not None:
                 # control-plane metrics ride the summary stream (rate-
                 # limited inside; never raises)

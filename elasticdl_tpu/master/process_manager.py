@@ -67,6 +67,10 @@ class _WorkerProc:
     # its death must trigger a downsized re-formation, not an in-place
     # relaunch that would just die again
     no_relaunch: bool = False
+    # deliberately evicted by policy (master/autoscaler.py): its exit is
+    # an expected retirement (status DELETED), never a failure that
+    # counts toward all_failed() or burns a relaunch
+    evicted: bool = False
 
 
 class ProcessManager:
@@ -148,6 +152,10 @@ class ProcessManager:
         # file (workers adopt it) and onto every reform.* span this manager
         # opens, so master + workers share a timeline per resize
         self._reform_trace_id: Optional[str] = None   # guarded_by: _lock
+        # observer for measured re-formation durations (the autoscaler's
+        # cost model subscribes — client/local.py wires it); best-effort,
+        # called OUTSIDE the lock with (seconds, old_size, new_size)
+        self._reform_observers: List = []
 
     @property
     def _cohort_mode(self) -> bool:
@@ -338,6 +346,42 @@ class ProcessManager:
         )
         return target
 
+    def add_reform_observer(self, cb) -> None:
+        """cb(seconds, old_size, new_size) after every completed cohort
+        re-formation — the autoscaler's cost model feeds its rescale-cost
+        EWMA from this. Registration-before-start contract."""
+        self._reform_observers.append(cb)
+
+    def _notify_reform(self, seconds: float, old: int, new: int) -> None:
+        for cb in self._reform_observers:
+            try:
+                cb(seconds, old, new)
+            except Exception:
+                logger.exception("reform observer %r failed (ignored)", cb)
+
+    def evict_worker(self, worker_id: int) -> bool:
+        """Policy eviction of a PLAIN worker (master/autoscaler.py; the
+        cohort flavor is remove_worker's drain-first resize). Marks the
+        slot never-relaunch and DELETED-on-exit — the worker itself
+        drains through the heartbeat `evict` bit and exits EX_TEMPFAIL;
+        this side only ensures the exit retires the slot instead of
+        respawning it, and that a deliberate eviction never reads as a
+        failure (all_failed must stay false). No signal is sent here:
+        the drain handshake is the servicer's, and killing the process
+        would throw away exactly the records the drain retires."""
+        with self._lock:
+            wp = self._procs.get(worker_id)
+            if wp is None or wp.proc.poll() is not None:
+                return False
+            wp.no_relaunch = True
+            wp.evicted = True
+            wp.relaunches = self.cfg.relaunch_max + 1
+        logger.warning(
+            "worker %d marked evicted (policy): drains via the heartbeat "
+            "evict bit, exit retires the slot", worker_id,
+        )
+        return True
+
     def kill_worker(
         self, worker_id: int, relaunch: bool = True, graceful: bool = False
     ) -> bool:
@@ -381,6 +425,23 @@ class ProcessManager:
                     # teardown-phase exits are not failures to recover from
                     wp.status = PodStatus.SUCCEEDED
                     logger.info("worker %d exited (code %s) after job end", wid, code)
+                    continue
+                if wp.evicted:
+                    # policy eviction completing: the worker drained
+                    # (records retired under its drain checkpoint) and
+                    # exited EX_TEMPFAIL. Retire the slot — DELETED, not
+                    # FAILED: a deliberate shrink must never read as "all
+                    # workers failed" and abort the job. mark_dead still
+                    # runs so any lease the drain could not release
+                    # requeues FRONT exactly like a death.
+                    wp.status = PodStatus.DELETED
+                    if self._membership is not None:
+                        self._membership.mark_dead(
+                            wid, reason="evicted by autoscale policy")
+                    logger.warning(
+                        "worker %d eviction complete (exit code %s); slot "
+                        "retired", wid, code,
+                    )
                     continue
                 # failure/preemption path
                 if self._membership is not None:
@@ -498,7 +559,10 @@ class ProcessManager:
                 _COHORT_SIZE.set(self._cohort_size)
         tracing.set_world_version(world_version)
         _REFORMS.inc(kind="resize" if new_size != old_size else "relaunch")
-        _REFORM_S.observe(time.monotonic() - t0)
+        reform_s = time.monotonic() - t0
+        _REFORM_S.observe(reform_s)
+        # feed the autoscaler's cost model (outside the lock; best-effort)
+        self._notify_reform(reform_s, old_size, new_size)
         if new_size != old_size:
             logger.warning(
                 "cohort RESIZED %d -> %d processes (world v%d): %s",
@@ -817,14 +881,23 @@ class ProcessManager:
             return all(wp.proc.poll() is not None for wp in self._procs.values())
 
     def all_failed(self) -> bool:
-        """True when every worker is dead with its relaunch budget spent —
-        the job cannot make progress anymore."""
+        """True when every worker that could still make progress is dead
+        with its relaunch budget spent — the job cannot continue.
+        DELETED (policy-evicted) and SUCCEEDED slots are deliberate
+        retirements, not failures: they are EXCLUDED from the scan, or a
+        single autoscale eviction would pin this False forever and a
+        subsequently all-dead fleet could never abort the launcher's
+        wait."""
         with self._lock:
-            if not self._procs:
+            tracked = [
+                wp for wp in self._procs.values()
+                if wp.status not in (PodStatus.DELETED, PodStatus.SUCCEEDED)
+            ]
+            if not tracked:
                 return False
             return all(
                 wp.status == PodStatus.FAILED and wp.proc.poll() is not None
-                for wp in self._procs.values()
+                for wp in tracked
             )
 
     def statuses(self) -> Dict[int, str]:

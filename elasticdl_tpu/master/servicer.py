@@ -70,6 +70,13 @@ class MasterServicer:
         # EDL101 find); worker ids that should checkpoint
         self._ctrl_lock = threading.Lock()
         self._checkpoint_requested = set()  # guarded_by: _ctrl_lock
+        # worker ids evicted by the closed-loop autoscaler: STICKY (not
+        # one-shot like the checkpoint bit) — a heartbeat response can be
+        # dropped on the wire, and a lost one-shot eviction would leave
+        # the straggler degrading the fleet forever. The worker's drain
+        # is idempotent, so repeats are free; the set is pruned when the
+        # worker leaves the membership.
+        self._evict_requested = set()       # guarded_by: _ctrl_lock
         self._lr_override = 0.0             # 0 = no master-pushed LR
         self._shutdown = False
 
@@ -268,6 +275,7 @@ class MasterServicer:
             # consume (or both miss) the same request
             should_ckpt = request.worker_id in self._checkpoint_requested
             self._checkpoint_requested.discard(request.worker_id)
+            evict = request.worker_id in self._evict_requested
         return pb.HeartbeatResponse(
             membership_version=self._membership.version,
             num_workers=self._membership.alive_count(),
@@ -275,6 +283,7 @@ class MasterServicer:
             shutdown=self._shutdown or not known,
             job_done=self._dispatcher.finished(),
             learning_rate=self._lr_override,
+            evict=evict,
         )
 
     def set_learning_rate(self, lr: float) -> None:
@@ -356,6 +365,28 @@ class MasterServicer:
     def request_checkpoint(self, worker_id: int) -> None:
         with self._ctrl_lock:
             self._checkpoint_requested.add(worker_id)
+
+    def request_evict(self, worker_id: int) -> None:
+        """The wire half of the graceful-eviction drain handshake
+        (master/autoscaler.py): the worker's next heartbeat response
+        carries evict=True and it drains through its preempt path —
+        checkpoint + preempted report, so in-flight records retire
+        instead of re-training — then exits EX_TEMPFAIL."""
+        with self._ctrl_lock:
+            self._evict_requested.add(worker_id)
+        logger.warning(
+            "eviction requested for worker %d (drain handshake armed)",
+            worker_id,
+        )
+
+    def evict_pending(self, worker_id: int) -> bool:
+        with self._ctrl_lock:
+            return worker_id in self._evict_requested
+
+    def clear_evict(self, worker_id: int) -> None:
+        """Prune a completed eviction (the worker left the membership)."""
+        with self._ctrl_lock:
+            self._evict_requested.discard(worker_id)
 
     def request_shutdown(self) -> None:
         self._shutdown = True

@@ -396,7 +396,14 @@ class AlertEngine:
                 try:
                     hook(dict(info))
                 except Exception:
-                    logger.exception("alert hook %r failed (ignored)", hook)
+                    # swallowed (evaluation must survive its consumers)
+                    # but never dark: counted + named (observability/
+                    # hooks.py — shared with ClusterHealth's seam)
+                    from elasticdl_tpu.observability.hooks import (
+                        observe_hook_failure,
+                    )
+
+                    observe_hook_failure("alert_engine", hook, logger)
         for info in cleared:
             # bounded by the declared rule set (see the onset loop):
             # edl-lint: disable=EDL405

@@ -20,9 +20,10 @@ heartbeats entirely and got reaped. This module closes the loop:
   be dragged by the very outlier it is hunting — one 10x straggler shifts
   a mean-based z-score enough to hide itself. Scores feed cluster rollup
   gauges (`edl_cluster_*`, served by the master's /metrics), edge-triggered
-  `cluster.straggler` trace events, and a pluggable hook — log-only today,
-  the seam where an elasticity decision (shrink around the slow host,
-  ROADMAP items 3/4) will plug in.
+  `cluster.straggler` trace events, and a pluggable hook — the seam the
+  closed-loop autoscaler (master/autoscaler.py, ISSUE 14) subscribes to
+  for drain-first straggler eviction; log-only when the autoscaler is
+  off.
 
 Everything here is stdlib-only and jax-free, like the rest of the
 observability package, and strictly best-effort: a malformed payload, a
@@ -216,10 +217,12 @@ class ClusterHealth:
     `cluster.straggler` event and the hooks fire once at onset (and
     `cluster.straggler_cleared` at recovery), not every poll.
 
-    Hooks are the elasticity-decision seam: today the built-in action just
-    logs; ROADMAP items 3/4 plug capacity decisions in here without
-    touching the sensor. A hook that raises is logged and dropped from the
-    failing invocation — scoring must survive its consumers.
+    Hooks are the elasticity-decision seam: the closed-loop autoscaler
+    (master/autoscaler.py) records straggler onsets here and decides on
+    the wait poll; the built-in hook just logs. A hook that raises is
+    logged + counted (edl_hook_errors_total{source=cluster_health}) and
+    dropped from the failing invocation — scoring must survive its
+    consumers.
     """
 
     def __init__(
@@ -235,7 +238,12 @@ class ClusterHealth:
         self._membership = membership
         self.threshold = float(threshold)
         self.min_ratio = float(min_ratio)
-        self.min_workers = int(min_workers)
+        # the scoring quorum (--straggler_quorum; config validates >= 2
+        # at boot, this floor backstops direct constructions): with 2
+        # reporters the median IS one of them, but the min_ratio gate
+        # still decides "who is slow" — a 2-worker fleet must be able to
+        # flag its straggler; with 1 the question is undecidable
+        self.min_workers = max(2, int(min_workers))
         self.stale_after_s = float(stale_after_s)
         self._hooks: List[Callable[[Dict], None]] = [self._log_action]
         if on_straggler is not None:
@@ -310,8 +318,17 @@ class ClusterHealth:
             snap["fastest_worker"] = int(fastest.get("worker_id", -1))
             if scorable:
                 scores = robust_scores(p50s)
+                # quorum-2 fleets: with exactly two reporters the
+                # median/MAD score is structurally capped at ~0.67 sigma
+                # (each value is equidistant from their midpoint), so the
+                # sigma threshold alone could NEVER fire — the min_ratio
+                # gate decides instead (p50 >= 1.5x the pair median means
+                # >= 3x the peer). More reporters restore the full
+                # two-gate rule.
+                pair = len(fresh) == 2
                 for r, x, score in zip(fresh, p50s, scores):
-                    if score >= self.threshold and x >= self.min_ratio * med:
+                    if (score >= self.threshold or pair) \
+                            and x >= self.min_ratio * med:
                         info = {
                             "worker_id": int(r.get("worker_id", -1)),
                             "worker_name": str(r.get("name", "")),
@@ -376,9 +393,13 @@ class ClusterHealth:
                 try:
                     hook(dict(info))
                 except Exception:
-                    logger.exception(
-                        "straggler hook %r failed (ignored)", hook
+                    # swallowed (scoring must survive its consumers) but
+                    # never dark: counted + named (observability/hooks.py)
+                    from elasticdl_tpu.observability.hooks import (
+                        observe_hook_failure,
                     )
+
+                    observe_hook_failure("cluster_health", hook, logger)
         for info in cleared:
             tracing.event(
                 "cluster.straggler_cleared", worker_id=info["worker_id"],

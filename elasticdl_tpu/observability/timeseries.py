@@ -467,9 +467,14 @@ def fleet_series(health_records: List[Dict],
             if v is not None and v > 0.0]
     if p50s:
         out["edl_fleet_step_p50_ms_median"] = round(_median(p50s), 3)
-    if todo_tasks is not None:
+    if todo_tasks is not None and int(alive_workers or 0) > 0:
+        # backlog PER WORKER is undefined with zero alive workers (all
+        # churning mid-poll): emitting todo/1 there would hand the
+        # autoscaler's grow rule a fake spike exactly when the fleet is
+        # least able to absorb an action — absence reads as no-data and
+        # the rules (and the autoscaler) hold position instead
         out["edl_fleet_backlog_per_worker"] = round(
-            float(todo_tasks) / max(1, int(alive_workers or 0) or 1), 3)
+            float(todo_tasks) / int(alive_workers), 3)
     fracs = []
     for r in fresh:
         total = sum(num(r, k) or 0.0 for k in _PHASE_KEYS)

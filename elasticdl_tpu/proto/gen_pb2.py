@@ -158,6 +158,15 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
         ("accepted", 1, "bool", {}),
     ])
 
+    # Closed-loop autoscaler (ISSUE 14, master/autoscaler.py): the
+    # graceful-eviction drain handshake. The master sets `evict` on a
+    # worker's heartbeat response; the worker drains through its
+    # existing preempt path (drain checkpoint + preempted report — the
+    # remainder requeues FRONT like a death) and exits EX_TEMPFAIL.
+    # Old workers skip the unknown field and keep training (the policy
+    # falls back to lease-expiry recovery); old masters never set it.
+    changed += _add_field(msgs["HeartbeatResponse"], "evict", 7, "bool")
+
     # Read replicas (ISSUE 13): per-shard replica assignments ride the
     # same map response, flattened row-major at `replica_count` slots
     # per shard with -1 padding (proto3 has no repeated-of-repeated

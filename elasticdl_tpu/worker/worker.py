@@ -506,6 +506,23 @@ class Worker:
                         self._job_done = True
                     self._shutdown.set()
                     break
+                if getattr(resp, "evict", False):
+                    # graceful-eviction drain handshake (the closed-loop
+                    # autoscaler shrinking past this worker): identical to
+                    # a k8s SIGTERM preemption — stop at the next batch
+                    # boundary, drain-checkpoint, report the applied
+                    # prefix (the remainder requeues FRONT, retry-free),
+                    # exit EX_TEMPFAIL. The run loop does all of that off
+                    # the _preempted flag; this thread only raises it.
+                    logger.warning(
+                        "master evicted this worker (autoscale policy); "
+                        "draining"
+                    )
+                    tracing.event(
+                        "worker.evicted", worker_id=self.worker_id,
+                    )
+                    self.preempt()
+                    break
                 self._last_known_workers = resp.num_workers or self._last_known_workers
                 if resp.should_checkpoint:
                     # honored by the run loop at the next task boundary (the

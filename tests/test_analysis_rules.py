@@ -1528,4 +1528,74 @@ def test_tree_measures_durations_monotonically():
     import elasticdl_tpu.master.process_manager as pm
 
     src = open(pm.__file__, encoding="utf-8").read()
-    assert "_REFORM_S.observe(time.monotonic() - t0)" in src
+    assert "reform_s = time.monotonic() - t0" in src
+    assert "_REFORM_S.observe(reform_s)" in src
+
+
+# ------------------------------------------------------------------ #
+# EDL501 rescale-action-outside-policy
+
+
+EDL501_BAD = """
+    def react_to_lag(manager):
+        manager.add_worker()                      # BAD: ad-hoc grow
+        manager.remove_worker()                   # BAD: ad-hoc shrink
+        manager.evict_worker(3)                   # BAD: ad-hoc evict
+        manager.kill_worker(3, relaunch=False)    # BAD: eviction spelling
+"""
+
+EDL501_TRACKED = """
+    from elasticdl_tpu.master.process_manager import ProcessManager
+
+    pm = ProcessManager(cfg)
+
+    def scale(cfg):
+        pm.add_worker()                           # BAD: tracked receiver
+"""
+
+EDL501_GOOD = """
+    def chaos_kill(manager):
+        # in-place relaunch (the chaos/test hook), not a resize
+        manager.kill_worker(0, relaunch=True)
+        manager.kill_worker(0)
+
+    def unrelated(pool):
+        # receiver is not manager-ish and not a tracked construction
+        pool.add_worker()
+
+    def reviewed(manager):
+        # operator escape hatch under review:
+        # edl-lint: disable=EDL501
+        manager.remove_worker()
+"""
+
+
+def test_rescale_action_outside_policy_fires_on_adhoc_calls():
+    fs = findings_for(EDL501_BAD, select={"EDL501"},
+                      rel_path="elasticdl_tpu/worker/hacks.py")
+    assert rule_ids(fs) == ["EDL501"]
+    assert len(fs) == 4
+    assert all("cost gate" in f.message for f in fs)
+
+
+def test_rescale_action_tracks_manager_constructions():
+    fs = findings_for(EDL501_TRACKED, select={"EDL501"},
+                      rel_path="elasticdl_tpu/client/zoo.py")
+    assert rule_ids(fs) == ["EDL501"]
+    assert len(fs) == 1
+
+
+def test_rescale_action_quiet_on_relaunch_unrelated_and_disabled():
+    assert findings_for(EDL501_GOOD, select={"EDL501"},
+                        rel_path="elasticdl_tpu/worker/hacks.py") == []
+
+
+def test_rescale_action_allowlists_policy_and_entry_points():
+    for allowed in (
+        "elasticdl_tpu/master/autoscaler.py",
+        "elasticdl_tpu/client/local.py",
+        "elasticdl_tpu/client/api.py",
+        "elasticdl_tpu/master/k8s_instance_manager.py",
+    ):
+        assert findings_for(EDL501_BAD, select={"EDL501"},
+                            rel_path=allowed) == []

@@ -1,0 +1,792 @@
+"""Closed-loop autoscaler (ISSUE 14): deterministic policy unit tests —
+cost gate, cooldown, hysteresis hold, world bounds, action budget,
+conflicting signals, journaled decisions (applied + suppressed) with
+replay-inherited cooldown — plus the satellite pins: hook failures are
+counted (edl_hook_errors_total), the straggler quorum is configurable
+with a floor of 2 (a 2-worker fleet CAN flag its straggler), and the
+fleet series read "no data" (absent), never fake zeros, when reporters
+churn away mid-poll. Jax-free and fast."""
+
+import json
+import time
+from dataclasses import asdict
+
+import pytest
+
+from elasticdl_tpu.master.autoscaler import (
+    Autoscaler,
+    CostModel,
+    ProcessManagerTarget,
+)
+from elasticdl_tpu.master.journal import (
+    ControlPlaneJournal,
+    replay_lines,
+)
+from elasticdl_tpu.observability.health import ClusterHealth
+from elasticdl_tpu.observability.registry import default_registry
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeTarget:
+    def __init__(self, world=4, ok=True):
+        self.world = world
+        self.ok = ok
+        self.calls = []
+
+    def world_size(self):
+        return self.world
+
+    def evict(self, worker_id, worker_name=""):
+        self.calls.append(("evict", worker_id))
+        if self.ok:
+            self.world -= 1
+        return self.ok
+
+    def grow(self):
+        self.calls.append(("grow", None))
+        if self.ok:
+            self.world += 1
+        return self.ok
+
+    def shrink(self):
+        self.calls.append(("shrink", None))
+        if self.ok:
+            self.world -= 1
+        return self.ok
+
+
+def straggler_info(wid=3, p50=0.050, med=0.005):
+    return {
+        "worker_id": wid, "worker_name": f"w{wid}", "score": 12.0,
+        "step_time_p50_s": p50, "median_step_time_s": med,
+    }
+
+
+def make(clock=None, target=None, journal=None, **kw):
+    kw.setdefault("cooldown_s", 60.0)
+    kw.setdefault("hold_s", 0.0)
+    kw.setdefault("action_budget", 4)
+    kw.setdefault("cost_model", CostModel(rescale_cost_s=1.0,
+                                          horizon_s=300.0))
+    a = Autoscaler(journal=journal, clock=clock or Clock(), **kw)
+    if target is not None:
+        a.bind_target(target)
+    return a
+
+
+# ------------------------------------------------------------------ #
+# cost model
+
+
+def test_cost_model_projections_and_ewma():
+    cm = CostModel(rescale_cost_s=10.0, horizon_s=100.0)
+    # evict: slowdown 0.9 * world 4 * horizon 100 = 360 vs cost 40
+    p = cm.project("evict", 4, straggler_info(p50=0.050, med=0.005))
+    assert p["gain_s"] == pytest.approx(0.9 * 4 * 100, rel=1e-3)
+    assert p["cost_s"] == 40.0
+    # grow: one worker's horizon vs the fleet's recovery bill
+    p = cm.project("grow", 4, {})
+    assert p == {"gain_s": 100.0, "cost_s": 40.0}
+    # shrink: the freed worker's data_wait fraction
+    p = cm.project("shrink", 4, {"value": 0.8})
+    assert p["gain_s"] == pytest.approx(80.0)
+    assert p["cost_s"] == 30.0   # survivors pay
+    # observed recoveries move the estimate (EWMA, never raises)
+    cm.observe_recovery(2.0)
+    assert cm.rescale_cost_s == pytest.approx(6.0)
+    cm.observe_recovery("garbage")
+    cm.observe_recovery(-1)
+    assert cm.observed_recoveries == 1
+
+
+def test_cost_gate_suppresses_marginal_actions():
+    clock = Clock()
+    target = FakeTarget(world=4)
+    # a barely-slow straggler: slowdown ~0.17, gain 0.17*4*10 = 6.7 <
+    # cost 5*4 = 20 -> suppressed
+    a = make(clock, target,
+             cost_model=CostModel(rescale_cost_s=5.0, horizon_s=10.0))
+    a._on_straggler(straggler_info(p50=0.006, med=0.005))
+    assert a.evaluate() is None
+    assert target.calls == []
+    snap = a.snapshot()
+    assert snap["last_decision"]["suppress_reason"] == "cost_gate"
+    # a real straggler clears the gate
+    a2 = make(clock, FakeTarget(world=4),
+              cost_model=CostModel(rescale_cost_s=5.0, horizon_s=10.0))
+    a2._on_straggler(straggler_info(p50=0.100, med=0.005))
+    assert a2.evaluate() is not None
+
+
+# ------------------------------------------------------------------ #
+# gates: cooldown, hold, bounds, budget, conflicts
+
+
+def test_evict_applies_then_cooldown_suppresses_then_reopens():
+    clock = Clock()
+    target = FakeTarget(world=4)
+    a = make(clock, target, cooldown_s=100.0)
+    a._on_straggler(straggler_info(wid=3))
+    d = a.evaluate()
+    assert d["decision"] == "applied" and d["kind"] == "evict"
+    assert target.calls == [("evict", 3)]
+    # a new straggler inside the cooldown window: suppressed
+    clock.advance(10)
+    a._on_straggler(straggler_info(wid=2))
+    assert a.evaluate() is None
+    assert a.snapshot()["last_decision"]["suppress_reason"] == "cooldown"
+    # past the window: acts again
+    clock.advance(200)
+    d = a.evaluate()
+    assert d is not None and d["worker_id"] == 2
+    assert a.snapshot()["actions_applied"] == 2
+
+
+def test_hold_hysteresis_delays_action_until_signal_persists():
+    clock = Clock()
+    target = FakeTarget(world=4)
+    a = make(clock, target, hold_s=30.0)
+    a._on_straggler(straggler_info())
+    assert a.evaluate() is None          # not held long enough
+    assert target.calls == []
+    clock.advance(29)
+    assert a.evaluate() is None
+    clock.advance(2)
+    assert a.evaluate() is not None      # persisted past hold_s
+
+
+def test_world_bounds_suppress():
+    clock = Clock()
+    a = make(clock, FakeTarget(world=2), min_world=2)
+    a._on_straggler(straggler_info())
+    assert a.evaluate() is None
+    assert a.snapshot()["last_decision"]["suppress_reason"] == "world_at_min"
+    a2 = make(clock, FakeTarget(world=4), max_world=4)
+    a2._on_alert({"rule": "dispatcher_backlog_per_worker",
+                  "value": 200.0, "threshold": 64.0})
+    assert a2.evaluate() is None
+    assert a2.snapshot()["last_decision"]["suppress_reason"] == "world_at_max"
+
+
+def test_action_budget_caps_blast_radius():
+    clock = Clock()
+    target = FakeTarget(world=10)
+    a = make(clock, target, action_budget=2, cooldown_s=0.0)
+    for wid in (1, 2, 3):
+        a._on_straggler(straggler_info(wid=wid))
+        a.evaluate()
+        clock.advance(1)
+    assert a.snapshot()["actions_applied"] == 2
+    assert len([c for c in target.calls if c[0] == "evict"]) == 2
+    assert a.snapshot()["last_decision"]["suppress_reason"] \
+        == "budget_exhausted"
+
+
+def test_conflicting_grow_and_shrink_suppress_each_other():
+    clock = Clock()
+    target = FakeTarget(world=4)
+    a = make(clock, target)
+    a._on_alert({"rule": "dispatcher_backlog_per_worker", "value": 100.0,
+                 "threshold": 64.0})
+    a._on_alert({"rule": "fleet_data_wait_dominant", "value": 0.8,
+                 "threshold": 0.5})
+    assert a.evaluate() is None
+    assert target.calls == []
+    assert a.snapshot()["last_decision"]["suppress_reason"] \
+        == "conflicting_signals"
+
+
+def test_unbound_target_suppresses_no_target():
+    a = make(Clock())
+    a._on_straggler(straggler_info())
+    assert a.evaluate() is None
+    assert a.snapshot()["last_decision"]["suppress_reason"] == "no_target"
+
+
+def test_grow_and_shrink_signals_drive_their_actions():
+    clock = Clock()
+    target = FakeTarget(world=4)
+    a = make(clock, target)
+    a._on_alert({"rule": "dispatcher_backlog_per_worker", "value": 100.0,
+                 "threshold": 64.0})
+    d = a.evaluate()
+    assert d["kind"] == "grow" and target.calls[-1][0] == "grow"
+    clock.advance(1000)
+    a._on_alert({"rule": "fleet_data_wait_dominant", "value": 0.8,
+                 "threshold": 0.5})
+    d = a.evaluate()
+    assert d["kind"] == "shrink" and target.calls[-1][0] == "shrink"
+    # irrelevant rules never become signals
+    a._on_alert({"rule": "embedding_pull_p99", "value": 900.0})
+    assert a.snapshot()["pending_signals"] == 0
+
+
+def test_action_failure_keeps_cooldown_and_journals_failure():
+    clock = Clock()
+    target = FakeTarget(world=4, ok=False)
+    a = make(clock, target)
+    a._on_straggler(straggler_info())
+    d = a.evaluate()
+    assert d is not None          # the decision stood (journaled applied)
+    assert a.snapshot()["last_decision"]["suppress_reason"] \
+        == "action_failed"
+    assert a.snapshot()["actions_applied"] == 1
+
+
+def test_failed_action_rearms_signal_and_retries_after_cooldown():
+    """Review finding: hooks fire only at ONSET, so a signal consumed by
+    a FAILED action must re-arm — a transient target error must not
+    strand a still-flagged straggler for the rest of the job."""
+    clock = Clock()
+    target = FakeTarget(world=4, ok=False)
+    a = make(clock, target, cooldown_s=50.0)
+    a._on_straggler(straggler_info(wid=3))
+    assert a.evaluate() is not None
+    assert a.snapshot()["pending_signals"] == 1   # re-armed, not lost
+    clock.advance(10)
+    assert a.evaluate() is None                   # cooldown paces retry
+    target.ok = True                              # transient error heals
+    clock.advance(100)
+    d = a.evaluate()
+    assert d is not None and d["worker_id"] == 3
+    assert target.calls.count(("evict", 3)) == 2
+    assert a.snapshot()["pending_signals"] == 0
+
+
+# ------------------------------------------------------------------ #
+# journaled decisions + replay-inherited state
+
+
+def test_decisions_journaled_and_replayed_with_cooldown_inherited(tmp_path):
+    clock = Clock()
+    journal = ControlPlaneJournal(str(tmp_path))
+    target = FakeTarget(world=4)
+    a = make(clock, target, journal=journal, cooldown_s=500.0)
+    a._on_straggler(straggler_info(wid=7))
+    assert a.evaluate() is not None
+    # a second signal inside the cooldown: suppressed AND journaled
+    clock.advance(5)
+    a._on_straggler(straggler_info(wid=8))
+    assert a.evaluate() is None
+    # suppressed journaling is EDGE-triggered: more polls with the same
+    # (kind, reason) add no records
+    for _ in range(5):
+        a.evaluate()
+    journal.close()
+    with open(journal.path, encoding="utf-8") as f:
+        lines = f.readlines()
+    recs = [json.loads(ln) for ln in lines]
+    auto = [r for r in recs if r.get("t") == "autoscale"]
+    assert [r["decision"] for r in auto] == ["applied", "suppressed"]
+    assert auto[0]["kind"] == "evict" and auto[0]["worker_id"] == 7
+    assert auto[0]["gain_s"] > auto[0]["cost_s"]
+    assert auto[1]["suppress_reason"] == "cooldown"
+    # replay identity (twice over the same lines)
+    ra, rb = replay_lines(lines).autoscale, replay_lines(lines).autoscale
+    assert asdict(ra) == asdict(rb)
+    assert ra.actions_applied == 1
+    assert ra.last_action_ts == pytest.approx(clock.t - 5, abs=1.0)
+    assert ra.by_kind == {"evict": 1}
+
+    # takeover: the successor's journal open replays + rotates; a
+    # restored autoscaler inherits cooldown and does NOT re-fire
+    successor = ControlPlaneJournal(str(tmp_path))
+    snap = successor.autoscale_snapshot()
+    assert snap is not None and snap.actions_applied == 1
+    assert snap.last_action_ts == ra.last_action_ts
+    target2 = FakeTarget(world=4)
+    restored = make(clock, target2, journal=successor, cooldown_s=500.0)
+    restored._on_straggler(straggler_info(wid=9))
+    assert restored.evaluate() is None
+    assert target2.calls == []
+    assert restored.snapshot()["last_decision"]["suppress_reason"] \
+        == "cooldown"
+    # ... and past the inherited window the restored engine acts
+    clock.advance(1000)
+    assert restored.evaluate() is not None
+    successor.close()
+    # a snapshot survives another rotation round trip
+    third = ControlPlaneJournal(str(tmp_path))
+    assert third.autoscale_snapshot().actions_applied == 2
+    third.close()
+
+
+def test_autoscale_journal_record_in_group_commit_batch(tmp_path):
+    """Applied decisions await their commit (durable-before-action) in
+    group-commit mode too."""
+    journal = ControlPlaneJournal(str(tmp_path), group_commit_ms=5.0)
+    clock = Clock()
+    a = make(clock, FakeTarget(world=4), journal=journal)
+    a._on_straggler(straggler_info(wid=1))
+    assert a.evaluate() is not None
+    journal.close()
+    with open(journal.path, encoding="utf-8") as f:
+        ra = replay_lines(f.readlines()).autoscale
+    assert ra.actions_applied == 1
+
+
+# ------------------------------------------------------------------ #
+# live-sensor revalidation (signals act only while still true)
+
+
+class StubMembership:
+    def __init__(self, records):
+        self.records = records
+
+    def health_snapshot(self):
+        return self.records
+
+
+def _rec(wid, p50_ms, now):
+    return {"worker_id": wid, "name": f"w{wid}", "step_p50_ms": p50_ms,
+            "updated_at": now}
+
+
+def test_signal_cleared_before_hold_is_dropped():
+    now = time.time()
+    records = [_rec(0, 5.0, now), _rec(1, 5.0, now), _rec(2, 60.0, now)]
+    membership = StubMembership(records)
+    health = ClusterHealth(membership, min_workers=3)
+    clock = Clock()
+    target = FakeTarget(world=3)
+    a = make(clock, target, hold_s=10.0).subscribe(health=health)
+    health.update(now)
+    assert a.snapshot()["pending_signals"] == 1
+    # the straggler recovers before the hold elapses
+    records[2]["step_p50_ms"] = 5.0
+    health.update(now + 1)
+    clock.advance(60)
+    assert a.evaluate() is None
+    assert target.calls == []
+    assert a.snapshot()["pending_signals"] == 0
+
+
+def test_end_to_end_straggler_onset_drives_eviction():
+    """The real seam: ClusterHealth hook -> pending signal -> evaluate
+    -> evict, against real health records."""
+    now = time.time()
+    records = [_rec(0, 5.0, now), _rec(1, 5.0, now), _rec(2, 60.0, now)]
+    health = ClusterHealth(StubMembership(records), min_workers=3)
+    clock = Clock()
+    target = FakeTarget(world=3)
+    a = make(clock, target).subscribe(health=health)
+    health.update(now)
+    d = a.evaluate()
+    assert d is not None and d["kind"] == "evict" and d["worker_id"] == 2
+    assert target.calls == [("evict", 2)]
+
+
+def test_alert_engine_onset_drives_grow(tmp_path):
+    """The other seam: a real AlertEngine rule onset -> grow."""
+    from elasticdl_tpu.observability.alerts import AlertEngine, AlertRule
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+    store = TimeSeriesStore(interval_s=0.01)
+    engine = AlertEngine(store, rules=[AlertRule(
+        "dispatcher_backlog_per_worker",
+        series="edl_fleet_backlog_per_worker",
+        threshold=64.0, mode="value", window_s=60.0,
+    )])
+    clock = Clock()
+    target = FakeTarget(world=2)
+    a = make(clock, target).subscribe(alerts=engine)
+    now = time.time()
+    store.sample(extra={"edl_fleet_backlog_per_worker": 200.0}, now=now)
+    engine.evaluate(now=now)
+    d = a.evaluate()
+    assert d is not None and d["kind"] == "grow"
+    assert target.calls == [("grow", None)]
+
+
+def test_alert_cleared_before_action_drops_signal():
+    from elasticdl_tpu.observability.alerts import AlertEngine, AlertRule
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+    store = TimeSeriesStore(interval_s=0.01)
+    engine = AlertEngine(store, rules=[AlertRule(
+        "dispatcher_backlog_per_worker",
+        series="edl_fleet_backlog_per_worker",
+        threshold=64.0, mode="value", window_s=60.0,
+    )])
+    clock = Clock()
+    target = FakeTarget(world=2)
+    a = make(clock, target, hold_s=10.0).subscribe(alerts=engine)
+    now = time.time()
+    store.sample(extra={"edl_fleet_backlog_per_worker": 200.0}, now=now)
+    engine.evaluate(now=now)
+    assert a.snapshot()["pending_signals"] == 1
+    # backlog drains before the hold elapses: alert clears, signal drops
+    store.sample(extra={"edl_fleet_backlog_per_worker": 1.0}, now=now + 1)
+    engine.evaluate(now=now + 1)
+    clock.advance(60)
+    assert a.evaluate() is None
+    assert target.calls == []
+
+
+# ------------------------------------------------------------------ #
+# action adapters
+
+
+class FakeProc:
+    def poll(self):
+        return None
+
+
+class FakeManagerCfg:
+    def __init__(self, num_processes=1, num_workers=3):
+        self.num_processes = num_processes
+        self.num_workers = num_workers
+
+
+class FakePlainManager:
+    def __init__(self):
+        self.cfg = FakeManagerCfg(num_processes=1)
+        self.evicted = []
+
+    def evict_worker(self, wid):
+        self.evicted.append(wid)
+        return True
+
+
+class FakeCohortManager:
+    def __init__(self, size=4):
+        self.cfg = FakeManagerCfg(num_processes=size)
+        self.cohort_size = size
+        self.removed = 0
+        self.added = 0
+
+    def pending_size(self):
+        return None
+
+    def remove_worker(self):
+        self.removed += 1
+        return self.cohort_size - self.removed
+
+    def add_worker(self):
+        self.added += 1
+        return self.cohort_size + self.added
+
+
+class FakeServicer:
+    def __init__(self):
+        self.evict_requests = []
+
+    def request_evict(self, wid):
+        self.evict_requests.append(wid)
+
+
+def test_process_manager_target_plain_evict_uses_drain_handshake():
+    mgr = FakePlainManager()
+    servicer = FakeServicer()
+    t = ProcessManagerTarget(mgr, servicer=servicer)
+    assert t.evict(2, "worker-2") is True
+    # drain handshake armed FIRST (the worker retires its records),
+    # then the slot marked never-relaunch
+    assert servicer.evict_requests == [2]
+    assert mgr.evicted == [2]
+
+
+class FakeMembershipAlive:
+    def __init__(self, wids):
+        self._wids = wids
+
+    def alive_count(self):
+        return len(self._wids)
+
+    def alive_workers(self):
+        import types
+
+        return [types.SimpleNamespace(worker_id=w, led_by=None)
+                for w in self._wids]
+
+
+def test_plain_training_grow_is_unsupported_and_spends_no_budget():
+    """Review finding: a structurally impossible action (growing a plain
+    TRAINING fleet) must suppress BEFORE the budget/cooldown spend, not
+    journal an applied decision that always raises."""
+    from elasticdl_tpu.common.constants import JobType
+
+    mgr = FakePlainManager()
+    mgr.cfg.job_type = JobType.TRAINING_WITH_EVALUATION
+    target = ProcessManagerTarget(mgr, membership=FakeMembershipAlive([0]))
+    assert target.supports("grow") is False
+    assert target.supports("evict") is True
+    clock = Clock()
+    a = make(clock, target)
+    a._on_alert({"rule": "dispatcher_backlog_per_worker", "value": 100.0,
+                 "threshold": 64.0})
+    assert a.evaluate() is None
+    snap = a.snapshot()
+    assert snap["last_decision"]["suppress_reason"] == "unsupported"
+    assert snap["actions_applied"] == 0
+    assert snap["budget_remaining"] == a.action_budget
+    # eval/prediction plain fleets CAN grow
+    mgr.cfg.job_type = JobType.EVALUATION_ONLY
+    assert target.supports("grow") is True
+
+
+def test_plain_shrink_routes_through_the_evict_drain_path():
+    """Review finding: ProcessManager.remove_worker is cohort-only —
+    plain-mode shrink must evict the newest capacity via the drain
+    handshake instead of raising after the decision was journaled."""
+    mgr = FakePlainManager()
+    servicer = FakeServicer()
+    target = ProcessManagerTarget(
+        mgr, servicer=servicer, membership=FakeMembershipAlive([0, 1, 2]))
+    assert target.supports("shrink") is True
+    assert target.shrink() is True
+    assert servicer.evict_requests == [2]   # newest capacity drains
+    assert mgr.evicted == [2]
+
+
+def test_process_manager_target_cohort_evict_is_drain_first_shrink():
+    mgr = FakeCohortManager(size=4)
+    t = ProcessManagerTarget(mgr, servicer=FakeServicer())
+    assert t.world_size() == 4
+    assert t.evict(0, "cohort#p2") is True
+    assert mgr.removed == 1       # the quiesce-checkpoint resize path
+    assert t.grow() and mgr.added == 1
+
+
+def test_all_failed_ignores_policy_evicted_slots():
+    """Review finding: a DELETED (policy-evicted) slot must not pin
+    all_failed() False while the rest of the fleet dies — and a
+    deliberate eviction alone must never read as an all-failed abort."""
+    from elasticdl_tpu.common.config import JobConfig
+    from elasticdl_tpu.common.constants import PodStatus
+    from elasticdl_tpu.master.process_manager import (
+        ProcessManager,
+        _WorkerProc,
+    )
+
+    class DeadProc:
+        def poll(self):
+            return 75
+
+    cfg = JobConfig(model_def="m.f", num_workers=2)
+    mgr = ProcessManager(cfg, membership_signal_path="")
+    mgr._procs[0] = _WorkerProc(
+        worker_id=0, proc=DeadProc(), status=PodStatus.DELETED,
+        evicted=True)
+    mgr._procs[1] = _WorkerProc(
+        worker_id=1, proc=DeadProc(), status=PodStatus.FAILED)
+    # the evicted slot is excluded; the remaining fleet IS all failed
+    assert mgr.all_failed() is True
+    # only retirements left: not a failure state
+    mgr._procs[1].status = PodStatus.SUCCEEDED
+    assert mgr.all_failed() is False
+    # a live worker beside a failed one: not all failed
+
+    class LiveProc:
+        def poll(self):
+            return None
+
+    mgr._procs[1].status = PodStatus.FAILED
+    mgr._procs[2] = _WorkerProc(
+        worker_id=2, proc=LiveProc(), status=PodStatus.RUNNING)
+    assert mgr.all_failed() is False
+
+
+# ------------------------------------------------------------------ #
+# the drain-handshake wire bit (servicer + pb)
+
+
+def test_servicer_evict_bit_rides_heartbeat_and_clears_on_death():
+    from elasticdl_tpu.master.membership import Membership
+    from elasticdl_tpu.master.servicer import MasterServicer
+    from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    dispatcher = TaskDispatcher(
+        training_shards=[("s", 0, 64)], records_per_task=64, shuffle=False)
+    membership = Membership(heartbeat_timeout_s=60.0)
+    servicer = MasterServicer(dispatcher, membership)
+    membership.add_death_callback(servicer.clear_evict)
+    wid = membership.register("w0").worker_id
+    resp = servicer.Heartbeat(pb.HeartbeatRequest(worker_id=wid), None)
+    assert resp.evict is False
+    servicer.request_evict(wid)
+    resp = servicer.Heartbeat(pb.HeartbeatRequest(worker_id=wid), None)
+    assert resp.evict is True
+    # STICKY until the worker leaves (a dropped response must not lose
+    # the eviction) ...
+    resp = servicer.Heartbeat(pb.HeartbeatRequest(worker_id=wid), None)
+    assert resp.evict is True
+    # ... and pruned when it does (a revived id must not inherit it)
+    membership.mark_dead(wid, reason="evicted")
+    assert servicer.evict_pending(wid) is False
+
+
+def test_heartbeat_response_evict_field_survives_wire_roundtrip():
+    from elasticdl_tpu.proto import elasticdl_tpu_pb2 as pb
+
+    msg = pb.HeartbeatResponse(evict=True, num_workers=3)
+    decoded = pb.HeartbeatResponse.FromString(msg.SerializeToString())
+    assert decoded.evict is True and decoded.num_workers == 3
+    # proto3 default: an old master's response reads evict=False
+    assert pb.HeartbeatResponse().evict is False
+
+
+# ------------------------------------------------------------------ #
+# satellite: hook failures are counted, not dark
+
+
+def test_cluster_health_hook_errors_counted():
+    # importing the shared helper registers the counter (the seams load
+    # it lazily, only at the first failure)
+    from elasticdl_tpu.observability import hooks  # noqa: F401
+
+    counter = default_registry().get("edl_hook_errors_total")
+    before = counter.value(source="cluster_health")
+    now = time.time()
+    records = [_rec(0, 5.0, now), _rec(1, 5.0, now), _rec(2, 60.0, now)]
+    health = ClusterHealth(StubMembership(records), min_workers=3)
+
+    def bad_hook(info):
+        raise RuntimeError("policy bug")
+
+    health.add_hook(bad_hook)
+    snap = health.update(now)
+    assert snap["straggler_count"] == 1   # scoring survived the hook
+    assert counter.value(source="cluster_health") == before + 1
+
+
+def test_alert_engine_hook_errors_counted():
+    from elasticdl_tpu.observability import hooks  # noqa: F401
+    from elasticdl_tpu.observability.alerts import AlertEngine, AlertRule
+    from elasticdl_tpu.observability.timeseries import TimeSeriesStore
+
+    counter = default_registry().get("edl_hook_errors_total")
+    before = counter.value(source="alert_engine")
+    store = TimeSeriesStore(interval_s=0.01)
+    engine = AlertEngine(store, rules=[AlertRule(
+        "r", series="s", threshold=1.0, mode="value")])
+
+    def bad_hook(info):
+        raise RuntimeError("policy bug")
+
+    engine.add_hook(bad_hook)
+    now = time.time()
+    store.sample(extra={"s": 5.0}, now=now)
+    snap = engine.evaluate(now=now)
+    assert [a["rule"] for a in snap["active"]] == ["r"]
+    assert counter.value(source="alert_engine") == before + 1
+
+
+# ------------------------------------------------------------------ #
+# satellite: configurable straggler quorum (floor 2)
+
+
+def test_two_worker_fleet_flags_straggler_with_quorum_2():
+    now = time.time()
+    records = [_rec(0, 5.0, now), _rec(1, 60.0, now)]
+    health = ClusterHealth(StubMembership(records), min_workers=2)
+    snap = health.update(now)
+    assert snap["scorable"] is True
+    assert [s["worker_id"] for s in snap["stragglers"]] == [1]
+    # the ratio gate still protects a HEALTHY pair (60/5 = 12x flags;
+    # 6/5 = 1.2x must not)
+    health2 = ClusterHealth(
+        StubMembership([_rec(0, 5.0, now), _rec(1, 6.0, now)]),
+        min_workers=2)
+    assert health2.update(now)["straggler_count"] == 0
+
+
+def test_quorum_floor_and_default_unchanged():
+    health = ClusterHealth(StubMembership([]), min_workers=1)
+    assert health.min_workers == 2    # floor
+    now = time.time()
+    # default quorum 3: a 2-reporter fleet stays unscorable
+    records = [_rec(0, 5.0, now), _rec(1, 60.0, now)]
+    health3 = ClusterHealth(StubMembership(records))
+    snap = health3.update(now)
+    assert snap["scorable"] is False and snap["straggler_count"] == 0
+
+
+def test_straggler_quorum_config_validation():
+    from elasticdl_tpu.common.config import JobConfig
+
+    cfg = JobConfig(model_def="m.f", straggler_quorum=1)
+    with pytest.raises(ValueError, match="straggler_quorum"):
+        cfg.validate()
+    JobConfig(model_def="m.f", straggler_quorum=2).validate()
+
+
+def test_autoscale_config_validation():
+    from elasticdl_tpu.common.config import JobConfig
+
+    ok = JobConfig(model_def="m.f", autoscale=True, checkpoint_dir="/tmp/c")
+    ok.validate()
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        JobConfig(model_def="m.f", autoscale=True).validate()
+    with pytest.raises(ValueError, match="autoscale_actions_max"):
+        JobConfig(model_def="m.f", autoscale=True, checkpoint_dir="/t",
+                  autoscale_actions_max=0).validate()
+    with pytest.raises(ValueError, match="autoscale_max_workers"):
+        JobConfig(model_def="m.f", autoscale=True, checkpoint_dir="/t",
+                  autoscale_min_workers=4,
+                  autoscale_max_workers=2).validate()
+    with pytest.raises(ValueError, match="rescale_cost"):
+        JobConfig(model_def="m.f", autoscale=True, checkpoint_dir="/t",
+                  autoscale_rescale_cost_s=0).validate()
+    # off = no autoscale validation at all (the disable path)
+    JobConfig(model_def="m.f", autoscale_actions_max=0).validate()
+
+
+# ------------------------------------------------------------------ #
+# satellite: fleet series no-data semantics under reporter churn
+
+
+def test_fleet_series_no_data_not_fake_zeros():
+    from elasticdl_tpu.observability.timeseries import fleet_series
+
+    now = time.time()
+    # all workers churned away mid-poll: NO reporters, NO alive workers
+    series = fleet_series([], todo_tasks=500, alive_workers=0, now=now)
+    # backlog per worker is UNDEFINED, not todo/1: a fake 500-task
+    # "backlog" would fire the grow rule exactly when nothing can grow
+    assert "edl_fleet_backlog_per_worker" not in series
+    assert "edl_fleet_data_wait_frac" not in series
+    assert "edl_fleet_step_p50_ms_median" not in series
+    assert series["edl_fleet_workers_reporting"] == 0.0
+    # partial churn: stale records (beyond the window) count as absent
+    stale = [_rec(0, 5.0, now - 120)]
+    series = fleet_series(stale, todo_tasks=500, alive_workers=2, now=now)
+    assert series["edl_fleet_workers_reporting"] == 0.0
+    assert "edl_fleet_data_wait_frac" not in series
+    # backlog IS emitted when alive workers exist (the signal is real)
+    assert series["edl_fleet_backlog_per_worker"] == 250.0
+
+
+def test_goodput_series_absent_without_reporters():
+    from elasticdl_tpu.observability.goodput import FleetGoodput
+
+    fg = FleetGoodput(StubMembership([]), dispatcher=None)
+    fg.update()
+    assert fg.series() == {}   # absence IS the no-data signal
+
+
+def test_autoscaler_holds_position_on_no_data():
+    """Zero reporters -> no straggler onsets, no alert onsets -> the
+    engine makes NO decision (and journals nothing)."""
+    now = time.time()
+    membership = StubMembership([])
+    health = ClusterHealth(membership, min_workers=2)
+    clock = Clock()
+    target = FakeTarget(world=3)
+    a = make(clock, target).subscribe(health=health)
+    health.update(now)
+    assert a.evaluate() is None
+    assert target.calls == []
+    assert a.snapshot()["decision_records"] == 0

@@ -276,6 +276,55 @@ def test_goodput_leg_smoke(bench, monkeypatch, tmp_path):
     assert gp["non_productive_worker_seconds"] > 0
 
 
+def test_autoscale_leg_smoke(bench, monkeypatch, tmp_path):
+    """The closed-loop autoscaler chaos leg (ISSUE 14 acceptance): the
+    EDL_FAULTS-injected straggler is sensed by the real scorer and
+    auto-evicted within the policy window, throughput recovers, the
+    drained records bill zero wasted work, the control twin's fleet
+    goodput fraction is strictly lower, and the decision journal
+    replays identically with the cooldown inherited (no double-fire).
+    The artifacts must read --strict-clean through the incident CLI
+    (what the chaos-autoscale CI job runs)."""
+    art = str(tmp_path / "art")
+    monkeypatch.setenv("EDL_BENCH_ARTIFACT_DIR", art)
+    monkeypatch.setattr(bench, "AS_TASKS", 15)
+    res = bench.bench_autoscale()
+    assert res["straggler_detected"] is True, res
+    assert res["evicted_straggler"] is True
+    assert res["evicted_within_policy_window"] is True, res
+    assert res["throughput_recovers"] is True, res
+    assert res["drained_records_zero_waste"] is True, res
+    assert "worker_died" not in res["wasted_by_reason"]
+    assert res["goodput_higher_than_control"] is True, res
+    assert res["fleet_goodput_fraction"] > res["goodput_fraction_control"]
+    assert res["journal_replay_identical"] is True
+    assert res["cooldown_inherited_no_double_fire"] is True, res
+    assert res["suppressed_decision_journaled"] is True
+    assert res["journal_actions_applied"] == 1
+    assert res["autoscaler"]["actions_applied"] == 1
+    assert res["autoscaler"]["by_kind"] == {"evict": 1}
+    # fault injection must not leak into later tests
+    from elasticdl_tpu.common import faults
+
+    assert faults.get_injector() is None
+    names = sorted(os.listdir(art))
+    assert "bench-autoscale-journal.jsonl" in names
+    assert "bench-autoscale-trace.jsonl" in names
+    assert "bench-autoscale.health.json" in names
+    assert "bench-autoscale-ledgers.json" in names
+    from elasticdl_tpu.observability import incident
+
+    assert incident.main([art, "--strict"]) == 0
+    # the decision journal in the artifact carries the applied record
+    from elasticdl_tpu.master.journal import replay_lines
+
+    with open(os.path.join(art, "bench-autoscale-journal.jsonl"),
+              encoding="utf-8") as f:
+        state = replay_lines(f.readlines()).autoscale
+    assert state is not None and state.actions_applied == 1
+    assert state.by_kind == {"evict": 1}
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)
@@ -379,8 +428,9 @@ def test_checked_in_baselines_compare_clean_against_themselves(bench):
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     bdir = os.path.join(repo, "bench-baselines")
     names = sorted(os.listdir(bdir))
-    assert {"bench-control-plane.json", "bench-embedding-tier.json",
-            "bench-goodput.json", "bench-obs-overhead.json",
+    assert {"bench-autoscale.json", "bench-control-plane.json",
+            "bench-embedding-tier.json", "bench-goodput.json",
+            "bench-obs-overhead.json",
             "bench-rescale.json"} <= set(names)
     for name in names:
         if not name.endswith(".json"):
