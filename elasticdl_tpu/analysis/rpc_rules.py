@@ -38,6 +38,22 @@ EDL305 non-atomic-state-file-write
     `write_signal`). Opening the `.tmp` sibling itself, append-mode
     handles (a WAL's appends are torn-tail-tolerant by design), and
     scopes that do replace/rename are all quiet.
+
+EDL208 rpc-call-without-deadline
+    an embedding DATA-PLANE stub call (the EmbeddingPull/Push/
+    FetchShard/FetchDelta/Watermark RPC surface, or any method on a
+    local bound to a bare `DataPlaneStub(...)`) without a `timeout=`
+    argument. The data plane is the partition-critical path (ISSUE 15):
+    a deadline-less call against a blackholed owner blocks its worker
+    thread for the channel's whole connect saga, exactly the failure
+    the deadline-budget machinery exists to bound. The reference
+    fixture is embedding/data_plane.py's GrpcTransport, which threads
+    every call's budget down as the gRPC deadline; production callers
+    go through it (or ResilientTransport), never a bare stub. Numbered
+    in the EDL2xx embedding family (EDL206/EDL207's sibling) despite
+    living here with its RPC-hygiene kin. Lint targets are the package
+    tree — tests (outside it) may hold deadline-less calls to probe
+    the failure mode itself.
 """
 
 from __future__ import annotations
@@ -126,6 +142,62 @@ class RpcMissingDeadlineRule(Rule):
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Assign) and _is_call_to(
                 node.value, "MasterStub"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+        return names
+
+
+#: the embedding data-plane RPC surface (embedding/data_plane.py
+#: _DATA_RPCS) — names unique enough that ANY call spelling is a stub
+#: call (the servicer's same-named methods are definitions, not calls)
+DATA_PLANE_RPCS = {
+    "EmbeddingPull", "EmbeddingPush", "EmbeddingFetchShard",
+    "EmbeddingFetchDelta", "EmbeddingWatermark",
+}
+
+
+@register
+class DataPlaneCallWithoutDeadlineRule(Rule):
+    id = "EDL208"
+    name = "rpc-call-without-deadline"
+    doc = (
+        "embedding data-plane stub call without timeout= — blocks a "
+        "worker thread for the whole connect saga against a "
+        "partitioned owner; route through GrpcTransport/"
+        "ResilientTransport (deadline budgets) or pass timeout="
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        bare = self._bare_stub_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+            ):
+                continue
+            method = node.func.attr
+            recv = node.func.value
+            is_data_call = method in DATA_PLANE_RPCS or (
+                isinstance(recv, ast.Name) and recv.id in bare
+            ) or _is_call_to(recv, "DataPlaneStub")
+            if not is_data_call:
+                continue
+            if any(kw.arg == "timeout" for kw in node.keywords):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"data-plane call {method} without timeout= has no "
+                "deadline — it will block for the channel's whole "
+                "connect saga against a partitioned owner",
+            )
+
+    def _bare_stub_names(self, ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _is_call_to(
+                node.value, "DataPlaneStub"
             ):
                 for t in node.targets:
                     if isinstance(t, ast.Name):

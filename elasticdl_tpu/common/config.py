@@ -293,6 +293,34 @@ class JobConfig:
     # deduped pull with the current step's compute; drained (batches
     # re-issued) across rescale/reshard.
     embedding_pull_pipeline: int = 0
+    # --- partition-tolerant gRPC data plane (ISSUE 15,
+    # embedding/data_plane.py) ---
+    # "local" = the in-process LocalTransport (single-process jobs, the
+    # thread-cohort bench swarm); "grpc" = each worker serves its owning
+    # shards over a per-worker EmbeddingData endpoint (bound next to the
+    # observability endpoint, address ridden on RegisterWorker and the
+    # shard-map response) and routes peers' shards through
+    # GrpcTransport, wrapped in the ResilientTransport robustness layer
+    # (deadlines, per-owner breakers, hedged reads, degraded-mode
+    # serving, queued pushes).
+    embedding_transport: str = "local"
+    # per-call deadline BUDGET for data-plane pulls/pushes, in ms:
+    # retries and backoff sleeps spend it, each attempt's wire deadline
+    # is the remainder split over remaining attempts, and it propagates
+    # to the owner as the gRPC deadline (EDL208 polices stub calls that
+    # skip it).
+    embedding_rpc_deadline_ms: int = 2000
+    # hedge delay for data-plane reads, in ms: a pull whose primary has
+    # not answered after this long races a replica (first credible
+    # answer wins). 0 = derive from the measured pull p99 (x1.5, 1 ms
+    # floor) — see docs/performance.md "Hedge-delay sizing"; < 0
+    # disables hedging.
+    embedding_hedge_ms: int = 0
+    # bounded push queue behind an open owner breaker (entries; 0 =
+    # never queue — pushes block/raise through the partition instead).
+    # Queued pushes journal to <checkpoint_dir>/emb-push-queue.jsonl
+    # and drain in order on reconnect under their original seqs.
+    embedding_push_queue: int = 1024
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2
@@ -390,6 +418,25 @@ class JobConfig:
             raise ValueError(
                 "embedding_read_replicas requires the tier "
                 "(embedding_shards > 0)")
+        if self.embedding_transport not in ("local", "grpc"):
+            raise ValueError(
+                "embedding_transport must be 'local' or 'grpc' "
+                f"(got {self.embedding_transport!r})")
+        if (self.embedding_transport == "grpc"
+                and self.embedding_shards <= 0):
+            raise ValueError(
+                "embedding_transport='grpc' requires the tier "
+                "(embedding_shards > 0)")
+        if self.embedding_rpc_deadline_ms <= 0:
+            # a deadline-less data plane blocks forever against a
+            # half-dead owner — the exact failure EDL208 polices in code
+            raise ValueError(
+                "embedding_rpc_deadline_ms must be > 0 (the per-call "
+                "deadline budget; there is no 'no deadline' mode)")
+        if self.embedding_push_queue < 0:
+            raise ValueError(
+                "embedding_push_queue must be >= 0 (0 = never queue "
+                "behind a partitioned owner)")
         if self.flight_ring < 16:
             # a ring too small to hold even one incident's records would
             # silently produce useless bundles; fail at submit time

@@ -51,6 +51,21 @@ Sites threaded through the stack (exact-match, or a `prefix.*` wildcard):
                         is crashed abruptly and rebuilt on the same port,
                         replaying the control-plane journal
                         (master/journal.py) under a bumped generation
+    emb.pull / emb.push / emb.fetch_shard / emb.fetch_delta /
+    emb.watermark       REQUEST-side embedding data-plane sites, fired
+                        before the owner serves (embedding/transport.py
+                        LocalTransport and embedding/data_plane.py
+                        GrpcTransport fire identical sites, so one chaos
+                        schedule drives either transport)
+    emb.pull.recv / emb.push.recv / emb.fetch_shard.recv /
+    emb.fetch_delta.recv
+                        RESPONSE-side twins, fired after the owner
+                        applied/served but before the caller sees the
+                        reply — `drop` here is the lost-ack shape: the
+                        push LANDED, the caller re-sends under the same
+                        seq, and the store's exactly-once fence must
+                        absorb the duplicate (pinned over both
+                        transports)
     metrics_scrape      each /metrics//healthz HTTP request
                         (observability/http.py). Scraping is strictly
                         best-effort, so the terminal actions are remapped

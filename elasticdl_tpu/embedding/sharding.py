@@ -109,6 +109,12 @@ class ShardMapView:
     # Committed next to `owners` in the same journal records, so a
     # successor master replays the replica map identically.
     replicas: Tuple[Tuple[int, ...], ...] = ()
+    # owner ADDRESS BOOK (ISSUE 15): (worker id, data-plane endpoint)
+    # pairs for workers serving an embedding/data_plane.py endpoint —
+    # sourced from registration, ridden on the shard-map response, and
+    # adopted by GrpcTransport.update_addresses at every client refresh.
+    # Empty for local-transport deployments.
+    addrs: Tuple[Tuple[int, str], ...] = ()
 
     def owner_of(self, shard: int) -> int:
         return self.owners[shard]

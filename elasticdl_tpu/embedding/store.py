@@ -562,11 +562,16 @@ class EmbeddingShardStore:
         with sh.lock:
             return sh.wm
 
-    def shard_watermark(self, table: str, shard: int) -> int:
-        """The primary's push watermark — the hot-row cache's freshness
+    def shard_watermark(self, table: str, shard: int,
+                        replica: bool = False) -> int:
+        """The shard's push watermark — the hot-row cache's freshness
         probe (a fully-cache-served client must still learn the owner
-        moved on; tier.py probes this on a lookup cadence)."""
-        sh = self._get_shard(table, shard, None)
+        moved on; tier.py probes this on a lookup cadence).
+        ``replica=True`` reads the replica copy's watermark: a LOWER
+        bound on the primary's, which is what the degraded-mode ladder
+        probes when the primary has partitioned away (tier.py
+        _maybe_probe_watermarks)."""
+        sh = self._get_shard(table, shard, None, replica=replica)
         with sh.lock:
             return sh.wm
 

@@ -64,7 +64,10 @@ from elasticdl_tpu.embedding import sharding
 from elasticdl_tpu.embedding.cache import HotRowCache
 from elasticdl_tpu.embedding.sketch import SpaceSaving
 from elasticdl_tpu.embedding.store import StaleShardMapError
-from elasticdl_tpu.embedding.transport import OwnerUnavailableError
+from elasticdl_tpu.embedding.transport import (
+    DEGRADED_READS,
+    OwnerUnavailableError,
+)
 from elasticdl_tpu.observability.registry import (
     default_registry,
     quantile_sorted,
@@ -220,6 +223,7 @@ class EmbeddingTierClient:
     ):
         self._map_fetch = map_fetch
         self._transport = transport
+        self._wm_replica_ok: Optional[bool] = None  # lazy capability probe
         # incarnation-scoped identity: the stores' seq watermarks OUTLIVE
         # this client (they ride drain checkpoints and shard migrations),
         # so a relaunched worker reusing a bare worker-id client_id would
@@ -320,6 +324,12 @@ class EmbeddingTierClient:
 
     def refresh(self) -> sharding.ShardMapView:
         view = self._map_fetch()
+        # owner address book (ISSUE 15): a remote transport learns where
+        # the owners serve from the same response that names them —
+        # adopted BEFORE the view swap so no call routes to an owner
+        # whose address the transport does not know yet
+        if view.addrs and hasattr(self._transport, "update_addresses"):
+            self._transport.update_addresses(dict(view.addrs))
         invalidate = False
         with self._lock:
             old = self._view
@@ -448,6 +458,7 @@ class EmbeddingTierClient:
         out = np.empty((uniq.shape[0], spec.dim), np.float32)
         if hit_rows is not None:
             out[hit_mask] = hit_rows
+            self._attribute_degraded_hits(view, uniq, hit_mask, counts)
         miss = ~hit_mask
         if miss.any():
             miss_ids = uniq[miss]
@@ -461,25 +472,98 @@ class EmbeddingTierClient:
             self._maybe_probe_watermarks(table, view)
         return out
 
+    def _attribute_degraded_hits(self, view, uniq, hit_mask,
+                                 counts) -> None:
+        """The degraded ladder's \"cache\" rung, honestly attributed
+        (ISSUE 15): a cache hit is normally fenced by watermarks the
+        owner keeps refreshing — but while the owner's breaker is OPEN
+        the observed watermark is frozen (probes fall back to replicas,
+        or fail entirely), so hits on that owner's shards are served
+        beyond `wm_probe` reach. They still honor the LAST verified
+        bound; counting them `edl_emb_degraded_reads_total{mode=
+        \"cache\"}` is what keeps the partition from hiding inside a
+        healthy-looking hit rate."""
+        degraded_fn = getattr(self._transport, "owner_degraded", None)
+        if degraded_fn is None or not hit_mask.any():
+            return
+        bad_shards = [
+            s for s in range(view.num_shards)
+            if degraded_fn(view.owner_of(s))
+        ]
+        if not bad_shards:
+            return
+        hit_ids = uniq[hit_mask]
+        shards = sharding.shard_of(hit_ids, view.num_shards)
+        sel = np.isin(shards, np.asarray(bad_shards))
+        if not sel.any():
+            return
+        if counts is None:
+            n = int(sel.sum())
+        else:
+            n = int(counts[hit_mask][sel].sum())
+        DEGRADED_READS.inc(n, mode="cache")
+
+    def _wm_probe_accepts_replica(self) -> bool:
+        """Whether the transport's `shard_watermark` takes `replica=`
+        (minimal test transports may not). Decided ONCE by signature
+        inspection, not by catching TypeError per probe — a genuine
+        TypeError raised inside a real transport must surface, not
+        silently freeze the watermark fence."""
+        ok = self._wm_replica_ok
+        if ok is None:
+            try:
+                import inspect
+
+                params = inspect.signature(
+                    self._transport.shard_watermark).parameters
+                ok = "replica" in params or any(
+                    p.kind is inspect.Parameter.VAR_KEYWORD
+                    for p in params.values())
+            except (TypeError, ValueError):
+                ok = True   # unintrospectable: assume the full contract
+            self._wm_replica_ok = ok
+        return ok
+
     def _maybe_probe_watermarks(self, table: str, view) -> None:
         """Bound a read-mostly client's staleness: after
         `wm_probe_every` consecutive fully-cache-served lookups, fetch
         each primary's bare watermark so the next lookup's fence sees
         how far the owners really moved. Best-effort — a dead owner's
-        probe is the retry path's problem, not the hit path's."""
+        probe is the retry path's problem, not the hit path's.
+
+        Partition fallback (ISSUE 15): when the PRIMARY's probe fails,
+        ask its replicas for THEIR watermark. A replica's watermark is
+        a lower bound on the primary's — enough to keep the staleness
+        contract one-sided during a partition: foreign pushes that the
+        replica has synced WILL advance the observed watermark and
+        evict rows past the bound, even though the primary is
+        unreachable (the satellite test pins this)."""
         with self._lock:
             n = self._full_hits.get(table, 0) + 1
             self._full_hits[table] = 0 if n >= self.wm_probe_every else n
         if n < self.wm_probe_every:
             return
         for shard in range(view.num_shards):
+            wm = None
             try:
                 wm = self._transport.shard_watermark(
                     view.owner_of(shard), table, shard)
             except (StaleShardMapError, OwnerUnavailableError,
                     faults.FaultInjected):
-                continue
-            self._note_wm(table, view.num_shards, shard, int(wm))
+                if not self._wm_probe_accepts_replica():
+                    continue
+                for rep in view.replicas_of(shard):
+                    if rep == view.owner_of(shard):
+                        continue
+                    try:
+                        wm = self._transport.shard_watermark(
+                            rep, table, shard, replica=True)
+                        break
+                    except (StaleShardMapError, OwnerUnavailableError,
+                            faults.FaultInjected):
+                        continue
+            if wm is not None:
+                self._note_wm(table, view.num_shards, shard, int(wm))
 
     def _pull_owner(self, table: str, spec,
                     uniq: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -1006,6 +1090,14 @@ def view_from_response(resp) -> Optional[sharding.ShardMapView]:
             tuple(int(o) for o in flat[s * rc:(s + 1) * rc] if int(o) >= 0)
             for s in range(int(resp.num_shards))
         )
+    # owner address book (ISSUE 15): parallel arrays on the wire, pairs
+    # in the view (old masters never set them — empty book, local
+    # transport routing only)
+    addr_ids = list(getattr(resp, "addr_worker_ids", ()) or ())
+    addr_strs = list(getattr(resp, "addrs", ()) or ())
+    addrs = tuple(
+        (int(w), a) for w, a in zip(addr_ids, addr_strs) if a
+    )
     return sharding.ShardMapView(
         version=int(resp.version),
         num_shards=int(resp.num_shards),
@@ -1019,6 +1111,7 @@ def view_from_response(resp) -> Optional[sharding.ShardMapView]:
         ),
         resharding=bool(resp.resharding),
         replicas=replicas,
+        addrs=addrs,
     )
 
 
@@ -1097,7 +1190,7 @@ class WorkerTierRuntime:
     def __init__(self, stub, worker_id: int, checkpoint_dir: str = "",
                  transport=None, cache_rows: int = 0,
                  cache_staleness: int = 1, read_replicas: bool = False,
-                 pipeline_depth: int = 0):
+                 pipeline_depth: int = 0, bind_servicer=None):
         from elasticdl_tpu.embedding.store import EmbeddingShardStore
 
         self._stub = stub
@@ -1108,12 +1201,21 @@ class WorkerTierRuntime:
             else default_transport()
         self.store = EmbeddingShardStore(worker_id)
         self.transport.register(self.store)
+        if bind_servicer is not None:
+            # gRPC data plane (ISSUE 15): the worker's endpoint came up
+            # before registration (its address rides RegisterWorker);
+            # the store binds late, here, once it exists
+            bind_servicer.bind_store(self.store)
         self.client = EmbeddingTierClient(
             stub_map_fetch(stub, worker_id), self.transport,
             client_id=f"worker-{worker_id}",
             cache_rows=cache_rows, cache_staleness=cache_staleness,
             read_replicas=read_replicas,
         )
+        if hasattr(self.transport, "set_view_fn"):
+            # the robustness layer hedges to replicas and re-routes
+            # drained pushes off the client's live view
+            self.transport.set_view_fn(lambda: self.client.view)
         created = self.store.attach(self.client.view, checkpoint_dir)
         if created and self.client.view.resharding:
             confirm_reshard(
@@ -1247,6 +1349,15 @@ class WorkerTierRuntime:
         sync loop's."""
         view = self.client.view
         synced = 0
+        if hasattr(self.transport, "drain_queued"):
+            # reconnect drain (ISSUE 15): pushes parked behind an open
+            # owner breaker re-send in order on the task-boundary
+            # cadence — the same cadence that already retries deferred
+            # replica installs
+            try:
+                self.transport.drain_queued()
+            except Exception:
+                logger.debug("queued-push drain deferred", exc_info=True)
         if set(self.store.resident_replicas()) != {
             (t.name, s)
             for s in view.shards_replicated_on(self.worker_id)

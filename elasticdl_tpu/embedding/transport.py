@@ -3,28 +3,54 @@
 The data plane is pluggable: `LocalTransport` serves the in-process
 deployments this repo can actually run (single-process workers, the
 thread-cohort bench swarm) and is the reference implementation of the
-call contract; a cross-host gRPC transport slots in behind the same
-three methods without touching client or store (the wire schema is the
-shard-map RPCs' sibling — see docs/architecture.md "Embedding tier").
+call contract; the cross-host `GrpcTransport` (embedding/data_plane.py)
+slots in behind the same methods without touching client or store, and
+`SimWireTransport` puts a deterministic simulated wire in front of any
+inner transport so the bench's read-layer legs and the real gRPC legs
+are interchangeable runs of the same scenario.
 
 Every call crosses a REAL boundary even in-process: requests and
 responses are numpy arrays (never shared jax buffers), and the
-fault-injection sites ``emb.pull`` / ``emb.push`` / ``emb.fetch_shard``
-(common/faults.py) wrap each call so chaos schedules can drop or delay
-tier traffic deterministically — the exactly-once tests ride these.
+fault-injection sites wrap each call so chaos schedules can drop or
+delay tier traffic deterministically — the exactly-once tests ride
+these. Each method fires a REQUEST-side site (``emb.pull``,
+``emb.push``, ``emb.fetch_shard``, ``emb.fetch_delta``,
+``emb.watermark``) before the owner serves, and a RESPONSE-side
+``.recv`` twin after it (``emb.pull.recv``, ``emb.push.recv``,
+``emb.fetch_shard.recv``, ``emb.fetch_delta.recv``): a ``.recv`` drop
+models a reply lost AFTER the owner applied — the hard case for a
+non-idempotent push, which the per-(client, seq) fence must absorb
+(the caller re-sends under the same seq and the store acks the
+duplicate without touching the table).
 """
 
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
+
+#: the degraded-mode ladder's honesty counter (ISSUE 15), shared by the
+#: robustness layer (data_plane.ResilientTransport: mode="replica" when
+#: a hedge served because the primary FAILED, mode="blocked" when every
+#: rung failed) and the tier client (tier.py: mode="cache" for hits
+#: served while the owner's breaker is open — freshness is then running
+#: on the last observed watermark, beyond wm_probe reach). Registered
+#: here because the ladder spans both modules and the registry rejects
+#: duplicate names.
+DEGRADED_READS = default_registry().counter(
+    "edl_emb_degraded_reads_total",
+    "reads served (or refused) by the degraded-mode ladder while an "
+    "owner was partitioned away, by rung",
+    labels=("mode",))
 
 
 class OwnerUnavailableError(ConnectionError):
@@ -71,8 +97,12 @@ class LocalTransport:
              with_watermark: bool = False, replica: bool = False):
         faults.fire("emb.pull")
         store = self.store_of(owner)
-        return store.pull(table, shard, local_ids, map_version=map_version,
-                          with_watermark=with_watermark, replica=replica)
+        out = store.pull(table, shard, local_ids, map_version=map_version,
+                         with_watermark=with_watermark, replica=replica)
+        # response-side injection: the owner DID serve; the reply is lost
+        # on the way back (reads are idempotent — the caller re-pulls)
+        faults.fire("emb.pull.recv")
+        return out
 
     def push(self, owner: int, table: str, shard: int,
              local_ids: np.ndarray, rows: np.ndarray, *, client_id: str,
@@ -93,13 +123,20 @@ class LocalTransport:
     def fetch_shard(self, owner: int, table: str,
                     shard: int) -> Dict[str, Any]:
         faults.fire("emb.fetch_shard")
-        return self.store_of(owner).extract_shard(table, shard)
+        payload = self.store_of(owner).extract_shard(table, shard)
+        faults.fire("emb.fetch_shard.recv")
+        return payload
 
-    def shard_watermark(self, owner: int, table: str, shard: int) -> int:
+    def shard_watermark(self, owner: int, table: str, shard: int,
+                        replica: bool = False) -> int:
         """Watermark-only freshness probe (no rows cross the wire) —
-        what bounds a fully-cache-served client's staleness."""
+        what bounds a fully-cache-served client's staleness.
+        ``replica=True`` probes the owner's replica copy: a lower bound
+        on the primary's watermark, the degraded ladder's fallback when
+        the primary has partitioned away."""
         faults.fire("emb.watermark")
-        return self.store_of(owner).shard_watermark(table, shard)
+        return self.store_of(owner).shard_watermark(
+            table, shard, replica=replica)
 
     def fetch_delta(self, owner: int, table: str, shard: int,
                     since_wm: int) -> Optional[Dict[str, Any]]:
@@ -107,4 +144,62 @@ class LocalTransport:
         (watermark-tagged, contiguous) or None when its bounded delta log
         no longer reaches back — the replica then re-copies the shard."""
         faults.fire("emb.fetch_delta")
-        return self.store_of(owner).fetch_delta(table, shard, since_wm)
+        delta = self.store_of(owner).fetch_delta(table, shard, since_wm)
+        faults.fire("emb.fetch_delta.recv")
+        return delta
+
+
+class SimWireTransport:
+    """Any transport behind a deterministic simulated wire: every
+    data-plane call sleeps ``base + real_rows * per_row`` before
+    serving. sleep() releases the GIL, so pipeline overlap and replica
+    fan-out compose exactly as against a real network peer — which is
+    what the read layers exist for; in-process the serve is free and
+    there is nothing to cache or overlap.
+
+    Folded behind the shared transport contract (ISSUE 15) so the
+    bench's sim-wire legs and the real gRPC transport are
+    interchangeable runs of the same scenario — and so the model's
+    constants (`bench.py` ET_WIRE_US / ET_WIRE_ROW_US) can be
+    CALIBRATED against the measured loopback RPC cost the `data_plane`
+    leg reports (`wire_truth`). Wire constants ride the bench record;
+    0/0 disables the model entirely (pure delegation)."""
+
+    def __init__(self, inner, call_us: float, row_us: float):
+        self._inner = inner
+        self._call_s = call_us * 1e-6
+        self._row_s = row_us * 1e-6
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _wire(self, rows: int) -> None:
+        if self._call_s or self._row_s:
+            time.sleep(self._call_s + rows * self._row_s)
+
+    def pull(self, owner, table, shard, local_ids, **kw):
+        self._wire(int((local_ids >= 0).sum()))
+        return self._inner.pull(owner, table, shard, local_ids, **kw)
+
+    def push(self, owner, table, shard, local_ids, rows, **kw):
+        self._wire(int((local_ids >= 0).sum()))
+        return self._inner.push(owner, table, shard, local_ids, rows, **kw)
+
+    def shard_watermark(self, owner, table, shard, replica=False):
+        self._wire(0)
+        return self._inner.shard_watermark(
+            owner, table, shard, replica=replica)
+
+    def fetch_shard(self, owner, table, shard):
+        payload = self._inner.fetch_shard(owner, table, shard)
+        self._wire(int(payload["rows"].shape[0]))
+        return payload
+
+    def fetch_delta(self, owner, table, shard, since_wm):
+        delta = self._inner.fetch_delta(owner, table, shard, since_wm)
+        if delta is None:
+            self._wire(0)
+        else:
+            self._wire(sum(int(e["ids"].shape[0])
+                           for e in delta["entries"]))
+        return delta

@@ -354,7 +354,10 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                     break
             membership.workers.append(
                 {"worker_id": wid, "name": rec.get("name", ""), "alive": True,
-                 "led_by": rec.get("led_by")}
+                 "led_by": rec.get("led_by"),
+                 # embedding data-plane endpoint (ISSUE 15): replays so a
+                 # successor master serves the same owner address book
+                 "data_addr": rec.get("data_addr") or ""}
             )
             membership.next_id = max(membership.next_id, wid + 1)
             membership.version = max(membership.version, int(rec.get("version", 0)))

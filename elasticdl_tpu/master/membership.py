@@ -68,6 +68,11 @@ class WorkerInfo:
     # are skipped by reap scans (their liveness IS the leader's beat),
     # never bump the membership version, and die with their leader.
     led_by: Optional[int] = None
+    # embedding data-plane endpoint this worker serves its owning shards
+    # from (embedding/data_plane.py; "" = none). Journaled with the join
+    # so a successor master replays the owner address book — the
+    # shard-map response carries it to every tier client.
+    data_addr: str = ""
 
 
 class Membership(CommitGate):
@@ -118,6 +123,7 @@ class Membership(CommitGate):
                 last_heartbeat=now,
                 alive=bool(w.get("alive", True)),
                 led_by=int(led_by) if led_by is not None else None,
+                data_addr=str(w.get("data_addr") or ""),
             )
         self._next_id = snap.next_id
         self._version = snap.version
@@ -139,7 +145,8 @@ class Membership(CommitGate):
         TaskDispatcher.recover_tasks."""
         self._death_callbacks.append(cb)
 
-    def register(self, name: str, preferred_id: int = -1) -> WorkerInfo:
+    def register(self, name: str, preferred_id: int = -1,
+                 data_addr: str = "") -> WorkerInfo:
         with self._lock:
             wid = None
             if preferred_id >= 0:
@@ -149,12 +156,15 @@ class Membership(CommitGate):
             if wid is None:
                 wid = self._next_id
             self._next_id = max(self._next_id, wid + 1)
-            info = WorkerInfo(worker_id=wid, name=name, last_heartbeat=time.time())
+            info = WorkerInfo(worker_id=wid, name=name,
+                              last_heartbeat=time.time(),
+                              data_addr=data_addr or "")
             self._workers[wid] = info
             self._version += 1
             version = self._version     # the version THIS join created
             self._j(
-                "member_join", worker_id=wid, name=name, version=version
+                "member_join", worker_id=wid, name=name, version=version,
+                data_addr=info.data_addr,
             )
             _MB_REGISTERED.inc()
             _MB_ALIVE.set(self._alive_count_locked())
@@ -242,7 +252,8 @@ class Membership(CommitGate):
             )
         return infos
 
-    def reregister(self, worker_id: int, name: str) -> WorkerInfo:
+    def reregister(self, worker_id: int, name: str,
+                   data_addr: str = "") -> WorkerInfo:
         """Idempotent re-register of a worker that was ALREADY a member —
         the reconnect handshake after a master restart. A live worker's
         entry is refreshed in place with NO version bump (the worker set
@@ -257,15 +268,26 @@ class Membership(CommitGate):
                 info.name = name or info.name
                 info.last_heartbeat = time.time()
                 revived = not info.alive
+                addr_changed = bool(data_addr) and data_addr != info.data_addr
+                if data_addr:
+                    info.data_addr = data_addr
                 if revived:
                     info.alive = True
                     self._version += 1
                     self._j(
                         "member_join", worker_id=worker_id, name=info.name,
-                        version=self._version,
+                        version=self._version, data_addr=info.data_addr,
                     )
                     _MB_ALIVE.set(self._alive_count_locked())
                     _MB_VERSION.set(self._version)
+                elif addr_changed:
+                    # no version bump (the worker set did not change) but
+                    # the address book did — journal the join record so a
+                    # successor's replay routes to the NEW endpoint
+                    self._j(
+                        "member_join", worker_id=worker_id, name=info.name,
+                        version=self._version, data_addr=info.data_addr,
+                    )
                 version = self._version
                 logger.info(
                     "worker %d (%s) re-registered%s; membership v%d",
@@ -275,7 +297,8 @@ class Membership(CommitGate):
         if info is not None:
             self._await(commit)
         if info is None:
-            return self.register(name, preferred_id=worker_id)
+            return self.register(name, preferred_id=worker_id,
+                                 data_addr=data_addr)
         tracing.event(
             "membership.reregister", worker_id=worker_id, worker_name=name,
             version=version,
@@ -428,6 +451,18 @@ class Membership(CommitGate):
     def alive_workers(self) -> List[WorkerInfo]:
         with self._lock:
             return [w for w in self._workers.values() if w.alive]
+
+    def data_addresses(self) -> List[Tuple[int, str]]:
+        """The owner address book (ISSUE 15): (worker id, data-plane
+        endpoint) for every alive logical worker that registered one —
+        what the shard-map response carries so tier clients can route
+        pull/push over gRPC to whichever process owns a shard."""
+        with self._lock:
+            return sorted(
+                (w.worker_id, w.data_addr)
+                for w in self._workers.values()
+                if w.alive and w.led_by is None and w.data_addr
+            )
 
     def health_snapshot(self) -> List[Dict]:
         """Telemetry records (copies) of currently-ALIVE workers — the

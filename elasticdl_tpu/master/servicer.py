@@ -148,6 +148,7 @@ class MasterServicer:
         # call — the reconnect handshake clears the claim first
         self._fence_generation("RegisterWorker", context)
         preferred = request.preferred_id_plus_one - 1
+        data_addr = str(getattr(request, "data_plane_addr", "") or "")
         if (
             self._request_metadata(context).get(REREGISTER_KEY) == "1"
             and preferred >= 0
@@ -155,10 +156,12 @@ class MasterServicer:
             # reconnect of an existing member (e.g. after a master
             # restart): idempotent — a live worker keeps its id and bumps
             # nothing, a reaped one is revived; never a duplicate join
-            info = self._membership.reregister(preferred, request.worker_name)
+            info = self._membership.reregister(
+                preferred, request.worker_name, data_addr=data_addr)
             _REREGISTERS.inc()
         else:
-            info = self._membership.register(request.worker_name, preferred)
+            info = self._membership.register(
+                request.worker_name, preferred, data_addr=data_addr)
         member_ids = []
         if request.member_names:
             # cohort-aggregated membership: the leader's member processes
@@ -331,6 +334,13 @@ class MasterServicer:
                 name=t.name, vocab=t.vocab, dim=t.dim, seed=t.seed,
                 init_scale=t.init_scale,
             )
+        # owner address book (ISSUE 15): every alive worker's embedding
+        # data-plane endpoint rides the map response — GrpcTransport
+        # clients adopt it on every refresh, so a relaunched owner's new
+        # address propagates on the same cadence as ownership itself
+        for wid, addr in self._membership.data_addresses():
+            resp.addr_worker_ids.append(wid)
+            resp.addrs.append(addr)
         return resp
 
     def ReportEmbeddingReshard(self, request, context):

@@ -36,9 +36,11 @@ _PB2_PATH = os.path.join(_HERE, "elasticdl_tpu_pb2.py")
 # (message, field name, field number, type, extras)
 _SCALAR = {
     "int32": descriptor_pb2.FieldDescriptorProto.TYPE_INT32,
+    "int64": descriptor_pb2.FieldDescriptorProto.TYPE_INT64,
     "string": descriptor_pb2.FieldDescriptorProto.TYPE_STRING,
     "float": descriptor_pb2.FieldDescriptorProto.TYPE_FLOAT,
     "bool": descriptor_pb2.FieldDescriptorProto.TYPE_BOOL,
+    "bytes": descriptor_pb2.FieldDescriptorProto.TYPE_BYTES,
 }
 
 
@@ -177,6 +179,103 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
     changed += _add_field(
         msgs["GetEmbeddingShardMapResponse"], "shard_replicas", 7, "int32",
         repeated=True)
+
+    # Cross-host embedding data plane (ISSUE 15, embedding/data_plane.py):
+    # every worker serves its owning store over a per-worker gRPC
+    # endpoint; peers reach it through the OWNER ADDRESS BOOK that rides
+    # the shard-map response (addr_worker_ids[i] serves at addrs[i]).
+    # Workers report their data-plane address at registration; old
+    # workers never set it and are simply absent from the book (their
+    # shards stay reachable in-process / via LocalTransport only).
+    changed += _add_field(
+        msgs["RegisterWorkerRequest"], "data_plane_addr", 4, "string")
+    changed += _add_field(
+        msgs["GetEmbeddingShardMapResponse"], "addr_worker_ids", 8, "int32",
+        repeated=True)
+    changed += _add_field(
+        msgs["GetEmbeddingShardMapResponse"], "addrs", 9, "string",
+        repeated=True)
+
+    # Data-plane RPC payloads. Id vectors travel as raw little-endian
+    # int32 bytes and row matrices as raw float32 bytes + a dim field
+    # (one memcpy each way — repeated scalar varint packing would cost
+    # real CPU at serving rates). Watermarks are int64: they count every
+    # applied push over a job's lifetime.
+    changed += _new_msg("EmbeddingPullRequest", [
+        ("table", 1, "string", {}),
+        ("shard", 2, "int32", {}),
+        ("ids", 3, "bytes", {}),          # int32 LE, pow2-padded (-1)
+        ("map_version", 4, "int32", {}),
+        ("with_watermark", 5, "bool", {}),
+        ("replica", 6, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingPullResponse", [
+        ("rows", 1, "bytes", {}),         # float32 LE, (n_ids, dim)
+        ("dim", 2, "int32", {}),
+        ("wm", 3, "int64", {}),
+    ])
+    changed += _new_msg("EmbeddingPushRequest", [
+        ("table", 1, "string", {}),
+        ("shard", 2, "int32", {}),
+        ("ids", 3, "bytes", {}),
+        ("rows", 4, "bytes", {}),
+        ("dim", 5, "int32", {}),
+        ("client_id", 6, "string", {}),
+        ("seq", 7, "int64", {}),
+        ("map_version", 8, "int32", {}),
+        ("scale", 9, "float", {}),
+        ("with_watermark", 10, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingPushResponse", [
+        ("applied", 1, "bool", {}),
+        ("wm", 2, "int64", {}),
+    ])
+    changed += _new_msg("EmbeddingFetchShardRequest", [
+        ("table", 1, "string", {}),
+        ("shard", 2, "int32", {}),
+        ("replica", 3, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingFetchShardResponse", [
+        ("rows", 1, "bytes", {}),
+        ("rows_n", 2, "int32", {}),
+        ("dim", 3, "int32", {}),
+        # exactly-once seq watermarks as the same compact JSON dict the
+        # checkpoint .npz files carry — the fence TRAVELS with the shard
+        ("applied_json", 4, "string", {}),
+        ("wm", 5, "int64", {}),
+    ])
+    changed += _new_msg("EmbeddingDeltaEntry", [
+        ("wm", 1, "int64", {}),
+        ("ids", 2, "bytes", {}),
+        ("rows", 3, "bytes", {}),
+        ("dim", 4, "int32", {}),
+        ("scale", 5, "float", {}),
+        ("client_id", 6, "string", {}),
+        ("seq", 7, "int64", {}),
+    ])
+    changed += _new_msg("EmbeddingFetchDeltaRequest", [
+        ("table", 1, "string", {}),
+        ("shard", 2, "int32", {}),
+        ("since_wm", 3, "int64", {}),
+    ])
+    changed += _new_msg("EmbeddingFetchDeltaResponse", [
+        # False = the bounded delta log no longer reaches back to
+        # since_wm; the caller falls back to a full FetchShard copy
+        ("found", 1, "bool", {}),
+        ("wm", 2, "int64", {}),
+        ("entries", 3, "", {
+            "repeated": True,
+            "type_name": ".elasticdl_tpu.EmbeddingDeltaEntry",
+        }),
+    ])
+    changed += _new_msg("EmbeddingWatermarkRequest", [
+        ("table", 1, "string", {}),
+        ("shard", 2, "int32", {}),
+        ("replica", 3, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingWatermarkResponse", [
+        ("wm", 1, "int64", {}),
+    ])
     return changed
 
 

@@ -191,9 +191,16 @@ class CircuitBreaker:
     window cheap and the log honest.
     """
 
-    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 10.0):
+    def __init__(self, failure_threshold: int = 5, cooldown_s: float = 10.0,
+                 telemetry: bool = True):
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
+        # telemetry=False reuses the state machine without the
+        # master-breaker gauges/events/log lines (the embedding data
+        # plane keeps per-owner breakers and its own edl_emb_owner_*
+        # metrics — a partitioned owner must not read as a master
+        # outage on edl_rpc_breaker_open, nor close it back to 0)
+        self._telemetry = telemetry
         # consecutive_failures is read lock-free by RetryingMasterStub's
         # error message (a snapshot for humans, not a decision input)
         self.consecutive_failures = 0
@@ -229,7 +236,7 @@ class CircuitBreaker:
             self.consecutive_failures = 0
             self._opened_at = None
             self._probe_in_flight = False
-        if reopened:
+        if reopened and self._telemetry:
             _BREAKER_OPEN.set(0)
             tracing.event("rpc.breaker_closed")
             logger.info("master circuit closed again (probe succeeded)")
@@ -250,7 +257,7 @@ class CircuitBreaker:
             self.consecutive_failures = 0
             self._opened_at = None
             self._probe_in_flight = False
-        if dirty:
+        if dirty and self._telemetry:
             _BREAKER_OPEN.set(0)
             _BREAKER_RESETS.inc()
             tracing.event("rpc.breaker_reset")
@@ -268,7 +275,7 @@ class CircuitBreaker:
                 self._opened_at = time.monotonic()
                 opened_now = True
             failures = self.consecutive_failures
-        if opened_now:
+        if opened_now and self._telemetry:
             _BREAKER_OPEN.set(1)
             _BREAKER_TRIPS.inc()
             tracing.event("rpc.breaker_open", consecutive_failures=failures)
@@ -644,6 +651,7 @@ def register_with_retry(
     shutdown: threading.Event,
     what: str = "worker",
     member_names=(),
+    data_addr: str = "",
 ):
     """Boot-time registration hardened against a master that is down or
     RESTARTING right now (observed: a master crash with the registration
@@ -670,6 +678,7 @@ def register_with_retry(
             worker_name=name,
             preferred_id_plus_one=preferred_id + 1 if preferred_id >= 0 else 0,
             member_names=list(member_names),
+            data_plane_addr=data_addr,
         )
         metadata = (
             ((REREGISTER_KEY, "1"),) if attempt and preferred_id >= 0 else None
@@ -697,7 +706,7 @@ def register_with_retry(
 
 
 def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int,
-               member_names=()):
+               member_names=(), data_addr: str = ""):
     """The reconnect handshake after a master restart: clear the stale
     generation claim (a generation-free RegisterWorker is what learns the
     new one from the response's trailing metadata), then re-register under
@@ -716,6 +725,7 @@ def reregister(stub: "RetryingMasterStub", *, name: str, worker_id: int,
             pb.RegisterWorkerRequest(
                 worker_name=name, preferred_id_plus_one=worker_id + 1,
                 member_names=list(member_names),
+                data_plane_addr=data_addr,
             ),
             timeout=30,
             metadata=((REREGISTER_KEY, "1"),),

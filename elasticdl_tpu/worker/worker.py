@@ -145,6 +145,11 @@ class Worker:
         # registered once, reused by every reconnect handshake: a renamed
         # re-register would silently overwrite the membership entry's name
         self._name = f"{socket.gethostname()}:{os.getpid()}"
+        # gRPC embedding data plane (ISSUE 15): the endpoint comes up
+        # BEFORE registration so its address can ride the RegisterWorker
+        # request into the owner address book; the store binds later,
+        # when the tier runtime builds it (_init_embedding_tier)
+        self._start_data_plane()
         preferred = int(os.environ.get(WorkerEnv.WORKER_ID, -1))
         resp = self._boot_register(self._name, preferred)
         self.worker_id = resp.worker_id
@@ -175,6 +180,38 @@ class Worker:
             self.worker_id, resp.membership_version, resp.num_workers,
         )
 
+    def _start_data_plane(self) -> None:
+        """Bind the per-worker EmbeddingData endpoint (next to the
+        observability endpoint — both are sidecar servers on daemon
+        threads). A bind failure is fatal: `--embedding_transport grpc`
+        means peers' shards live in other processes, so silently
+        falling back to LocalTransport would leave every peer-owned
+        pull/push raising OwnerUnavailableError forever (and peers
+        unable to reach our shards) — fail at boot, loudly, instead."""
+        self._data_server = None
+        if (self.cfg.embedding_shards <= 0
+                or self.cfg.embedding_transport != "grpc"):
+            return
+        try:
+            from elasticdl_tpu.embedding.data_plane import (
+                EmbeddingDataServer,
+            )
+
+            self._data_server = EmbeddingDataServer()
+            self._data_server.start()
+        except Exception as e:
+            self._data_server = None
+            raise RuntimeError(
+                "embedding data-plane endpoint failed to start but "
+                "--embedding_transport grpc requires it (peer-owned "
+                f"shards are unreachable over LocalTransport): {e}"
+            ) from e
+
+    @property
+    def _data_addr(self) -> str:
+        srv = getattr(self, "_data_server", None)
+        return srv.address or "" if srv is not None else ""
+
     def _boot_register(self, name: str, preferred: int):
         """Boot-time registration that rides out a master that is down or
         restarting (see proto/service.py's register_with_retry — shared
@@ -185,6 +222,7 @@ class Worker:
             preferred_id=preferred,
             window_s=self.cfg.master_unreachable_timeout_s,
             shutdown=self._shutdown,
+            data_addr=self._data_addr,
         )
 
     def _note_master_ok(self) -> None:
@@ -219,6 +257,7 @@ class Worker:
         re-register under our EXISTING worker id, then apply the response."""
         resp = reregister(
             self._stub, name=self._name, worker_id=self.worker_id,
+            data_addr=self._data_addr,
         )
         # drop locally queued leases: the restarted master conservatively
         # requeued every lease of the dead generation, so these tasks will
@@ -1096,9 +1135,40 @@ class Worker:
         try:
             from elasticdl_tpu.embedding.tier import WorkerTierRuntime
 
+            transport = bind_servicer = None
+            if (self.cfg.embedding_transport == "grpc"
+                    and getattr(self, "_data_server", None) is not None):
+                # the partition-tolerant data plane (ISSUE 15): route
+                # peers' shards over gRPC through the robustness layer;
+                # our own store short-circuits in-process
+                from elasticdl_tpu.embedding.data_plane import (
+                    GrpcTransport,
+                    ResilientTransport,
+                    default_policies,
+                )
+
+                budget_s = self.cfg.embedding_rpc_deadline_ms / 1e3
+                queue_journal = ""
+                if (self.cfg.embedding_push_queue > 0
+                        and self.cfg.checkpoint_dir):
+                    queue_journal = os.path.join(
+                        self.cfg.checkpoint_dir,
+                        f"emb-push-queue-{self.worker_id}.jsonl")
+                transport = ResilientTransport(
+                    GrpcTransport(default_timeout_s=budget_s),
+                    policies=default_policies(budget_s),
+                    staleness_bound=self.cfg.embedding_cache_staleness,
+                    hedge=self.cfg.embedding_hedge_ms >= 0,
+                    hedge_delay_ms=max(0, self.cfg.embedding_hedge_ms),
+                    queue_journal=queue_journal,
+                    queue_max=self.cfg.embedding_push_queue,
+                )
+                bind_servicer = self._data_server.servicer
             self._tier = WorkerTierRuntime(
                 self._stub, self.worker_id,
                 checkpoint_dir=self.cfg.checkpoint_dir,
+                transport=transport,
+                bind_servicer=bind_servicer,
                 cache_rows=self.cfg.embedding_cache_rows,
                 cache_staleness=self.cfg.embedding_cache_staleness,
                 read_replicas=self.cfg.embedding_read_replicas > 0,
@@ -1323,6 +1393,12 @@ class Worker:
                 self._metrics_server.stop()
             except Exception:
                 logger.debug("metrics endpoint stop failed", exc_info=True)
+        if getattr(self, "_data_server", None) is not None:
+            try:
+                self._data_server.stop()
+            except Exception:
+                logger.debug("data-plane endpoint stop failed",
+                             exc_info=True)
         # flush trace.jsonl durably (the tracer reopens on reconfigure)
         tracing.get_tracer().close()
         if self._heartbeat_thread is not None:

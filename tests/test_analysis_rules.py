@@ -625,6 +625,78 @@ def test_constant_sleep_in_retry_loop_fires():
 
 
 # ------------------------------------------------------------------ #
+# EDL208 rpc-call-without-deadline (embedding data plane)
+
+
+def test_data_plane_call_without_deadline_fires():
+    bad = """
+        def sync(stub, req):
+            return stub.EmbeddingPull(req)        # BAD: no deadline
+    """
+    fs = findings_for(bad, select={"EDL208"})
+    assert len(fs) == 1 and fs[0].rule == "EDL208"
+
+    bare_stub = """
+        def build(channel, req):
+            stub = DataPlaneStub(channel)
+            return stub.anything(req)             # BAD: bare stub local
+    """
+    assert len(findings_for(bare_stub, select={"EDL208"})) == 1
+
+
+def test_data_plane_call_with_deadline_is_quiet():
+    good = """
+        def sync(stub, req, budget):
+            stub.EmbeddingWatermark(req, timeout=1.0)
+            return stub.EmbeddingPush(req, timeout=budget)
+    """
+    assert findings_for(good, select={"EDL208"}) == []
+
+
+def test_data_plane_rule_ignores_definitions_and_unrelated_calls():
+    # the servicer DEFINES methods with the RPC names — definitions are
+    # not calls; unrelated attribute calls stay quiet
+    good = """
+        class Servicer:
+            def EmbeddingPull(self, request, context):
+                return self._store.pull(request.table)
+
+        def other(client):
+            client.pull_embeddings(batch)
+    """
+    assert findings_for(good, select={"EDL208"}) == []
+
+
+def test_data_plane_call_suppressible():
+    bad = """
+        def probe(stub, req):
+            return stub.EmbeddingPull(req)  # edl-lint: disable=EDL208
+    """
+    assert findings_for(bad, select={"EDL208"}) == []
+
+
+def test_data_plane_reference_fixture_is_the_transport():
+    # the new transport is the reference fixture: every stub call in
+    # embedding/data_plane.py threads a deadline, so the rule is clean
+    # over the real module
+    import elasticdl_tpu.embedding.data_plane as dp
+
+    with open(dp.__file__) as f:
+        src = f.read()
+    ctx = ModuleContext(dp.__file__, src, "elasticdl_tpu/embedding/data_plane.py")
+    from elasticdl_tpu.analysis.core import all_rules
+
+    fs = [
+        f
+        for rule in all_rules()
+        if rule.id == "EDL208"
+        for f in rule.check(ctx)
+        if not ctx.suppressed(f)
+    ]
+    assert fs == []
+
+
+# ------------------------------------------------------------------ #
 # EDL305 non-atomic-state-file-write
 
 

@@ -325,6 +325,54 @@ def test_autoscale_leg_smoke(bench, monkeypatch, tmp_path):
     assert state.by_kind == {"evict": 1}
 
 
+def test_data_plane_leg_smoke(bench, monkeypatch, tmp_path):
+    """The partition-tolerant gRPC data-plane chaos leg (ISSUE 15
+    acceptance): real subprocess owners over real gRPC, an injected
+    partition (client-side drops + a channel blackhole), hedged reads
+    served bounded while the unhedged control blocks to its deadline,
+    degraded reads attributed by mode, zero double-applied pushes
+    across the heal (seq-fence audit), and the push-queue journal
+    replaying identically. The artifacts must read --strict-clean
+    through the incident CLI (what the chaos-data-plane CI job runs).
+    The 3x-p99 boundedness gate belongs to the real bench run — a
+    throttled CI box can't hold a tight percentile — so the smoke pins
+    an ABSOLUTE ceiling far under the deadline the control pays."""
+    art = str(tmp_path / "art")
+    monkeypatch.setenv("EDL_BENCH_ARTIFACT_DIR", art)
+    monkeypatch.setattr(bench, "DP_STEPS", 20)
+    res = bench.bench_data_plane()
+    budget_ms = res["deadline_budget_ms"]
+    # hedging kept reads served and bounded while the control blocked
+    assert res["read_p99_under_partition_ms"] < budget_ms / 2, res
+    assert res["control_blocked_to_deadline"] is True, res
+    assert res["control_blocked_p99_ms"] >= 0.8 * budget_ms
+    assert res["hedged_pulls"] >= 1
+    # the degraded ladder attributed every rung
+    assert res["degraded_modes_attributed"] is True, res
+    assert res["degraded_reads"]["replica"] > 0
+    assert res["degraded_reads"]["cache"] > 0
+    assert res["degraded_read_share"] > 0.5
+    # exactly-once across the partition heal
+    assert res["zero_double_applied_pushes"] is True, res
+    assert res["seq_fence_max_row_error"] < 1e-4
+    assert res["queued_pushes_drained"] == res["push_queue_depth_at_heal"]
+    assert res["push_queue_empty_after_heal"] is True
+    assert res["journal_replays_identically"] is True, res
+    # wire truth rides the record (sim-wire calibration input)
+    assert res["wire_truth"]["measured_loopback_call_us"] > 0
+    # fault injection must not leak into later tests
+    from elasticdl_tpu.common import faults
+
+    assert faults.get_injector() is None
+    names = sorted(os.listdir(art))
+    assert "bench-data-plane-trace.jsonl" in names
+    assert "bench-data-plane-pushes.jsonl" in names
+    assert "bench-data-plane.health.json" in names
+    from elasticdl_tpu.observability import incident
+
+    assert incident.main([art, "--strict"]) == 0
+
+
 def test_leg_dispatch_unknown_leg_exits(bench, mesh8):
     with pytest.raises(SystemExit):
         bench._run_leg("no_such_leg", mesh8, np)
