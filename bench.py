@@ -3414,6 +3414,97 @@ def bench_autoscale(mesh=None, np=None):
     return out
 
 
+def bench_fleet_soak(mesh=None, np=None):
+    """Thousand-worker fleet soak (ISSUE 16): protocol-faithful scripted
+    worker lifecycles drive the REAL master stack (journal, membership,
+    dispatcher, alerts, autoscaler) over compressed virtual time. Two
+    chaos legs at EDL_BENCH_FLEET_WORKERS (default 1000) — correlated
+    rack loss and a double master kill — must end with the job finished,
+    the journal replaying record-identically, zero acked leases lost and
+    the incident CLI strict-clean. A third leg runs the noisy-signal
+    scenario twice: damped (EWMA + reversal hold, the shipped defaults)
+    versus an undamped twin — the damped run must hold position
+    (0 reversals) while the twin oscillates. `mesh`/`np` ignored
+    (uniform leg signature; jax-free)."""
+    import tempfile
+
+    from elasticdl_tpu.fleetsim import builtin_scenario_path, load_scenario
+    from elasticdl_tpu.fleetsim.sim import run_scenario
+
+    workers = int(os.environ.get("EDL_BENCH_FLEET_WORKERS", "1000"))
+    art_dir = os.environ.get("EDL_BENCH_ARTIFACT_DIR")
+
+    def _one(name, label, overrides=None):
+        sc = load_scenario(builtin_scenario_path(name))
+        if overrides:
+            sc = sc.override(**overrides)
+        adir = (os.path.join(art_dir, f"fleet-soak-{label}")
+                if art_dir else None)
+        with tempfile.TemporaryDirectory(prefix=f"fleetsoak-{label}-") \
+                as td:
+            if adir is None:
+                # always run the incident --strict pass, even when CI
+                # isn't keeping the artifacts
+                adir = os.path.join(td, "artifacts")
+            t0 = time.perf_counter()
+            r = run_scenario(sc, os.path.join(td, "journal"),
+                             artifacts_dir=adir)
+            r["bench_wall_s"] = round(time.perf_counter() - t0, 2)
+        return r
+
+    out = {"workers": workers}
+    chaos = {}
+    for name in ("rack_failure", "master_failover"):
+        r = _one(name, name, {"workers": workers})
+        chaos[name] = {
+            "leases_per_s": r["leases_per_s"],
+            "wall_s": r["bench_wall_s"],
+            "time_compression": r["time_compression"],
+            "job_finished": bool(r["job_finished"]),
+            "replay_identical": bool(r["replay"]["identical"]),
+            "zero_lost_acked_leases": r["lost_acked_leases"] == 0,
+            "incident_strict_clean": r.get("incident_strict_rc") == 0,
+            "master_restarts": r["master_restarts"],
+            "journal_flush_p99_ms": r["journal"]["flush_probe_p99_ms"],
+            "commit_queue_high_water":
+                r["journal"]["commit_queue_high_water"],
+            # dotted path ends ".<phase>", so the *_p99_ms gate glob
+            # deliberately does NOT match these (phase walls are sub-ms
+            # and swing with box contention — informational only)
+            "poll_phase_p99": {k: v["p99_ms"]
+                               for k, v in r["poll_phases"].items()},
+        }
+    out["scenarios"] = chaos
+    # headline: lease throughput the control plane sustained at fleet
+    # scale (virtual-time-structured — scripted think time dominates
+    # scheduler noise, so the rate is stable across boxes)
+    out["leases_per_s_at_1k"] = max(
+        c["leases_per_s"] for c in chaos.values())
+
+    damped = _one("noisy_signal", "noisy-damped")
+    undamped = _one(
+        "noisy_signal", "noisy-undamped",
+        {"autoscale": {"damping": 0.0, "reversal_hold_s": 0.0}})
+    # the twin's reversal count is SUPPOSED to be large — its field
+    # names dodge the *autoscale_reversals gate glob on purpose
+    out["noisy_signal"] = {
+        "autoscale_reversals": float(damped["autoscale"]["reversals"]),
+        "actions_total": sum(
+            damped["autoscale"]["actions_by_kind"].values()),
+        "replay_identical": bool(damped["replay"]["identical"]),
+        "incident_strict_clean": damped.get("incident_strict_rc") == 0,
+        "undamped_twin": {
+            "reversals_observed": undamped["autoscale"]["reversals"],
+            "actions_observed": sum(
+                undamped["autoscale"]["actions_by_kind"].values()),
+        },
+        "damping_beats_undamped": bool(
+            undamped["autoscale"]["reversals"]
+            > damped["autoscale"]["reversals"]),
+    }
+    return out
+
+
 # ---------------------------------------------------------------------- #
 # baseline compare mode (ISSUE 11): diff a run's headline numbers against
 # a prior artifact, exit nonzero past a regression threshold — the perf
@@ -3475,6 +3566,14 @@ _COMPARE_METRICS = (
     # time_to_evict_s wall clock is deliberately NOT gated (the
     # evicted_within_policy_window boolean is the structural gate).
     ("*autoscale_goodput_gain", "higher", 0.1),
+    # ISSUE 16 fleet soak: the 1k-worker lease rate is virtual-time-
+    # structured (scripted think time dominates scheduler noise); the
+    # damped noisy-signal run must hold at ZERO reversals — any upward
+    # move is an oscillation regression, so no slack. (The undamped
+    # twin's count is deliberately named reversals_observed so this
+    # glob never gates it.)
+    ("*leases_per_s_at_1k", "higher", 0.0),
+    ("*autoscale_reversals", "lower", 0.0),
 )
 
 #: paths NEVER gated even when a metric glob matches: scenario-record
@@ -3729,6 +3828,8 @@ def _run_leg(leg, mesh, np):
         return bench_goodput(mesh, np)
     if leg == "autoscale":
         return bench_autoscale(mesh, np)
+    if leg == "fleet_soak":
+        return bench_fleet_soak(mesh, np)
     if leg == "embedding_tier":
         return bench_embedding_tier(mesh, np)
     if leg == "data_plane":
@@ -3774,7 +3875,8 @@ def _run_leg(leg, mesh, np):
 # first, and resnet50 — whose killed staging+compile is what wedged the
 # tunnel in round 3 — runs last so a wedge can't void the others.
 SWEEP_LEGS = (
-    "rescale", "control_plane", "goodput", "autoscale", "embedding_tier",
+    "rescale", "control_plane", "goodput", "autoscale", "fleet_soak",
+    "embedding_tier",
     "data_plane", "obs_overhead", "embedding", "transformer_lm",
     "time_to_auc", "mnist_cnn", "census_wide_deep", "xdeepfm",
     "cifar10_resnet20", "resnet50_imagenet",
@@ -3882,6 +3984,17 @@ def main():
         # (the chaos-autoscale CI job sets one) and defaults to the
         # deterministic worker.train_step.1 delay.
         record = {"autoscale": bench_autoscale()}
+        print(json.dumps(record))
+        _maybe_compare_exit(record)
+        return
+
+    if len(sys.argv) >= 2 and sys.argv[1] == "fleet_soak":
+        # `python bench.py fleet_soak`: the thousand-worker scenario
+        # soak alone (ISSUE 16) — jax-free, before any jax import; the
+        # whole fleet is scripted in virtual time against the real
+        # master stack. EDL_BENCH_FLEET_WORKERS scales the chaos legs
+        # (default 1000; the fleet-soak CI job runs 256).
+        record = {"fleet_soak": bench_fleet_soak()}
         print(json.dumps(record))
         _maybe_compare_exit(record)
         return

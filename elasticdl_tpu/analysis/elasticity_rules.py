@@ -22,6 +22,19 @@ EDL501 rescale-action-outside-policy
     the same module. `kill_worker` with `relaunch=True` (or omitted) is
     the chaos/test hook — an in-place relaunch, not a resize — and is
     not flagged.
+
+EDL502 sleep-in-simulated-time
+    A bare `time.sleep(...)` (or `sleep(...)` imported from `time`)
+    inside `elasticdl_tpu/fleetsim/`. The fleet simulator runs on a
+    virtual clock (ISSUE 16): every delay must be an event scheduled
+    via `Scheduler.after(...)` / `Scheduler.at(...)` so the clock can
+    jump over it. A real sleep burns wall time inside the compressed
+    run (a 600 s scenario stops finishing in seconds), dodges the
+    deterministic heap ordering that makes same-seed runs digest-
+    identical, and silently skews the REAL costs measured around it
+    (journal fsync, poll-phase walls). Schedule the delay, or carry a
+    reviewed `# edl-lint: disable=EDL502` (e.g. a deliberate wall-time
+    throttle in the CLI layer, outside the simulated run).
 """
 
 from __future__ import annotations
@@ -137,4 +150,62 @@ class RescaleActionOutsidePolicyRule(Rule):
                         names.add(t.id)
                     elif isinstance(t, ast.Attribute):
                         names.add(t.attr)
+        return names
+
+
+#: the virtual-time package: every module under here runs (or builds
+#: objects that run) inside the scenario scheduler's compressed clock
+_FLEETSIM_PREFIX = "elasticdl_tpu/fleetsim/"
+
+
+def _is_time_sleep(node: ast.Call, time_sleep_names: Set[str]) -> bool:
+    """`time.sleep(...)` / `<alias>.sleep(...)` where the receiver is
+    the `time` module, or a bare `sleep(...)` imported from `time`."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "sleep":
+        return isinstance(f.value, ast.Name) and f.value.id in time_sleep_names
+    if isinstance(f, ast.Name):
+        return f.id in time_sleep_names and f.id != "time"
+    return False
+
+
+@register
+class SleepInSimulatedTimeRule(Rule):
+    id = "EDL502"
+    name = "sleep-in-simulated-time"
+    doc = (
+        "bare time.sleep inside the fleet simulator — burns wall time "
+        "the virtual clock is supposed to jump over and breaks "
+        "same-seed determinism; schedule the delay on the event heap"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if _FLEETSIM_PREFIX not in ctx.rel_path:
+            return
+        names = self._time_module_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) and _is_time_sleep(node, names):
+                yield self.finding(
+                    ctx, node,
+                    "time.sleep() inside elasticdl_tpu/fleetsim/ burns "
+                    "real wall time in a virtual-clock run and breaks "
+                    "same-seed determinism; schedule the delay via "
+                    "Scheduler.after()/at() (or carry a reviewed disable)",
+                )
+
+    @staticmethod
+    def _time_module_names(ctx: ModuleContext) -> Set[str]:
+        """Names that resolve to the `time` module or its `sleep`:
+        `import time` / `import time as t` / `from time import sleep
+        [as snooze]`."""
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        names.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name == "sleep":
+                        names.add(a.asname or a.name)
         return names

@@ -190,6 +190,15 @@ class JobConfig:
     # horizon the projected goodput gain accrues over: an action is
     # taken only when gain(horizon) > rescale_cost x world
     autoscale_horizon_s: float = 300.0
+    # signal damping in [0, 1): EWMA smoothing factor applied to the
+    # grow/shrink alert values — a decision needs the SMOOTHED value
+    # past the rule threshold by a deadband margin, so one noisy sample
+    # cannot thrash the loop. 0 (default) = decide on raw signals.
+    autoscale_damping: float = 0.0
+    # anti-thrash reversal hold: a grow→shrink (or shrink→grow)
+    # candidate within this many seconds of the last applied opposite
+    # action suppresses with reason `reversal_hold`. 0 = off.
+    autoscale_reversal_hold_s: float = 0.0
 
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
@@ -475,6 +484,14 @@ class JobConfig:
                     "bench.py rescale's time_to_recovery_s)")
             if self.autoscale_horizon_s <= 0:
                 raise ValueError("autoscale_horizon_s must be > 0")
+            if not 0.0 <= self.autoscale_damping < 1.0:
+                raise ValueError(
+                    "autoscale_damping must be in [0, 1): it is the EWMA "
+                    "smoothing factor (0 = no damping); 1 would freeze "
+                    "the smoothed signal forever")
+            if self.autoscale_reversal_hold_s < 0:
+                raise ValueError(
+                    "autoscale_reversal_hold_s must be >= 0 (0 = off)")
             if not self.checkpoint_dir:
                 # decisions are journaled and replayed at takeover; a
                 # journal-less autoscaler would re-fire after every

@@ -84,7 +84,7 @@ KINDS = ("evict", "grow", "shrink")
 SUPPRESS_REASONS = (
     "no_target", "unsupported", "cooldown", "budget_exhausted",
     "world_at_min", "world_at_max", "cost_gate", "conflicting_signals",
-    "action_failed",
+    "action_failed", "damped", "reversal_hold",
 )
 
 #: the two alert rules this engine subscribes to (observability/alerts.py
@@ -110,6 +110,10 @@ _AS_COOLDOWN = _reg.gauge(
 _AS_PENDING = _reg.gauge(
     "edl_autoscale_pending_signals",
     "signals recorded by the hooks, not yet decided")
+_AS_REVERSALS = _reg.counter(
+    "edl_autoscale_reversals_total",
+    "applied grow->shrink or shrink->grow reversals within one cost "
+    "horizon — the oscillation count a noisy signal produces")
 
 
 class CostModel:
@@ -354,6 +358,12 @@ class Autoscaler:
     """The policy engine. One instance per master; `evaluate()` runs on
     the wait-poll cadence and never raises."""
 
+    #: deadband as a fraction of the rule threshold: with damping on, a
+    #: smoothed grow/shrink signal must clear its threshold by this
+    #: margin before it is actionable — hovering AT the threshold (the
+    #: noisy-signal thrash mode the fleet soak reproduces) stays held
+    DAMPING_DEADBAND = 0.1
+
     def __init__(
         self,
         *,
@@ -364,6 +374,8 @@ class Autoscaler:
         cooldown_s: float = 120.0,
         hold_s: float = 30.0,
         action_budget: int = 8,
+        damping: float = 0.0,
+        reversal_hold_s: float = 0.0,
         clock: Callable[[], float] = time.time,
     ):
         self._journal = journal
@@ -373,6 +385,16 @@ class Autoscaler:
         self.cooldown_s = max(0.0, float(cooldown_s))
         self.hold_s = max(0.0, float(hold_s))
         self.action_budget = max(0, int(action_budget))
+        # signal damping (--autoscale_damping): EWMA smoothing factor in
+        # [0, 1) — 0 disables. Grow/shrink decide on the SMOOTHED alert
+        # value, and only when it clears the rule threshold by the
+        # deadband margin, so one noisy sample cannot flip the loop.
+        self.damping = min(0.999, max(0.0, float(damping)))
+        # anti-thrash (--autoscale_reversal_hold_s): a grow→shrink or
+        # shrink→grow candidate inside this window of the LAST applied
+        # opposite action suppresses as `reversal_hold` — the fleet it
+        # would resize is still paying for the previous resize
+        self.reversal_hold_s = max(0.0, float(reversal_hold_s))
         # wall clock ON PURPOSE (not monotonic): last_action_ts is
         # journaled and must survive a master restart — a monotonic
         # stamp from a dead process is meaningless to its successor
@@ -400,6 +422,14 @@ class Autoscaler:
         # record per (kind, reason) TRANSITION, not one per poll
         self._last_suppressed: Dict[str, str] = {}    # guarded_by: _lock
         self._last_decision: Optional[Dict] = None    # guarded_by: _lock
+        # EWMA of each rule's live alert value (damping > 0 only); decays
+        # toward 0 while the alert is inactive         # guarded_by: _lock
+        self._smoothed: Dict[str, float] = {}
+        # last APPLIED grow/shrink: (kind, ts) — reversal detection.
+        # In-memory only: a restarted master starts direction-blind,
+        # which errs toward counting/suppressing less, never more.
+        self._last_resize: Optional[tuple] = None     # guarded_by: _lock
+        self._reversals = 0                           # guarded_by: _lock
         self._target = None
         self._health = None
         self._alerts = None
@@ -499,7 +529,29 @@ class Autoscaler:
                         "cleared before action", wid,
                     )
         if self._alerts is not None:
-            active = {a.get("rule") for a in self._alerts.active()}
+            active_alerts = self._alerts.active()
+            active = {a.get("rule") for a in active_alerts}
+            if self.damping > 0:
+                # EWMA over the LIVE alert value each poll (an inactive
+                # alert contributes 0, so the smoothed series decays
+                # instead of freezing at its last noisy spike)
+                vals = {
+                    str(a.get("rule")): float(a.get("value") or 0.0)
+                    for a in active_alerts
+                    if a.get("rule") in (GROW_RULE, SHRINK_RULE)
+                }
+                alpha = 1.0 - self.damping
+                with self._lock:
+                    for rule in (GROW_RULE, SHRINK_RULE):
+                        v = vals.get(rule, 0.0)
+                        # decay up from a 0 baseline on first sight, so
+                        # damping also blunts signal ONSET — seeding with
+                        # the first raw sample would let a single spike
+                        # through undamped
+                        prev = self._smoothed.get(rule, 0.0)
+                        self._smoothed[rule] = (
+                            alpha * v + (1.0 - alpha) * prev
+                        )
             if grow is not None and GROW_RULE not in active:
                 with self._lock:
                     self._grow_signal = None
@@ -559,6 +611,33 @@ class Autoscaler:
             # the whole action budget against a sustained alert
             self._suppress(kind, signal, "unsupported", now)
             return None
+        if self.damping > 0 and kind in ("grow", "shrink"):
+            rule = GROW_RULE if kind == "grow" else SHRINK_RULE
+            with self._lock:
+                smoothed = self._smoothed.get(rule)
+            threshold = float(signal.get("threshold") or 0.0)
+            op = str(signal.get("op") or ">")
+            margin = abs(threshold) * self.DAMPING_DEADBAND
+            breached = smoothed is not None and (
+                smoothed <= threshold - margin if op in ("<", "<=")
+                else smoothed >= threshold + margin
+            )
+            if not breached:
+                self._suppress(
+                    kind, signal, "damped", now,
+                    smoothed=round(smoothed or 0.0, 3),
+                )
+                return None
+        if self.reversal_hold_s > 0 and kind in ("grow", "shrink"):
+            with self._lock:
+                last = self._last_resize
+            if (last is not None and last[0] != kind
+                    and now - last[1] < self.reversal_hold_s):
+                self._suppress(
+                    kind, signal, "reversal_hold", now,
+                    prior_kind=last[0], prior_ts=round(last[1], 3),
+                )
+                return None
         world = max(1, int(target.world_size()))
         new_world = world + (1 if kind == "grow" else -1)
         if kind in ("evict", "shrink") and new_world < self.min_world:
@@ -666,6 +745,7 @@ class Autoscaler:
                     "takeover)")
                 span.set(outcome="journal_failed")
                 return None
+            reversal = False
             with self._lock:
                 self._state.actions_applied += 1
                 self._state.last_action_ts = max(
@@ -675,12 +755,28 @@ class Autoscaler:
                 self._state.records += 1
                 self._last_decision = dict(info)
                 self._last_suppressed.pop(kind, None)
+                if kind in ("grow", "shrink"):
+                    last = self._last_resize
+                    if (last is not None and last[0] != kind
+                            and now - last[1] <= self.cost.horizon_s):
+                        reversal = True
+                        self._reversals += 1
+                    self._last_resize = (kind, now)
                 if kind == "evict":
                     self._stragglers.pop(info.get("worker_id"), None)
                 elif kind == "grow":
                     self._grow_signal = None
                 else:
                     self._shrink_signal = None
+            if reversal:
+                _AS_REVERSALS.inc()
+                span.set(reversal=True)
+                logger.warning(
+                    "autoscale REVERSAL: %s within one horizon of the "
+                    "opposite action — the loop is oscillating "
+                    "(consider --autoscale_damping / "
+                    "--autoscale_reversal_hold_s)", kind,
+                )
             ok = False
             try:
                 if kind == "evict":
@@ -727,7 +823,8 @@ class Autoscaler:
 
             flight_lib.get_recorder().record(
                 "autoscale", kind, **{
-                    k: v for k, v in info.items() if k != "decision"
+                    k: v for k, v in info.items()
+                    if k not in ("decision", "kind")
                 },
             )
         except Exception:
@@ -754,6 +851,8 @@ class Autoscaler:
             actions_applied = self._state.actions_applied
             by_kind = dict(self._state.by_kind)
             records = self._state.records
+            reversals = self._reversals
+            smoothed = dict(self._smoothed)
             last = dict(self._last_decision) if self._last_decision else None
             pending = (
                 len(self._stragglers)
@@ -777,6 +876,12 @@ class Autoscaler:
             "pending_signals": pending,
             "last_decision": last,
             "decision_records": records,
+            "damping": self.damping,
+            "reversal_hold_s": self.reversal_hold_s,
+            "reversals": reversals,
+            "smoothed_signals": {
+                k: round(v, 4) for k, v in smoothed.items()
+            },
         }
 
 
@@ -796,4 +901,6 @@ def from_config(cfg, journal=None) -> Optional[Autoscaler]:
         cooldown_s=cfg.autoscale_cooldown_s,
         hold_s=cfg.autoscale_hold_s,
         action_budget=cfg.autoscale_actions_max,
+        damping=getattr(cfg, "autoscale_damping", 0.0),
+        reversal_hold_s=getattr(cfg, "autoscale_reversal_hold_s", 0.0),
     )

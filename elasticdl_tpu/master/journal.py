@@ -120,6 +120,15 @@ _GROUP_BATCH = _reg.histogram(
 _COMMIT_LATENCY = _reg.histogram(
     "edl_journal_commit_latency_seconds",
     "enqueue-to-durable latency per commit (both modes)")
+_QUEUE_DEPTH = _reg.gauge(
+    "edl_journal_commit_queue_depth",
+    "records sitting in the open group-commit batch (saturation signal: "
+    "a depth that grows across windows means offered commit rate exceeds "
+    "flush throughput)")
+_BACKPRESSURE = _reg.counter(
+    "edl_journal_backpressure_warnings_total",
+    "group-commit windows whose queue depth crossed the backpressure "
+    "warning threshold")
 
 
 @dataclass
@@ -659,6 +668,10 @@ class ControlPlaneJournal:
         # flush succeeds). Once poisoned, every queued and future commit
         # fails its wait() — no ack ever leaves for an undurable record.
         self._poisoned: Optional[BaseException] = None   # guarded_by: _qcv
+        # saturation observability: deepest open batch seen (records), and
+        # a once-per-window backpressure-warning edge trigger
+        self._queue_high_water = 0           # guarded_by: _qcv
+        self._bp_warned = False              # guarded_by: _qcv
         self._committer: Optional[threading.Thread] = None
         self.generation = 1
         self.recovered = False
@@ -672,9 +685,23 @@ class ControlPlaneJournal:
             )
             self._committer.start()
 
+    #: open-batch depth past which the journal logs a backpressure
+    #: warning (once per window): the queue is unbounded by design — the
+    #: committer always drains it — but a window this deep means the
+    #: offered commit rate is outrunning flush throughput and commit
+    #: latency is about to climb toward Commit.wait's deadline
+    COMMIT_QUEUE_WARN_DEPTH = 4096
+
     @property
     def group_commit(self) -> bool:
         return self._window_s > 0
+
+    @property
+    def commit_queue_high_water(self) -> int:
+        """Deepest open group-commit batch observed (records) — the soak
+        harness's journal-saturation cliff metric."""
+        with self._qcv:
+            return self._queue_high_water
 
     # -------------------------------------------------------------- #
     # open / rotate / replay
@@ -861,6 +888,22 @@ class ControlPlaneJournal:
                 batch.opened_at = time.monotonic()
             batch.records.extend(recs)
             batch.enqueued_at.append(time.perf_counter())
+            depth = len(batch.records)
+            _QUEUE_DEPTH.set(depth)
+            if depth > self._queue_high_water:
+                self._queue_high_water = depth
+            if depth > self.COMMIT_QUEUE_WARN_DEPTH and not self._bp_warned:
+                # edge-triggered per window (the committer resets the
+                # flag on swap): one warning per saturated window, not
+                # one per commit
+                self._bp_warned = True
+                _BACKPRESSURE.inc()
+                logger.warning(
+                    "journal group-commit BACKPRESSURE: %d records queued "
+                    "in the open window (warn threshold %d) — offered "
+                    "commit rate exceeds flush throughput",
+                    depth, self.COMMIT_QUEUE_WARN_DEPTH,
+                )
             self._qcv.notify_all()
             return Commit(batch.event, batch)
 
@@ -884,6 +927,8 @@ class ControlPlaneJournal:
                     self._qcv.wait(remaining)
                 batch, self._queue = self._queue, _OpenBatch()
                 self._flush_now = False
+                self._bp_warned = False
+                _QUEUE_DEPTH.set(0)
             if batch.records:
                 # a close() racing the window wait can hand us a freshly
                 # swapped EMPTY batch — flushing it would write a spurious
@@ -962,6 +1007,7 @@ class ControlPlaneJournal:
         with self._qcv:
             self._closing = True
             batch, self._queue = self._queue, _OpenBatch()
+            _QUEUE_DEPTH.set(0)
             self._qcv.notify_all()
         if self._committer is not None:
             self._committer.join(timeout=10.0)

@@ -22,6 +22,7 @@ from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.master.evaluation_service import EvaluationService
 from elasticdl_tpu.master.journal import ControlPlaneJournal
 from elasticdl_tpu.master.membership import Membership
+from elasticdl_tpu.master.poll_phases import poll_phase
 from elasticdl_tpu.master.servicer import MasterServicer
 from elasticdl_tpu.master.task_dispatcher import TaskDispatcher
 from elasticdl_tpu.proto.service import add_master_servicer, make_server
@@ -458,26 +459,36 @@ class Master:
             # wait() — the catchable in-process flavor client/local.py's
             # --master_restarts recovery path consumes
             faults.fire("master_crash")
-            self.membership.reap()
-            self.dispatcher.poke()
+            # every phase is timed into edl_master_poll_phase_seconds
+            # (master/poll_phases.py) so a slow poll at fleet scale
+            # names its culprit instead of being one opaque number
+            with poll_phase("membership"):
+                self.membership.reap()
+            with poll_phase("dispatcher"):
+                self.dispatcher.poke()
             # fleet rollup + straggler scoring (never raises; gauges and
             # edge-triggered cluster.straggler events update here)
-            self.health.update()
+            with poll_phase("health"):
+                self.health.update()
             # fleet goodput rollup (never raises): heartbeat ledger
             # payloads + the dispatcher's wasted-work bill -> the
             # edl_goodput_* gauges the sampler below snapshots
-            self.goodput.update()
+            with poll_phase("goodput"):
+                self.goodput.update()
             # time-series sample when due (fleet series computed only
             # then) + declarative alert evaluation over the history —
             # edge-triggered cluster.alert events, edl_alert_* metrics,
             # flight-ring dump on page severity. Neither ever raises.
-            self.timeseries.maybe_sample(extra_fn=self._fleet_series)
-            self.alerts.evaluate()
+            with poll_phase("timeseries"):
+                self.timeseries.maybe_sample(extra_fn=self._fleet_series)
+            with poll_phase("alerts"):
+                self.alerts.evaluate()
             if self.autoscaler is not None:
                 # the decision pass: pending signals (recorded by the
                 # hooks above) -> at most one journaled, cost-gated,
                 # cooldown-bounded rescale action. Never raises.
-                self.autoscaler.evaluate()
+                with poll_phase("autoscaler"):
+                    self.autoscaler.evaluate()
             if self.summary is not None:
                 # control-plane metrics ride the summary stream (rate-
                 # limited inside; never raises)

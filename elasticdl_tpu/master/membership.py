@@ -83,14 +83,29 @@ class Membership(CommitGate):
     #: membership lock throughout, all from one RPC
     MAX_COHORT_MEMBERS = 4096
 
-    def __init__(self, heartbeat_timeout_s: float = 30.0, journal=None):
+    def __init__(self, heartbeat_timeout_s: float = 30.0, journal=None,
+                 clock: Callable[[], float] = time.time):
         self._lock = threading.Lock()
+        # Injectable time source: the fleet simulator (fleetsim/) drives
+        # membership on a compressed virtual clock; production uses
+        # time.time. Every liveness stamp and reap decision reads this.
+        self._clock = clock
         # Crash durability (master/journal.py): join/death transitions are
         # committed inside the _lock critical sections that apply them, so
         # a restarted master replays the registry instead of telling every
         # reconnecting worker to shut down as an unknown. None = volatile.
         self._journal = journal
         self._workers: Dict[int, WorkerInfo] = {}    # guarded_by: _lock
+        # Alive-entry indexes: the reap scan, the per-poll fleet rollup,
+        # and the address book must not pay O(all entries ever seen) once
+        # the registry holds thousands of dead/member rows. Invariant:
+        # _alive_leaders == {id: alive, led_by is None}, _alive_members ==
+        # {id: alive, led_by set}, _cohort_members[leader] == every member
+        # id ever registered under that leader (alive or dead — the
+        # idempotent re-register key space).        # guarded_by: _lock
+        self._alive_leaders: set = set()
+        self._alive_members: set = set()
+        self._cohort_members: Dict[int, set] = {}
         # last journal Commit of the current critical section (see _j)
         self._pending_commit = None                  # guarded_by: _lock
         # rolling per-worker heartbeat telemetry (health.py records);
@@ -113,11 +128,11 @@ class Membership(CommitGate):
         takeover: every restored-alive worker gets a fresh heartbeat stamp,
         so the reaper gives reconnecting workers a full timeout window
         before declaring anyone dead under the new generation."""
-        now = time.time()
+        now = self._clock()
         for w in snap.workers:
             wid = int(w["worker_id"])
             led_by = w.get("led_by")
-            self._workers[wid] = WorkerInfo(
+            info = WorkerInfo(
                 worker_id=wid,
                 name=w.get("name", ""),
                 last_heartbeat=now,
@@ -125,6 +140,10 @@ class Membership(CommitGate):
                 led_by=int(led_by) if led_by is not None else None,
                 data_addr=str(w.get("data_addr") or ""),
             )
+            self._workers[wid] = info
+            if info.led_by is not None:
+                self._cohort_members.setdefault(info.led_by, set()).add(wid)
+            self._index_locked(info)
         self._next_id = snap.next_id
         self._version = snap.version
         _MB_ALIVE.set(self._alive_count_locked())
@@ -134,6 +153,17 @@ class Membership(CommitGate):
             "(%d alive)", self._version, len(self._workers),
             self._alive_count_locked(),
         )
+
+    def _index_locked(self, info: WorkerInfo) -> None:
+        """Re-sync the alive indexes with info.alive. Must run after every
+        liveness flip or entry (re)insert, inside _lock."""
+        leaders, members = self._alive_leaders, self._alive_members
+        if info.led_by is None:
+            members.discard(info.worker_id)
+            (leaders.add if info.alive else leaders.discard)(info.worker_id)
+        else:
+            leaders.discard(info.worker_id)
+            (members.add if info.alive else members.discard)(info.worker_id)
 
     # _j / _take_commit_locked / _await come from CommitGate
     # (master/journal.py) — the ack-after-fsync plumbing shared with the
@@ -157,9 +187,10 @@ class Membership(CommitGate):
                 wid = self._next_id
             self._next_id = max(self._next_id, wid + 1)
             info = WorkerInfo(worker_id=wid, name=name,
-                              last_heartbeat=time.time(),
+                              last_heartbeat=self._clock(),
                               data_addr=data_addr or "")
             self._workers[wid] = info
+            self._index_locked(info)
             self._version += 1
             version = self._version     # the version THIS join created
             self._j(
@@ -207,13 +238,15 @@ class Membership(CommitGate):
                 raise KeyError(
                     f"worker {leader_id} is not a registered cohort leader"
                 )
+            cohort = self._cohort_members.setdefault(leader_id, set())
             by_name = {
-                w.name: w for w in self._workers.values()
-                if w.led_by == leader_id
+                self._workers[mid].name: self._workers[mid]
+                for mid in cohort
+                if self._workers[mid].led_by == leader_id
             }
             infos: List[WorkerInfo] = []
             records: List[Tuple[str, Dict]] = []
-            now = time.time()
+            now = self._clock()
             for name in names:
                 info = by_name.get(name)
                 if info is None:
@@ -223,6 +256,8 @@ class Membership(CommitGate):
                     )
                     self._next_id += 1
                     self._workers[info.worker_id] = info
+                    cohort.add(info.worker_id)
+                    self._index_locked(info)
                     records.append((
                         "member_join",
                         {"worker_id": info.worker_id, "name": name,
@@ -232,6 +267,7 @@ class Membership(CommitGate):
                     info.last_heartbeat = now
                     if not info.alive:
                         info.alive = True
+                        self._index_locked(info)
                         records.append((
                             "member_join",
                             {"worker_id": info.worker_id, "name": name,
@@ -266,13 +302,14 @@ class Membership(CommitGate):
             info = self._workers.get(worker_id)
             if info is not None:
                 info.name = name or info.name
-                info.last_heartbeat = time.time()
+                info.last_heartbeat = self._clock()
                 revived = not info.alive
                 addr_changed = bool(data_addr) and data_addr != info.data_addr
                 if data_addr:
                     info.data_addr = data_addr
                 if revived:
                     info.alive = True
+                    self._index_locked(info)
                     self._version += 1
                     self._j(
                         "member_join", worker_id=worker_id, name=info.name,
@@ -323,7 +360,7 @@ class Membership(CommitGate):
             info = self._workers.get(worker_id)
             if info is None or not info.alive:
                 return False
-            now = time.time()
+            now = self._clock()
             self._beat_locked(info, now, model_version, stats)
             coalesced = 0
             for mid, m_version, m_stats in members or ():
@@ -331,6 +368,7 @@ class Membership(CommitGate):
                 if member is None or member.led_by != worker_id:
                     continue
                 member.alive = True    # the leader's beat IS their liveness
+                self._alive_members.add(mid)
                 self._beat_locked(member, now, m_version, m_stats)
                 coalesced += 1
         _MB_BEATS.inc()
@@ -365,6 +403,7 @@ class Membership(CommitGate):
             if info is None or not info.alive:
                 return False
             info.alive = False
+            self._index_locked(info)
             if info.led_by is None:
                 self._version += 1      # a LOGICAL worker left the world
             version = self._version
@@ -373,12 +412,18 @@ class Membership(CommitGate):
             ]
             cascade = []
             if info.led_by is None:
+                # alive-index intersection, not a full-registry walk: a
+                # thousand-cohort fleet reaps one leader in O(its members)
                 cascade = [
-                    w for w in self._workers.values()
-                    if w.alive and w.led_by == worker_id
+                    self._workers[mid] for mid in sorted(
+                        self._cohort_members.get(worker_id, set())
+                        & self._alive_members
+                    )
+                    if self._workers[mid].led_by == worker_id
                 ]
                 for member in cascade:
                     member.alive = False
+                    self._index_locked(member)
                     records.append((
                         "member_death",
                         {"worker_id": member.worker_id, "version": version},
@@ -410,16 +455,16 @@ class Membership(CommitGate):
     def reap(self) -> List[int]:
         """Declare workers dead whose heartbeats lapsed. Returns their ids.
         Cohort members are SKIPPED — their liveness is the leader's beat
-        (they die with it via the mark_dead cascade) — so the scan is
-        O(cohorts + singletons), not O(worker processes)."""
-        now = time.time()
+        (they die with it via the mark_dead cascade) — and the scan walks
+        the alive-leader INDEX, so the cost is O(alive cohorts +
+        singletons): dead rows and member processes are never touched."""
+        now = self._clock()
         with self._lock:
-            lapsed = [
+            lapsed = sorted(
                 wid
-                for wid, info in self._workers.items()
-                if info.alive and info.led_by is None
-                and now - info.last_heartbeat > self._timeout
-            ]
+                for wid in self._alive_leaders
+                if now - self._workers[wid].last_heartbeat > self._timeout
+            )
         for wid in lapsed:
             if self.mark_dead(wid, reason="heartbeat timeout"):
                 _MB_REAPED.inc()
@@ -429,15 +474,10 @@ class Membership(CommitGate):
         """Alive LOGICAL workers (cohort leaders + singletons): member
         processes are not rendezvous participants and must not inflate
         num_workers (LR scaling, wait-for-workers logic)."""
-        return sum(
-            1 for w in self._workers.values() if w.alive and w.led_by is None
-        )
+        return len(self._alive_leaders)
 
     def _member_count_locked(self) -> int:
-        return sum(
-            1 for w in self._workers.values()
-            if w.alive and w.led_by is not None
-        )
+        return len(self._alive_members)
 
     @property
     def version(self) -> int:
@@ -450,7 +490,10 @@ class Membership(CommitGate):
 
     def alive_workers(self) -> List[WorkerInfo]:
         with self._lock:
-            return [w for w in self._workers.values() if w.alive]
+            return [
+                self._workers[wid]
+                for wid in sorted(self._alive_leaders | self._alive_members)
+            ]
 
     def data_addresses(self) -> List[Tuple[int, str]]:
         """The owner address book (ISSUE 15): (worker id, data-plane
@@ -459,18 +502,20 @@ class Membership(CommitGate):
         pull/push over gRPC to whichever process owns a shard."""
         with self._lock:
             return sorted(
-                (w.worker_id, w.data_addr)
-                for w in self._workers.values()
-                if w.alive and w.led_by is None and w.data_addr
+                (wid, self._workers[wid].data_addr)
+                for wid in self._alive_leaders
+                if self._workers[wid].data_addr
             )
 
     def health_snapshot(self) -> List[Dict]:
         """Telemetry records (copies) of currently-ALIVE workers — the
         straggler scorer's input. Dead workers keep their records in the
-        store (revival resumes the history) but are not scored."""
+        store (revival resumes the history) but are not scored. Walks the
+        alive indexes, not the full registry, so the per-poll fleet
+        rollup stays O(alive) when dead history dominates."""
         with self._lock:
             return [
                 dict(self._health[wid])
-                for wid, w in sorted(self._workers.items())
-                if w.alive and wid in self._health
+                for wid in sorted(self._alive_leaders | self._alive_members)
+                if wid in self._health
             ]

@@ -108,8 +108,13 @@ class TaskDispatcher(CommitGate):
         task_timeout_s: float = 600.0,
         final_save_model: bool = False,
         journal=None,
+        clock: Callable[[], float] = time.time,
     ):
         self._lock = threading.Lock()
+        # Injectable time source: lease stamps and expiry reaping read
+        # this, so the fleet simulator (fleetsim/) can drive lease
+        # timeouts on a compressed virtual clock. Production: time.time.
+        self._clock = clock
         # Crash durability (master/journal.py): every task lifecycle
         # transition below is committed to the journal INSIDE the _lock
         # critical section that applies it, so the on-disk order is the
@@ -450,7 +455,7 @@ class TaskDispatcher(CommitGate):
             if not self._todo:
                 self._set_queue_gauges_locked()
                 return []
-            now = time.time()
+            now = self._clock()
             tasks: List[TaskSpec] = []
             records = []
             while self._todo and len(tasks) < max_tasks:
@@ -699,7 +704,7 @@ class TaskDispatcher(CommitGate):
         return len(stale)
 
     def _reap_expired_locked(self) -> None:
-        now = time.time()
+        now = self._clock()
         expired = [
             tid
             for tid, lease in self._doing.items()

@@ -226,6 +226,39 @@ class Tracer:
 
     # ------------------------------------------------------------------ #
 
+    @contextmanager
+    def scoped(self, path: Optional[str] = None,
+               role: Optional[str] = None,
+               world_version: Optional[int] = None) -> Iterator["Tracer"]:
+        """Temporarily repoint the tracer (file sink, role, world
+        version) and restore EVERYTHING on exit — including the
+        in-memory ring's prior contents. A simulation can flood
+        thousands of spans through the real stack inside this block
+        without leaving the process tracer full (a full ring makes
+        every later `records[start:]` slice empty) or wearing the
+        simulation's role on subsequent log lines."""
+        with self._lock:
+            prev_role = self.role
+            prev_wv = self._world_version
+            prev_path = self._path
+            prev_had_file = self._file is not None
+            prev_records = list(self.records)
+        self.configure(path=path, role=role, world_version=world_version)
+        try:
+            yield self
+        finally:
+            with self._lock:
+                self._close_locked()
+                self._path = None
+            if prev_path is not None and prev_had_file:
+                self.configure(path=prev_path)
+            with self._lock:
+                self._path = prev_path
+                self.role = prev_role
+                self._world_version = prev_wv
+                self.records.clear()
+                self.records.extend(prev_records)
+
     def close(self) -> None:
         with self._lock:
             self._close_locked()

@@ -1671,3 +1671,80 @@ def test_rescale_action_allowlists_policy_and_entry_points():
     ):
         assert findings_for(EDL501_BAD, select={"EDL501"},
                             rel_path=allowed) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL502 sleep-in-simulated-time
+
+
+EDL502_BAD = """
+    import time
+    import time as walltime
+    from time import sleep
+    from time import sleep as snooze
+
+    def provision_delay():
+        time.sleep(5.0)                           # BAD: real sleep
+        walltime.sleep(0.1)                       # BAD: aliased module
+        sleep(1)                                  # BAD: from-import
+        snooze(2)                                 # BAD: aliased import
+"""
+
+EDL502_GOOD = """
+    import time
+
+    def schedule_delay(sched, fleet):
+        # virtual delay: an event on the heap, which the clock jumps over
+        sched.after(5.0, fleet.boot)
+        t0 = time.perf_counter()                  # measuring REAL cost is fine
+        fleet.journal_flush()
+        return time.perf_counter() - t0
+
+    def unrelated(pool):
+        # not the time module: a worker pool's own sleep() stays quiet
+        pool.sleep(1.0)
+
+    def cli_throttle():
+        # deliberate wall-time pacing in the CLI layer, reviewed:
+        # edl-lint: disable=EDL502
+        time.sleep(0.5)
+"""
+
+
+def test_sleep_in_simulated_time_fires_inside_fleetsim():
+    fs = findings_for(EDL502_BAD, select={"EDL502"},
+                      rel_path="elasticdl_tpu/fleetsim/sim.py")
+    assert rule_ids(fs) == ["EDL502"]
+    assert len(fs) == 4
+    assert all("virtual-clock" in f.message for f in fs)
+
+
+def test_sleep_in_simulated_time_quiet_on_perf_counters_and_disables():
+    assert findings_for(EDL502_GOOD, select={"EDL502"},
+                        rel_path="elasticdl_tpu/fleetsim/sim.py") == []
+
+
+def test_sleep_in_simulated_time_scoped_to_the_fleetsim_package():
+    # the same sleeps OUTSIDE fleetsim/ are someone else's business
+    # (workers legitimately back off in wall time)
+    for rel in ("elasticdl_tpu/worker/worker.py", "bench.py",
+                "elasticdl_tpu/master/main.py"):
+        assert findings_for(EDL502_BAD, select={"EDL502"},
+                            rel_path=rel) == []
+
+
+def test_fleetsim_tree_is_sleep_clean():
+    import glob
+    import os
+
+    from elasticdl_tpu.analysis.core import ModuleContext, all_rules
+
+    root = os.path.join(os.path.dirname(__file__), "..",
+                        "elasticdl_tpu", "fleetsim")
+    rule = next(r for r in all_rules() if r.id == "EDL502")
+    for path in glob.glob(os.path.join(root, "**", "*.py"), recursive=True):
+        rel = "elasticdl_tpu/fleetsim/" + os.path.relpath(
+            path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            ctx = ModuleContext(path, f.read(), rel)
+        assert list(rule.check(ctx)) == [], rel

@@ -1,0 +1,226 @@
+"""Autoscaler signal damping + reversal hold (ISSUE 16): the EWMA gate
+decays up from a ZERO baseline (one spike cannot fire the loop), a
+sustained breach still gets through, `reversal_hold` suppressions are
+journaled with the prior action attached, applied reversals increment
+both the snapshot counter and edl_autoscale_reversals_total, and the
+deadband holds a signal hovering AT its threshold. Jax-free and
+fast."""
+
+import json
+
+from elasticdl_tpu.master.autoscaler import (
+    GROW_RULE,
+    SHRINK_RULE,
+    Autoscaler,
+    CostModel,
+)
+from elasticdl_tpu.master.journal import ControlPlaneJournal
+from elasticdl_tpu.observability.registry import default_registry
+
+
+class Clock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class FakeTarget:
+    def __init__(self, world=4):
+        self.world = world
+        self.calls = []
+
+    def world_size(self):
+        return self.world
+
+    def supports(self, kind):
+        return True
+
+    def grow(self):
+        self.calls.append("grow")
+        self.world += 1
+        return True
+
+    def shrink(self):
+        self.calls.append("shrink")
+        self.world -= 1
+        return True
+
+    def evict(self, worker_id, worker_name=""):
+        self.calls.append("evict")
+        self.world -= 1
+        return True
+
+
+class FakeAlerts:
+    """Just enough AlertEngine surface for subscribe(): hooks fire on
+    onset, active() feeds the EWMA pass each poll."""
+
+    def __init__(self):
+        self.hooks = []
+        self.live = []
+
+    def add_hook(self, fn):
+        self.hooks.append(fn)
+
+    def active(self):
+        return list(self.live)
+
+    def raise_alert(self, rule, value, threshold, op=">"):
+        info = {"rule": rule, "value": value, "threshold": threshold,
+                "op": op}
+        self.live = [dict(info)]
+        for h in self.hooks:
+            h(dict(info))
+
+    def set_value(self, value):
+        self.live[0]["value"] = value
+
+    def clear(self):
+        self.live = []
+
+
+def make_loop(clock, *, damping=0.0, reversal_hold_s=0.0, journal=None,
+              world=4):
+    a = Autoscaler(
+        journal=journal,
+        cost_model=CostModel(rescale_cost_s=0.01, horizon_s=100.0),
+        min_world=1, max_world=64, cooldown_s=0.0, hold_s=0.0,
+        action_budget=100, damping=damping,
+        reversal_hold_s=reversal_hold_s, clock=clock,
+    )
+    alerts = FakeAlerts()
+    a.subscribe(alerts=alerts)
+    target = FakeTarget(world=world)
+    a.bind_target(target)
+    return a, alerts, target
+
+
+# ---------------------------------------------------------------------- #
+# EWMA damping
+
+
+def test_single_spike_is_damped_at_onset():
+    clock = Clock()
+    loop, alerts, target = make_loop(clock, damping=0.9)
+    alerts.raise_alert(GROW_RULE, value=150.0, threshold=64.0)
+    # seeded-from-zero EWMA: pass 1 smooths 150 down to 15 — far under
+    # the 64 * 1.1 deadband bar, so the spike suppresses as `damped`
+    assert loop.evaluate(clock()) is None
+    assert target.calls == []
+    snap = loop.snapshot()
+    assert snap["last_decision"]["suppress_reason"] == "damped"
+    assert 0 < snap["smoothed_signals"][GROW_RULE] < 64.0
+    # the spike clears next poll: smoothed decays back toward zero
+    alerts.clear()
+    loop.evaluate(clock.advance(1.0))
+    decayed = loop.snapshot()["smoothed_signals"][GROW_RULE]
+    assert decayed < snap["smoothed_signals"][GROW_RULE]
+
+
+def test_sustained_breach_gets_through_the_damping():
+    clock = Clock()
+    loop, alerts, target = make_loop(clock, damping=0.9)
+    alerts.raise_alert(GROW_RULE, value=150.0, threshold=64.0)
+    for _ in range(12):   # EWMA crosses 64*1.1 after ~7 sustained polls
+        loop.evaluate(clock.advance(1.0))
+        if target.calls:
+            break
+    assert target.calls == ["grow"]
+
+
+def test_deadband_holds_a_signal_hovering_at_threshold():
+    clock = Clock()
+    loop, alerts, target = make_loop(clock, damping=0.5)
+    # converged EWMA == raw value == threshold + epsilon: inside the 10%
+    # deadband, so the hovering signal never becomes an action
+    alerts.raise_alert(GROW_RULE, value=65.0, threshold=64.0)
+    for _ in range(30):
+        loop.evaluate(clock.advance(1.0))
+    assert target.calls == []
+    assert loop.snapshot()["last_decision"]["suppress_reason"] == "damped"
+
+
+def test_undamped_spike_fires_immediately():
+    clock = Clock()
+    loop, alerts, target = make_loop(clock, damping=0.0)
+    alerts.raise_alert(GROW_RULE, value=150.0, threshold=64.0)
+    assert loop.evaluate(clock())["decision"] == "applied"
+    assert target.calls == ["grow"]
+
+
+# ---------------------------------------------------------------------- #
+# reversal hold + reversal accounting
+
+
+def _flip_flop(loop, alerts, clock, passes=2):
+    """Drive alternating grow / shrink breaches through the loop."""
+    for _ in range(passes):
+        alerts.raise_alert(GROW_RULE, value=150.0, threshold=64.0)
+        loop.evaluate(clock.advance(5.0))
+        alerts.clear()
+        loop.evaluate(clock.advance(5.0))
+        alerts.raise_alert(SHRINK_RULE, value=0.7, threshold=0.5)
+        loop.evaluate(clock.advance(5.0))
+        alerts.clear()
+        loop.evaluate(clock.advance(5.0))
+
+
+def test_reversal_hold_suppresses_and_journals_the_reason(tmp_path):
+    clock = Clock()
+    journal = ControlPlaneJournal(str(tmp_path))
+    try:
+        loop, alerts, target = make_loop(
+            clock, reversal_hold_s=600.0, journal=journal)
+        _flip_flop(loop, alerts, clock, passes=2)
+        # same-direction resizes may repeat; every opposite-direction
+        # follow-up inside the hold window suppresses instead of flapping
+        assert set(target.calls) == {"grow"}
+        assert loop.snapshot()["reversals"] == 0
+        assert loop.snapshot()["last_decision"]["suppress_reason"] \
+            == "reversal_hold"
+    finally:
+        journal.close()
+    with open(journal.path, encoding="utf-8") as f:
+        recs = [json.loads(line) for line in f if line.strip()]
+    held = [r for r in recs if r.get("t") == "autoscale"
+            and r.get("suppress_reason") == "reversal_hold"]
+    assert held, "reversal_hold suppression must be journaled"
+    assert held[0]["prior_kind"] == "grow"
+    assert held[0]["decision"] == "suppressed"
+
+
+def test_reversals_counter_counts_the_oscillation():
+    clock = Clock()
+    counter = default_registry().get("edl_autoscale_reversals_total")
+    before = counter.value()
+    loop, alerts, target = make_loop(clock, reversal_hold_s=0.0)
+    _flip_flop(loop, alerts, clock, passes=2)
+    # undamped, no hold: grow, shrink, grow, shrink — all applied, and
+    # every flip after the first is a reversal within the cost horizon
+    assert target.calls == ["grow", "shrink", "grow", "shrink"]
+    assert loop.snapshot()["reversals"] == 3
+    assert counter.value() == before + 3
+
+
+def test_reversal_hold_expires_with_the_window():
+    clock = Clock()
+    loop, alerts, target = make_loop(clock, reversal_hold_s=30.0)
+    alerts.raise_alert(GROW_RULE, value=150.0, threshold=64.0)
+    loop.evaluate(clock.advance(1.0))
+    alerts.clear()
+    loop.evaluate(clock.advance(1.0))
+    # inside the window: held
+    alerts.raise_alert(SHRINK_RULE, value=0.7, threshold=0.5)
+    loop.evaluate(clock.advance(1.0))
+    assert target.calls == ["grow"]
+    # outside the window (and outside the cost horizon, so this shrink
+    # is a legitimate direction change, not a counted reversal)
+    loop.evaluate(clock.advance(200.0))
+    assert target.calls == ["grow", "shrink"]
+    assert loop.snapshot()["reversals"] == 0

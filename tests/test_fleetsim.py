@@ -1,0 +1,187 @@
+"""Fleet soak simulator (fleetsim/): scenario schema validation, the
+builtin scenario library, and the determinism contract — same file +
+same seed produces the identical event log (digest), identical journal
+accounting, a record-identical replay, zero lost acked leases, and a
+strict-clean incident report even through a rack kill and a master
+kill."""
+
+import copy
+import json
+import os
+
+import pytest
+
+from elasticdl_tpu.fleetsim import (
+    builtin_scenario_path,
+    builtin_scenarios,
+    load_scenario,
+)
+from elasticdl_tpu.fleetsim.scenario import validate_scenario
+from elasticdl_tpu.fleetsim.sim import run_scenario
+
+#: small enough for tier-1 (a ~150-virtual-second job over 8 workers
+#: runs in about a second of wall) but still crossing the interesting
+#: edges: a correlated rack kill mid-lease, the rack's rejoin, and a
+#: master kill with journal replay + generation-fence re-registration
+BASE = {
+    "name": "unit_chaos",
+    "seed": 71,
+    "duration_s": 150,
+    "workers": 8,
+    "racks": 4,
+    "poll_s": 1.0,
+    "heartbeat_s": 5.0,
+    "heartbeat_timeout_s": 15.0,
+    "task_timeout_s": 60.0,
+    "shards": 48,
+    "records_per_task": 128,
+    "records_per_s": 256.0,
+    "step_ms": 50.0,
+    "lease_batch": 2,
+    "group_commit_ms": 1.0,
+    "events": [
+        {"at_s": 30, "action": "kill_rack", "rack": 1},
+        {"at_s": 60, "action": "rejoin_rack", "rack": 1},
+        {"at_s": 80, "action": "kill_master", "down_s": 10},
+    ],
+}
+
+
+@pytest.fixture(scope="module")
+def twin_runs(tmp_path_factory):
+    """The BASE scenario run twice from the same seed — one with the
+    full artifact set (feeds the incident-CLI assertion)."""
+    sc = validate_scenario(copy.deepcopy(BASE))
+    root = tmp_path_factory.mktemp("fleetsim")
+    a = run_scenario(sc, str(root / "w1"), artifacts_dir=str(root / "art"))
+    b = run_scenario(sc, str(root / "w2"))
+    return a, b, str(root / "art")
+
+
+# ---------------------------------------------------------------------- #
+# determinism
+
+
+def test_same_seed_runs_are_digest_identical(twin_runs):
+    a, b, _ = twin_runs
+    assert a["event_log_digest"] == b["event_log_digest"]
+    assert a["event_log_entries"] == b["event_log_entries"] > 0
+
+
+def test_same_seed_runs_agree_on_journal_accounting(twin_runs):
+    a, b, _ = twin_runs
+    assert a["replay"]["live"] == b["replay"]["live"]
+    assert a["tasks"] == b["tasks"]
+    assert a["acked_training_reports"] == b["acked_training_reports"]
+
+
+# ---------------------------------------------------------------------- #
+# chaos invariants (the soak harness's own acceptance bar, in miniature)
+
+
+def test_chaos_run_finishes_and_replays_identically(twin_runs):
+    a, _, _ = twin_runs
+    assert a["job_finished"] is True
+    assert a["master_restarts"] == 1
+    assert a["replay"]["identical"] is True
+    assert a["replay"]["live"]["finished_training"] == BASE["shards"]
+
+
+def test_chaos_run_loses_no_acked_leases(twin_runs):
+    a, _, _ = twin_runs
+    assert a["lost_acked_leases"] == 0
+    assert a["acked_training_reports"] >= BASE["shards"]
+
+
+def test_chaos_run_incident_report_is_strict_clean(twin_runs):
+    a, _, art = twin_runs
+    assert a["incident_strict_rc"] == 0
+    # the artifact set the incident CLI consumed is on disk and valid
+    for name in ("journal.jsonl", "health.json", "events.json",
+                 "result.json", "incident_report.txt"):
+        assert os.path.exists(os.path.join(art, name)), name
+    with open(os.path.join(art, "result.json"), encoding="utf-8") as f:
+        disk = json.load(f)
+    assert disk["event_log_digest"] == a["event_log_digest"]
+
+
+def test_cliff_metrics_are_reported(twin_runs):
+    a, _, _ = twin_runs
+    assert a["journal"]["commit_queue_high_water"] >= 1
+    assert a["journal"]["flush_probe_p99_ms"] > 0
+    assert set(a["poll_phases"]) >= {"membership", "dispatcher", "health"}
+    for phase in a["poll_phases"].values():
+        assert phase["p99_ms"] >= phase["p50_ms"] >= 0
+
+
+# ---------------------------------------------------------------------- #
+# scenario schema
+
+
+def _bad(mutate):
+    doc = copy.deepcopy(BASE)
+    mutate(doc)
+    with pytest.raises(ValueError):
+        validate_scenario(doc)
+
+
+def test_scenario_validation_rejects_malformed_documents():
+    _bad(lambda d: d.pop("name"))
+    _bad(lambda d: d.update(name="Bad Name!"))
+    _bad(lambda d: d.update(workers=0))
+    _bad(lambda d: d.update(epochs=0))
+    _bad(lambda d: d["events"].append({"at_s": 1, "action": "warp_core"}))
+    _bad(lambda d: d["events"].append({"at_s": 1, "action": "kill_rack"}))
+    _bad(lambda d: d["events"].append(
+        {"at_s": BASE["duration_s"] + 1, "action": "kill_workers",
+         "count": 1}))
+    # inject_tasks needs an eval task size to mint tasks from
+    _bad(lambda d: d["events"].append(
+        {"at_s": 1, "action": "inject_tasks", "count": 4}))
+
+
+def test_scenario_override_merges_autoscale_and_revalidates():
+    doc = copy.deepcopy(BASE)
+    doc["autoscale"] = {"min_workers": 2, "max_workers": 12,
+                        "damping": 0.9, "reversal_hold_s": 240}
+    sc = validate_scenario(doc)
+    twin = sc.override(workers=16,
+                       autoscale={"damping": 0.0, "reversal_hold_s": 0.0})
+    assert twin.workers == 16
+    assert twin.autoscale["damping"] == 0.0
+    assert twin.autoscale["min_workers"] == 2     # merged, not replaced
+    assert sc.autoscale["damping"] == 0.9         # original untouched
+    with pytest.raises(ValueError):
+        sc.override(workers=-1)
+
+
+def test_sim_run_leaves_the_process_tracer_untouched(tmp_path):
+    """A soak floods thousands of spans through the real master stack;
+    the run must restore the process tracer afterwards — same role, same
+    ring contents — or it fills the bounded ring and every later
+    `records[start:]` slice in this process comes back empty."""
+    from elasticdl_tpu.observability import tracing
+
+    t = tracing.get_tracer()
+    before_role = t.role
+    before_records = list(t.records)
+    sc = validate_scenario(copy.deepcopy(BASE))
+    run_scenario(sc, str(tmp_path / "w"),
+                 artifacts_dir=str(tmp_path / "art"))
+    assert t.role == before_role
+    assert list(t.records) == before_records
+    # and the sim's spans did go somewhere: the artifact trace file
+    with open(tmp_path / "art" / "trace.jsonl", encoding="utf-8") as f:
+        assert sum(1 for line in f if line.strip()) > 0
+
+
+def test_builtin_scenario_library_loads_clean():
+    names = builtin_scenarios()
+    assert len(names) >= 6
+    assert {"rack_failure", "master_failover", "rolling_restart",
+            "slow_joiner_herd", "straggler_wave", "noisy_signal"} \
+        <= set(names)
+    for name in names:
+        sc = load_scenario(builtin_scenario_path(name))
+        assert sc.name == name
+        assert sc.workers >= 1 and sc.duration_s > 0

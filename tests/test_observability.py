@@ -34,10 +34,12 @@ def _clean_faults():
 @pytest.fixture()
 def tracer_memory():
     """Point the process tracer at memory only and hand back a marker for
-    slicing: records appended during the test are records[start:]."""
+    slicing: records appended during the test are records[start:]. The
+    ring is bounded, so a full ring would make every slice empty —
+    drain it first and slice from zero."""
     t = tracing.get_tracer()
-    start = len(t.records)
-    yield t, start
+    t.records.clear()
+    yield t, 0
 
 
 def new_records(t, start):
