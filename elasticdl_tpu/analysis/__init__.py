@@ -8,7 +8,12 @@ recompilation):
 
 - lock discipline (EDL1xx): `# guarded_by: _lock` attribute annotations,
   verified so every access happens under `with self._lock` or in a method
-  annotated as holding it;
+  annotated as holding it (EDL101); plus the whole-program half built on
+  the project call graph (`callgraph.py` / `concurrency.py`) — static
+  lock-order inversion over interprocedurally-propagated held sets
+  (EDL102, `--lock-graph` emits the acquisition graph), blocking calls
+  under a lock with may-block propagation (EDL103), and guarded mutable
+  state escaping its critical section as a live reference (EDL104);
 - JAX hazards (EDL2xx): host syncs in dispatch loops, jit cache churn,
   tracer leaks, unordered iteration feeding pytrees;
 - RPC / control-plane hygiene (EDL3xx): bare stubs bypassing
@@ -24,9 +29,12 @@ by the chaos tests — lives in `lockorder.py`.
 from elasticdl_tpu.analysis.core import (  # noqa: F401
     Finding,
     ModuleContext,
+    ProjectContext,
+    ProjectRule,
     Rule,
     all_rules,
     load_baseline,
+    prune_baseline,
     run_analysis,
 )
 from elasticdl_tpu.analysis.lockorder import (  # noqa: F401

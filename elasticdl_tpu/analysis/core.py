@@ -88,6 +88,48 @@ class Rule:
         )
 
 
+class ProjectRule(Rule):
+    """A rule that needs the WHOLE parsed tree (call graph, cross-module
+    state) instead of one module at a time. Subclasses implement
+    `check_project`; suppression/baseline/CLI machinery is shared — each
+    Finding is attributed to its module and suppressible there like any
+    per-module finding."""
+
+    def check(self, ctx: "ModuleContext") -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project: "ProjectContext") -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+class ProjectContext:
+    """Every parsed module of one run, plus shared lazily-built analyses.
+
+    The tree is parsed ONCE (the same ModuleContexts the per-module rules
+    saw); the call graph and any heavier shared models are built on first
+    use and memoized in `cache`, so N project rules pay for one build."""
+
+    def __init__(self, modules: Sequence["ModuleContext"]):
+        self.modules = list(modules)
+        self.by_path: Dict[str, "ModuleContext"] = {
+            m.rel_path: m for m in self.modules
+        }
+        self.cache: Dict[str, object] = {}
+        self._callgraph = None
+
+    @property
+    def callgraph(self):
+        if self._callgraph is None:
+            from elasticdl_tpu.analysis.callgraph import CallGraph
+
+            self._callgraph = CallGraph(self.modules)
+        return self._callgraph
+
+    def suppressed(self, finding: Finding) -> bool:
+        ctx = self.by_path.get(finding.path)
+        return ctx.suppressed(finding) if ctx is not None else False
+
+
 class ModuleContext:
     """One parsed module plus the lookups every rule needs."""
 
@@ -193,6 +235,7 @@ def all_rules() -> List[Rule]:
     """Every registered rule (importing the rule modules registers them)."""
     # imported lazily so `core` has no import cycle with the rule modules
     from elasticdl_tpu.analysis import (  # noqa: F401
+        concurrency,
         elasticity_rules,
         jax_rules,
         locks,
@@ -201,6 +244,28 @@ def all_rules() -> List[Rule]:
     )
 
     return list(_RULES)
+
+
+def select_rules(
+    rules: Sequence[Rule], select: Optional[Set[str]]
+) -> List[Rule]:
+    """Filter by id, slug, or FAMILY PREFIX: `EDL1` selects every EDL1xx
+    rule (`EDL` selects all). Matching is case-insensitive."""
+    if not select:
+        return list(rules)
+    wanted = {s.lower() for s in select}
+    out: List[Rule] = []
+    for r in rules:
+        rid = r.id.lower()
+        if rid in wanted or r.name.lower() in wanted:
+            out.append(r)
+            continue
+        if any(
+            re.fullmatch(r"edl\d{0,2}", w) and rid.startswith(w)
+            for w in wanted
+        ):
+            out.append(r)
+    return out
 
 
 # ------------------------------------------------------------------ #
@@ -232,6 +297,26 @@ def _suffixed_fingerprints(findings: Sequence[Finding]) -> List[str]:
         seen[fp] = n + 1
         out.append(fp if n == 0 else f"{fp}#{n}")
     return out
+
+
+def prune_baseline(path: str, stale: Sequence[str]) -> int:
+    """Drop `stale` fingerprints from the baseline file IN PLACE,
+    preserving the surviving entries' justifications (write_baseline
+    would reset them to TODO). Returns the number removed."""
+    if not path or not os.path.exists(path) or not stale:
+        return 0
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("entries", [])
+    dead = set(stale)
+    kept = [e for e in entries if e.get("fingerprint") not in dead]
+    removed = len(entries) - len(kept)
+    if removed:
+        data["entries"] = kept
+        with open(path, "w") as fh:
+            json.dump(data, fh, indent=2)
+            fh.write("\n")
+    return removed
 
 
 def write_baseline(path: str, findings: Sequence[Finding]) -> None:
@@ -286,7 +371,10 @@ class AnalysisResult:
 
     @property
     def ok(self) -> bool:
-        return not self.new and not self.errors
+        # stale baseline entries FAIL the run (not a note): tolerated
+        # debt that got fixed must leave the ledger (--prune-baseline),
+        # or the baseline silently rots into covering future findings
+        return not self.new and not self.errors and not self.stale_baseline
 
 
 def run_analysis(
@@ -295,27 +383,32 @@ def run_analysis(
     baseline: Optional[Dict[str, str]] = None,
     select: Optional[Set[str]] = None,
 ) -> AnalysisResult:
-    rules = list(rules) if rules is not None else all_rules()
-    if select:
-        wanted = {s.lower() for s in select}
-        rules = [
-            r for r in rules
-            if r.id.lower() in wanted or r.name.lower() in wanted
-        ]
+    rules = select_rules(
+        list(rules) if rules is not None else all_rules(), select
+    )
     baseline = baseline or {}
     findings: List[Finding] = []
     errors: List[str] = []
+    contexts: List[ModuleContext] = []
     for abs_path, rel_path in iter_python_files(paths):
         try:
             with open(abs_path, encoding="utf-8") as f:
                 source = f.read()
-            ctx = ModuleContext(abs_path, source, rel_path)
+            contexts.append(ModuleContext(abs_path, source, rel_path))
         except (SyntaxError, UnicodeDecodeError) as e:
             errors.append(f"{rel_path}: {e}")
-            continue
-        for rule in rules:
+    module_rules = [r for r in rules if not isinstance(r, ProjectRule)]
+    project_rules = [r for r in rules if isinstance(r, ProjectRule)]
+    for ctx in contexts:
+        for rule in module_rules:
             for finding in rule.check(ctx):
                 if not ctx.suppressed(finding):
+                    findings.append(finding)
+    if project_rules:
+        project = ProjectContext(contexts)
+        for rule in project_rules:
+            for finding in rule.check_project(project):
+                if not project.suppressed(finding):
                     findings.append(finding)
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     fingerprints = _suffixed_fingerprints(findings)

@@ -97,6 +97,15 @@ class _RecordingLock:
     def __exit__(self, *exc) -> None:
         self.release()
 
+    def __getattr__(self, name: str):
+        # Condition support: wait/wait_for/notify/notify_all pass through
+        # to the wrapped primitive. wait() internally releases and
+        # re-acquires the UNDERLYING lock without telling the recorder —
+        # the held-stack deliberately keeps the lock "held" across the
+        # wait, matching the lexical `with cv:` nesting the static
+        # analyzer (EDL102) sees, so the two graphs stay comparable.
+        return getattr(self._inner, name)
+
 
 class LockOrderRecorder:
     def __init__(self, raise_on_cycle: bool = True):
@@ -279,9 +288,13 @@ def instrument_master(
     process_manager=None,
     servicer=None,
     evaluation=None,
+    journal=None,
+    autoscaler=None,
 ) -> LockOrderRecorder:
     """Instrument the standard master-side locks under their canonical
-    names (the chaos smoke and the lock-order tests share this wiring)."""
+    names (the chaos smoke, the fleet soak, and the lock-order tests all
+    share this wiring — and EDL102's CANONICAL_LOCK_NAMES mirrors it, so
+    the static lock graph and the runtime edges use one vocabulary)."""
     if membership is not None:
         recorder.instrument(membership, name="membership")
     if dispatcher is not None:
@@ -294,4 +307,10 @@ def instrument_master(
             recorder.instrument(servicer, name="servicer.ctrl", attr="_ctrl_lock")
     if evaluation is not None:
         recorder.instrument(evaluation, name="evaluation")
+    if journal is not None:
+        recorder.instrument(journal, name="journal.file")
+        if hasattr(journal, "_qcv"):
+            recorder.instrument(journal, name="journal.queue", attr="_qcv")
+    if autoscaler is not None:
+        recorder.instrument(autoscaler, name="autoscaler")
     return recorder

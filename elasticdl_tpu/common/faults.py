@@ -342,6 +342,10 @@ class FaultInjector:
         if fired is None:
             return
         if fired.action == "delay":
+            # the injected stall IS the fault being simulated — callers
+            # holding locks across fire() is exactly the stall-under-lock
+            # behavior chaos legs exist to exercise:
+            # edl-lint: disable=EDL103
             time.sleep(fired.params.get("ms", 100.0) / 1000.0)
         elif fired.action == "drop":
             raise FaultInjected(site, fired.hit)
@@ -364,6 +368,9 @@ class FaultInjector:
             return
         self._trace_flushed = True
         try:
+            # last-gasp evidence dump on the atexit / pre-os._exit crash
+            # path — the process is dying, nothing queues behind it:
+            # edl-lint: disable=EDL103
             with open(self._trace_path, "a") as f:
                 for line in self.trace:
                     f.write(line + "\n")

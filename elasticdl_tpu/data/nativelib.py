@@ -48,6 +48,9 @@ def build_shared(src: str, lib_path: str, force: bool = False) -> Optional[str]:
             return lib_path if have_lib else None
         tmp = f"{lib_path}.{os.getpid()}.tmp"
         try:
+            # the module lock EXISTS to serialize this one-time compile
+            # (N concurrent opens must pay one build, not N):
+            # edl-lint: disable=EDL103
             subprocess.run(
                 ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", src, "-o", tmp],
                 check=True,

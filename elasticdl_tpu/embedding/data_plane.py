@@ -736,6 +736,10 @@ class PushQueue:
         if not self._journal_path or self._journal_failed:
             return
         try:
+            # journaling inside the queue's critical section is the
+            # replay-identity invariant (journal order == deque order);
+            # plain buffered append, no fsync — see enqueue():
+            # edl-lint: disable=EDL103
             with open(self._journal_path, "a") as f:
                 f.write(json.dumps(record) + "\n")
         except OSError:

@@ -572,6 +572,13 @@ class FleetSim:
                 "polls", "injected_tasks",
             )
         }
+        # runtime lock-order recording across the whole run (restarts
+        # union into one graph — the recorder is name-keyed, not
+        # instance-keyed): any inversion the scenario drives the real
+        # control plane into raises AT THE ACQUIRE, with both sites
+        from elasticdl_tpu.analysis.lockorder import LockOrderRecorder
+
+        self.lock_recorder = LockOrderRecorder(raise_on_cycle=True)
         # master-side handles, (re)bound by _build_master
         self.journal = None
         self.dispatcher = None
@@ -679,6 +686,16 @@ class FleetSim:
             )
             self.autoscaler.subscribe(health=self.health, alerts=self.alerts)
             self.autoscaler.bind_target(SimScaleTarget(self))
+        from elasticdl_tpu.analysis.lockorder import instrument_master
+
+        instrument_master(
+            self.lock_recorder,
+            membership=self.membership,
+            dispatcher=self.dispatcher,
+            servicer=self.servicer,
+            journal=self.journal,
+            autoscaler=self.autoscaler,
+        )
 
     def _harvest_autoscaler(self) -> None:
         """Accumulate a dying autoscaler instance's per-run counters (a
@@ -971,6 +988,13 @@ class FleetSim:
         probe.sort()
 
         replay = self._check_replay()
+        # every scenario doubles as a lock-order soak: the recorder
+        # already raised at any inverting acquire; this sweep catches
+        # cycles whose edges came from DIFFERENT threads' stacks, and
+        # the observed edges land in the result for the static-graph
+        # superset cross-check (test_lock_order.py)
+        self.lock_recorder.assert_no_cycles()
+        lock_edges = sorted(self.lock_recorder.edges())
         phases = {}
         for phase, walls in sorted(self._phase_wall.items()):
             s = sorted(walls)
@@ -1025,6 +1049,10 @@ class FleetSim:
                 "enabled": self.autoscaler is not None,
                 "reversals": self._as_totals["reversals"],
                 "actions_by_kind": dict(self._as_totals["actions"]),
+            },
+            "lock_order": {
+                "edges": [[a, b] for a, b in lock_edges],
+                "violations": len(self.lock_recorder.violations()),
             },
             "replay": replay,
             "acked_training_reports": acked,

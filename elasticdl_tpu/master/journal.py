@@ -709,6 +709,9 @@ class ControlPlaneJournal:
     def _open(self) -> None:  # holds: _lock (construction)
         os.makedirs(self.dir, exist_ok=True)
         if os.path.exists(self.path):
+            # boot-time replay read: single-threaded (no mutator exists
+            # yet), the lock is held only for construction-ordering
+            # reasons: edl-lint: disable=EDL103
             with open(self.path, encoding="utf-8") as f:
                 lines = f.readlines()
             self.replay = replay_lines(lines)
@@ -725,6 +728,8 @@ class ControlPlaneJournal:
                  if self.replay.dispatcher else 0),
             )
         self._rotate_locked()
+        # boot-time append-handle open, same single-threaded window:
+        # edl-lint: disable=EDL103
         self._fh = open(self.path, "a", encoding="utf-8")
         _GENERATION.set(self.generation)
 
@@ -738,6 +743,9 @@ class ControlPlaneJournal:
         except OSError:
             return
         try:
+            # directory-entry durability is part of the journal's leaf I/O
+            # contract — only journal.file is ever held here:
+            # edl-lint: disable=EDL103
             os.fsync(fd)
         except OSError:
             pass
@@ -748,6 +756,8 @@ class ControlPlaneJournal:
         """Atomically (re)write the journal as header + compacted snapshot.
         Runs before the append handle opens (single-threaded boot)."""
         tmp = self.path + ".tmp"
+        # boot-time rotation write — see the fsync note below:
+        # edl-lint: disable=EDL103
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(json.dumps(
                 {"t": "header", "v": JOURNAL_VERSION,
@@ -783,7 +793,7 @@ class ControlPlaneJournal:
             f.flush()
             # boot-time rotation: single-threaded (the append handle is
             # not open yet), so no mutator can queue behind this fsync:
-            # edl-lint: disable=EDL403
+            # edl-lint: disable=EDL403,EDL103
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
         self._fsync_dir()
@@ -861,7 +871,7 @@ class ControlPlaneJournal:
                 # the one sanctioned per-commit fsync site: the journal
                 # lock is a leaf I/O lock, not a control-plane lock — the
                 # group-commit committer is the scalable path
-                os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403
+                os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403,EDL103
         _APPENDS.inc(len(records))
         _COMMIT_LATENCY.observe(time.perf_counter() - t0)
         return Commit()
@@ -971,7 +981,7 @@ class ControlPlaneJournal:
                     # the group-commit fsync: ONE syscall for the whole
                     # window's commits, on the committer thread — never
                     # under a control-plane lock (the EDL403 idiom)
-                    os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403
+                    os.fsync(self._fh.fileno())  # edl-lint: disable=EDL403,EDL103
         except BaseException as e:
             batch.error = e
             with self._qcv:
@@ -1074,7 +1084,7 @@ class ControlPlaneJournal:
                         # teardown: the committer is already stopped and
                         # mutators' post-close appends drop — nothing can
                         # queue behind this final fsync:
-                        # edl-lint: disable=EDL403
+                        # edl-lint: disable=EDL403,EDL103
                         os.fsync(self._fh.fileno())
                 finally:
                     self._fh.close()

@@ -78,7 +78,7 @@ class SummaryWriter:
                 try:
                     self._jsonl.flush()
                     # teardown of a leaf writer lock (never held by
-                    # control-plane mutators): edl-lint: disable=EDL403
+                    # control-plane mutators): edl-lint: disable=EDL403,EDL103
                     os.fsync(self._jsonl.fileno())
                 except (OSError, ValueError):
                     logger.exception("events.jsonl fsync failed")
@@ -163,10 +163,16 @@ class SummaryService:
             logger.exception("registry snapshot failed")
 
     def close(self) -> None:
+        # EDL103 find: writer.close() fsyncs — take the reference under
+        # the service lock, do the blocking close outside it, so a slow
+        # disk can't convoy a concurrent eval finalizing on a handler
+        # thread behind _eval_lock
         self._train.close()
         with self._eval_lock:
-            if self._eval is not None:
-                self._eval.close()
+            ev = self._eval
+        if ev is not None:
+            ev.close()
         with self._control_lock:
-            if self._control is not None:
-                self._control.close()
+            ctl = self._control
+        if ctl is not None:
+            ctl.close()

@@ -1,0 +1,849 @@
+"""EDL1xx whole-program concurrency analyzer: fixture suites for EDL102
+(static lock-order inversion), EDL103 (blocking-call-under-lock, inter-
+procedural), and EDL104 (guarded-state escape), plus the lock-graph
+emitters and the CLI surface (`--explain`, `--select EDL1`, `--format
+github`, `--prune-baseline`, `--lock-graph`). Pure AST — no threads, no
+JAX; every fixture is a miniature of a real control-plane shape."""
+
+import json
+import textwrap
+
+from elasticdl_tpu.analysis import __main__ as cli
+from elasticdl_tpu.analysis.concurrency import (
+    build_lock_graph,
+    render_lock_graph_dot,
+)
+from elasticdl_tpu.analysis.core import (
+    ModuleContext,
+    ProjectContext,
+    ProjectRule,
+    all_rules,
+)
+
+
+def project_for(sources):
+    """ProjectContext over {rel_path: source} fixture modules."""
+    if isinstance(sources, str):
+        sources = {"fixture_conc.py": sources}
+    return ProjectContext([
+        ModuleContext(path, textwrap.dedent(src), path)
+        for path, src in sources.items()
+    ])
+
+
+def project_findings(sources, select=None):
+    """Run only the ProjectRules (the EDL1xx family) over fixtures,
+    honoring suppressions — the same path run_analysis takes."""
+    project = project_for(sources)
+    out = []
+    for rule in all_rules():
+        if not isinstance(rule, ProjectRule):
+            continue
+        if select and rule.id not in select and rule.name not in select:
+            continue
+        for f in rule.check_project(project):
+            if not project.suppressed(f):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.rule, f.path, f.line))
+
+
+def rule_ids(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ------------------------------------------------------------------ #
+# EDL102 lock-order-inversion
+
+
+INVERSION = """
+    import threading
+
+    class Pool:
+        def __init__(self):
+            self._a_lock = threading.Lock()
+            self._b_lock = threading.Lock()
+
+        def forward(self):
+            with self._a_lock:
+                with self._b_lock:
+                    pass
+
+        def backward(self):
+            with self._b_lock:
+                with self._a_lock:
+                    pass
+"""
+
+
+def test_lexical_inversion_detected():
+    fs = project_findings(INVERSION, select={"EDL102"})
+    assert len(fs) == 1
+    msg = fs[0].message
+    assert "cycle" in msg
+    assert "Pool._a_lock" in msg and "Pool._b_lock" in msg
+
+
+def test_consistent_order_is_clean():
+    src = INVERSION.replace(
+        "with self._b_lock:\n                with self._a_lock:",
+        "with self._a_lock:\n                with self._b_lock:",
+    )
+    assert project_findings(src, select={"EDL102"}) == []
+
+
+def test_interprocedural_cross_class_inversion():
+    """Neither method nests two `with` blocks; the cycle only exists
+    through the call graph (A holds its lock and calls into B, which
+    acquires B's lock — and vice versa, in a second path)."""
+    src = """
+        import threading
+
+        class Journal:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def journal_append(self):
+                with self._lock:
+                    pass
+
+            def rescan(self, reg: "Registry"):
+                with self._lock:
+                    reg.registry_note()
+
+        class Registry:
+            def __init__(self, journal: "Journal"):
+                self._lock = threading.Lock()
+                self._journal = journal
+
+            def registry_note(self):
+                with self._lock:
+                    pass
+
+            def publish(self):
+                with self._lock:
+                    self._journal.journal_append()
+    """
+    fs = project_findings(src, select={"EDL102"})
+    assert len(fs) == 1
+    assert "Journal._lock" in fs[0].message
+    assert "Registry._lock" in fs[0].message
+
+
+def test_holds_declaration_seeds_the_held_set():
+    """`# holds: _a_lock` on a helper means its acquisitions happen
+    under _a_lock — closing a cycle with a method that nests the other
+    way, even though the helper itself has ONE `with`."""
+    src = """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def _push(self):  # holds: _a_lock
+                with self._b_lock:
+                    pass
+
+            def drain(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """
+    fs = project_findings(src, select={"EDL102"})
+    assert len(fs) == 1
+    assert "Svc._a_lock" in fs[0].message and "Svc._b_lock" in fs[0].message
+
+
+def test_locked_suffix_idiom_seeds_the_held_set():
+    """`def _flush_locked` is the repo's called-under-THE-lock idiom;
+    its acquisitions are charged to `_lock` holders."""
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._io_lock = threading.Lock()
+
+            def _flush_locked(self):
+                with self._io_lock:
+                    pass
+
+            def reopen(self):
+                with self._io_lock:
+                    with self._lock:
+                        pass
+    """
+    fs = project_findings(src, select={"EDL102"})
+    assert len(fs) == 1
+    assert "Writer._lock" in fs[0].message
+    assert "Writer._io_lock" in fs[0].message
+
+
+def test_reviewed_disable_drops_the_edge_not_just_the_finding():
+    """disable=EDL102 on an acquisition site removes its edges from the
+    graph itself — the --lock-graph artifact must agree with the rule."""
+    src = INVERSION.replace(
+        "with self._a_lock:\n                    pass",
+        "with self._a_lock:  # edl-lint: disable=EDL102\n"
+        "                    pass",
+    )
+    assert project_findings(src, select={"EDL102"}) == []
+    graph = build_lock_graph(project_for(src))
+    assert graph["cycles"] == []
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("Pool._b_lock", "Pool._a_lock") not in edges
+    assert ("Pool._a_lock", "Pool._b_lock") in edges
+
+
+def test_reentrant_plain_lock_acquisition_reported():
+    src = """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def oops(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+    """
+    fs = project_findings(src, select={"EDL102"})
+    assert len(fs) == 1
+    assert "re-entrant" in fs[0].message
+    assert "self-deadlock" in fs[0].message
+
+
+def test_construction_under_lock_does_not_order_the_new_lock():
+    """Building an object under a held lock runs its __init__ happens-
+    before publication: the fresh object's internal locking must not
+    create a held -> new-lock edge (same exemption EDL101 grants)."""
+    src = """
+        import threading
+
+        class Child:
+            def __init__(self):
+                self._lock = threading.Lock()
+                with self._lock:
+                    self._state = {}
+
+            def child_touch(self, owner: "Owner"):
+                with self._lock:
+                    owner.owner_note()
+
+        class Owner:
+            def __init__(self):
+                self._own_lock = threading.Lock()
+
+            def owner_note(self):
+                with self._own_lock:
+                    pass
+
+            def spawn(self):
+                with self._own_lock:
+                    return Child()
+    """
+    assert project_findings(src, select={"EDL102"}) == []
+
+
+def test_module_level_lock_participates_in_the_graph():
+    src = """
+        import threading
+
+        _REG_LOCK = threading.Lock()
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def refresh(self):
+                with _REG_LOCK:
+                    with self._lock:
+                        pass
+    """
+    graph = build_lock_graph(project_for(src))
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("fixture_conc.py:_REG_LOCK", "Cache._lock") in edges
+
+
+def test_lock_graph_shape_and_dot_rendering():
+    graph = build_lock_graph(project_for(INVERSION))
+    assert graph["version"] == 1
+    names = {n["name"] for n in graph["nodes"]}
+    assert {"Pool._a_lock", "Pool._b_lock"} <= names
+    assert all(n["kind"] in ("lock", "rlock", "condition")
+               for n in graph["nodes"])
+    edges = {(e["from"], e["to"]) for e in graph["edges"]}
+    assert ("Pool._a_lock", "Pool._b_lock") in edges
+    assert ("Pool._b_lock", "Pool._a_lock") in edges
+    for e in graph["edges"]:
+        assert e["sites"] and all("fixture_conc.py:" in s for s in e["sites"])
+    assert graph["cycles"] and sorted(graph["cycles"][0]) == [
+        "Pool._a_lock", "Pool._b_lock"
+    ]
+    dot = render_lock_graph_dot(graph)
+    assert dot.startswith("digraph lock_order {")
+    # cycle participants render highlighted
+    assert '"Pool._a_lock" [color=red' in dot
+    assert '"Pool._a_lock" -> "Pool._b_lock"' in dot
+
+
+# ------------------------------------------------------------------ #
+# EDL103 blocking-call-under-lock
+
+
+def test_direct_blockers_under_lock_flagged():
+    src = """
+        import os
+        import time
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def stall(self):
+                with self._lock:
+                    time.sleep(1)
+
+            def flush(self, fh):
+                with self._lock:
+                    os.fsync(fh.fileno())
+
+            def load(self, path):
+                with self._lock:
+                    with open(path) as f:
+                        return f.read()
+
+            def take(self, work_queue):
+                with self._lock:
+                    return work_queue.get()
+    """
+    fs = project_findings(src, select={"EDL103"})
+    msgs = "\n".join(f.message for f in fs)
+    assert len(fs) == 4
+    assert "time.sleep()" in msgs
+    assert "os.fsync()" in msgs
+    assert "open()" in msgs
+    assert "queue wait" in msgs
+    assert all("Svc._lock" in f.message for f in fs)
+
+
+def test_blockers_outside_any_lock_are_clean():
+    src = """
+        import time
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def nap(self):
+                time.sleep(1)
+                with self._lock:
+                    return 1
+    """
+    assert project_findings(src, select={"EDL103"}) == []
+
+
+def test_may_block_propagates_through_the_call_graph():
+    """Two hops: report() holds the lock and calls _publish(), which
+    calls _flush(), which sleeps. Only the call-under-lock is flagged,
+    and the message names the original blocking site as the witness."""
+    src = """
+        import time
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _flush(self):
+                time.sleep(0.5)
+
+            def _publish(self):
+                self._flush()
+
+            def report(self):
+                with self._lock:
+                    self._publish()
+    """
+    fs = project_findings(src, select={"EDL103"})
+    assert len(fs) == 1
+    msg = fs[0].message
+    assert "_publish" in msg and "may block" in msg
+    assert "time.sleep()" in msg
+    assert "fixture_conc.py:" in msg       # the witness site
+
+
+def test_condition_wait_on_sole_held_lock_is_exempt():
+    src = """
+        import threading
+
+        class Group:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def await_quorum(self):
+                with self._cv:
+                    while not self.ready():
+                        self._cv.wait(timeout=1.0)
+
+            def ready(self):
+                return True
+    """
+    assert project_findings(src, select={"EDL103"}) == []
+
+
+def test_condition_wait_while_holding_another_lock_flagged():
+    """wait() releases the CONDITION's lock — anything else stays held
+    for the whole wait, which is the convoy EDL103 exists to catch."""
+    src = """
+        import threading
+
+        class Group:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition()
+
+            def await_quorum(self):
+                with self._lock:
+                    with self._cv:
+                        self._cv.wait(timeout=1.0)
+    """
+    fs = project_findings(src, select={"EDL103"})
+    assert len(fs) == 1
+    assert "wait()" in fs[0].message
+    assert "Group._lock" in fs[0].message
+
+
+def test_sanctioned_blocker_stops_interprocedural_propagation():
+    """A reviewed disable ON the blocking line silences the site AND
+    un-charges every caller — the journal-committer pattern: one
+    sanctioned fsync site, clean callers."""
+    src = """
+        import os
+        import threading
+
+        class Journalish:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _flush(self, fh):
+                # committer-thread leaf I/O: edl-lint: disable=EDL103
+                os.fsync(fh.fileno())
+
+            def append(self, fh):
+                with self._lock:
+                    self._flush(fh)
+    """
+    assert project_findings(src, select={"EDL103"}) == []
+
+
+def test_nonblocking_queue_get_is_not_a_blocker():
+    src = """
+        import threading
+
+        class Svc:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poll(self, work_queue):
+                with self._lock:
+                    return work_queue.get(block=False)
+    """
+    assert project_findings(src, select={"EDL103"}) == []
+
+
+def test_rpc_stub_call_under_lock_flagged():
+    src = """
+        import threading
+
+        class Reporter:
+            def __init__(self, stub):
+                self._lock = threading.Lock()
+                self._stub = stub
+
+            def report(self, req):
+                with self._lock:
+                    return self._stub.ReportTaskResult(req)
+    """
+    fs = project_findings(src, select={"EDL103"})
+    assert len(fs) == 1
+    assert "RPC" in fs[0].message
+
+
+def test_locked_suffix_method_is_charged_with_the_lock():
+    """No lexical `with` anywhere near the open(): the `_locked` naming
+    contract alone puts the body under `_lock`."""
+    src = """
+        import threading
+
+        class Writer:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def _rotate_locked(self, path):
+                return open(path, "ab")
+    """
+    fs = project_findings(src, select={"EDL103"})
+    assert len(fs) == 1
+    assert "open()" in fs[0].message
+    assert "Writer._lock" in fs[0].message
+
+
+# ------------------------------------------------------------------ #
+# EDL104 guarded-state-escape
+
+
+def test_returning_live_guarded_container_flagged_copy_clean():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def snapshot(self):
+                with self._lock:
+                    return self._members
+
+            def safe_snapshot(self):
+                with self._lock:
+                    return dict(self._members)
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1
+    assert fs[0].context == "Registry.snapshot"
+    assert "escapes" in fs[0].message and "returned" in fs[0].message
+
+
+def test_alias_then_return_is_still_an_escape():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def snapshot(self):
+                with self._lock:
+                    out = self._members
+                return out
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1 and "returned" in fs[0].message
+
+
+def test_live_dict_view_escape_flagged_materialized_clean():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def pairs(self):
+                with self._lock:
+                    return self._members.items()
+
+            def safe_pairs(self):
+                with self._lock:
+                    return list(self._members.items())
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1
+    assert fs[0].context == "Registry.pairs"
+
+
+def test_thread_and_queue_capture_flagged():
+    src = """
+        import threading
+
+        class Health:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats = {}   # guarded_by: _lock
+
+            def export(self, out_queue):
+                with self._lock:
+                    out_queue.put(self._stats)
+
+            def watch(self, fn):
+                with self._lock:
+                    t = threading.Thread(target=fn, args=(self._stats,))
+                t.start()
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 2
+    assert all("another thread" in f.message for f in fs)
+
+
+def test_cross_guard_alias_flagged_same_guard_clean():
+    src = """
+        import threading
+
+        class Tracker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux_lock = threading.Lock()
+                self._doing = {}   # guarded_by: _lock
+                self._done = {}    # guarded_by: _lock
+                self._last = {}    # guarded_by: _aux_lock
+
+            def rotate(self):
+                with self._lock:
+                    self._done = self._doing     # same guard: fine
+
+            def publish(self):
+                with self._lock:
+                    with self._aux_lock:
+                        self._last = self._doing  # guard changes: escape
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1
+    assert fs[0].context == "Tracker.publish"
+    assert "aliased into self._last" in fs[0].message
+
+
+def test_scalars_and_unknown_types_are_exempt():
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self, clock):
+                self._lock = threading.Lock()
+                self._count = 0        # guarded_by: _lock
+                self._clock = clock    # guarded_by: _lock
+
+            def value(self):
+                with self._lock:
+                    return self._count
+
+            def clock(self):
+                with self._lock:
+                    return self._clock
+    """
+    assert project_findings(src, select={"EDL104"}) == []
+
+
+def test_store_onto_other_object_and_into_container_flagged():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def attach(self, view, cache):
+                with self._lock:
+                    view.members = self._members
+                    cache["m"] = self._members
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 2
+    msgs = "\n".join(f.message for f in fs)
+    assert "stored onto view.members" in msgs
+    assert "stored into a container" in msgs
+
+
+def test_reviewed_disable_suppresses_the_escape():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def snapshot(self):
+                with self._lock:
+                    # single-threaded bootstrap only:
+                    # edl-lint: disable=EDL104
+                    return self._members
+    """
+    assert project_findings(src, select={"EDL104"}) == []
+
+
+def test_nested_defs_are_out_of_scope_by_design():
+    """Closures are a separate escape surface the rule documents as
+    skipped (EDL101 makes the same call) — pin that so a future change
+    is deliberate, not accidental."""
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            def mk_reader(self):
+                def read():
+                    return self._members
+                return read
+    """
+    assert project_findings(src, select={"EDL104"}) == []
+
+
+def test_decorated_methods_are_still_checked():
+    src = """
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._members = {}   # guarded_by: _lock
+
+            @property
+            def members(self):
+                with self._lock:
+                    return self._members
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1 and fs[0].context == "Registry.members"
+
+
+def test_annotation_typed_attr_counts_as_mutable():
+    src = """
+        import threading
+        from typing import Dict
+
+        class Registry:
+            def __init__(self, seed):
+                self._lock = threading.Lock()
+                self._members: Dict[str, int] = seed   # guarded_by: _lock
+
+            def snapshot(self):
+                with self._lock:
+                    return self._members
+    """
+    fs = project_findings(src, select={"EDL104"})
+    assert len(fs) == 1
+
+
+# ------------------------------------------------------------------ #
+# CLI surface
+
+
+def test_cli_explain_prints_full_docstring(capsys):
+    rc = cli.main(["--explain", "EDL102"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "EDL102 (lock-order-inversion)" in out
+    # full docstring, not the one-liner: the fix guidance is in there
+    assert "single global order" in out
+    rc = cli.main(["--explain", "guarded-state-escape"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "EDL104" in out
+
+
+def test_cli_explain_unknown_rule_is_a_usage_error(capsys):
+    rc = cli.main(["--explain", "EDL999"])
+    assert rc == 2
+    assert "no such rule" in capsys.readouterr().err
+
+
+def test_cli_select_family_prefix(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    # EDL1 family: the EDL303 finding is out of scope -> clean
+    rc = cli.main([str(bad), "--select", "EDL1", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    rc = cli.main([str(bad), "--select", "EDL3", "--no-baseline"])
+    capsys.readouterr()
+    assert rc == 1
+
+
+def test_cli_github_format_emits_workflow_annotations(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    rc = cli.main(
+        [str(bad), "--format", "github", "--no-baseline"]
+    )
+    out = capsys.readouterr().out
+    assert rc == 1
+    line = next(ln for ln in out.splitlines() if ln.startswith("::error"))
+    assert "file=" in line and "line=" in line
+    assert "title=edl-lint EDL303" in line
+
+
+def test_cli_stale_baseline_fails_until_pruned(tmp_path, capsys):
+    bad = tmp_path / "mod.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    baseline = tmp_path / ".edl-lint-baseline.json"
+    rc = cli.main([str(bad), "--baseline", str(baseline), "--write-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+
+    # pay the debt: the baselined finding disappears -> stale entry
+    bad.write_text("def f(ch):\n    ch.close()\n")
+    rc = cli.main([str(bad), "--baseline", str(baseline)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "STALE baseline" in out
+
+    rc = cli.main([str(bad), "--baseline", str(baseline),
+                   "--prune-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0 and "pruned 1" in out
+    assert json.loads(baseline.read_text())["entries"] == []
+
+    rc = cli.main([str(bad), "--baseline", str(baseline)])
+    capsys.readouterr()
+    assert rc == 0
+
+
+def test_cli_lock_graph_artifact_json_and_dot(tmp_path, capsys):
+    mod = tmp_path / "pool.py"
+    mod.write_text(textwrap.dedent("""
+        import threading
+
+        class Pool:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def forward(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+    """))
+    dest = tmp_path / "lock_graph.json"
+    rc = cli.main([str(mod), "--no-baseline", "--lock-graph", str(dest)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "lock graph:" in out
+    graph = json.loads(dest.read_text())
+    assert {(e["from"], e["to"]) for e in graph["edges"]} == {
+        ("Pool._a_lock", "Pool._b_lock")
+    }
+    dot_dest = tmp_path / "lock_graph.dot"
+    rc = cli.main([str(mod), "--no-baseline", "--lock-graph", str(dot_dest)])
+    capsys.readouterr()
+    assert rc == 0
+    assert dot_dest.read_text().startswith("digraph lock_order {")

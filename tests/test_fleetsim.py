@@ -114,6 +114,41 @@ def test_cliff_metrics_are_reported(twin_runs):
         assert phase["p99_ms"] >= phase["p50_ms"] >= 0
 
 
+def test_soak_lock_order_clean_and_covered_by_static_graph(twin_runs):
+    """The soak doubles as the runtime leg of the EDL102 cross-check:
+    the whole chaos run (rack kill, master kill + replay) recorded a
+    cycle-free acquisition graph, every observed edge names a canonical
+    lock, and every edge is already in the static lock-acquisition
+    graph — runtime ⊆ static, the direction that proves the analyzer's
+    call-graph resolution isn't losing executed paths."""
+    import elasticdl_tpu
+    from elasticdl_tpu.analysis.concurrency import build_lock_graph
+    from elasticdl_tpu.analysis.core import (
+        ModuleContext,
+        ProjectContext,
+        iter_python_files,
+    )
+
+    a, _, _ = twin_runs
+    assert a["lock_order"]["violations"] == 0
+    runtime = {tuple(e) for e in a["lock_order"]["edges"]}
+    # the journaling master must actually have nested owner -> journal
+    assert any(b.startswith("journal.") for (_, b) in runtime), runtime
+
+    pkg = os.path.dirname(elasticdl_tpu.__file__)
+    contexts = []
+    for abs_path, rel_path in iter_python_files([pkg]):
+        with open(abs_path, encoding="utf-8") as f:
+            contexts.append(ModuleContext(abs_path, f.read(), rel_path))
+    graph = build_lock_graph(ProjectContext(contexts))
+    static = {(e["from"], e["to"]) for e in graph["edges"]}
+    missing = runtime - static
+    assert not missing, (
+        f"soak-observed lock edges absent from the static graph: "
+        f"{sorted(missing)}"
+    )
+
+
 # ---------------------------------------------------------------------- #
 # scenario schema
 
