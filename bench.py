@@ -1375,8 +1375,33 @@ ET_PIPE = int(os.environ.get("EDL_BENCH_ET_PIPE", "2"))
 # base + rows*per_row before serving (sleep releases the GIL, so
 # overlap composes exactly like a NIC-bound RPC would); the constants
 # are explicit in the bench record and 0/0 turns the wire off.
-ET_WIRE_US = float(os.environ.get("EDL_BENCH_ET_WIRE_US", "200"))
-ET_WIRE_ROW_US = float(os.environ.get("EDL_BENCH_ET_WIRE_ROW_US", "1"))
+#
+# CALIBRATED (ISSUE 18): the defaults come from the committed
+# data_plane baseline's `wire_truth` record — the loopback per-call and
+# per-row cost the real gRPC leg MEASURED on a runner of this class —
+# instead of the hand-picked 200/1 the model shipped with (the measured
+# call cost was ~5x that, which is exactly the gap the fused lanes
+# close). Env overrides still win, and a tree without the baseline
+# falls back to the old constants.
+
+
+def _wire_truth_defaults():
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "bench-baselines", "bench-data-plane.json")
+    try:
+        with open(path) as f:
+            wt = json.load(f)["data_plane"]["wire_truth"]
+        return (float(wt["measured_loopback_call_us"]),
+                float(wt["measured_loopback_row_us"]))
+    except Exception:
+        return 200.0, 1.0
+
+
+_ET_WIRE_DEFAULTS = _wire_truth_defaults()
+ET_WIRE_US = float(os.environ.get(
+    "EDL_BENCH_ET_WIRE_US", str(_ET_WIRE_DEFAULTS[0])))
+ET_WIRE_ROW_US = float(os.environ.get(
+    "EDL_BENCH_ET_WIRE_ROW_US", str(_ET_WIRE_DEFAULTS[1])))
 
 
 def _et_master(tmp, num_shards, replicas=0):
@@ -2247,8 +2272,10 @@ def bench_data_plane(mesh=None, np=None):
         # unhedged control: same topology, its own channels, no hedge,
         # no queue — what the partition does to a naive client
         ctrl = dp.ResilientTransport(
+            # shm=False: the control is the pure-SOCKET shape — the
+            # same-host ring must not quietly rescue it
             dp.GrpcTransport({0: addr0, 1: addr1},
-                             default_timeout_s=budget_s),
+                             default_timeout_s=budget_s, shm=False),
             policies={"pull": dp.CallPolicy(budget_s=budget_s,
                                             max_attempts=1)},
             hedge=False, queue_max=0,
@@ -2330,6 +2357,87 @@ def bench_data_plane(mesh=None, np=None):
             "measured_loopback_call_us": round(call_us, 1),
             "measured_loopback_row_us": round(row_us, 3),
         }
+
+        # ---- wire-speed throughput legs (ISSUE 18) --------------------
+        # raw transport read rate against ONE owner over the same live
+        # processes, three stacked lanes so every layer's win is
+        # attributed: per-(table, shard) unary pulls (the PR-15 shape:
+        # DP_SHARDS calls per round), the FUSED pull_multi over the
+        # gRPC socket (1 call per round), and the fused call over the
+        # same-host shared-memory ring. Each lane uses its own bare
+        # GrpcTransport — no hedging/retry layer, no cache — so the
+        # rates are pure wire + codec.
+        tp_ids = np.arange(256, dtype=np.int32)
+        tp_reqs = [("users", s, tp_ids) for s in range(DP_SHARDS)]
+        tp_rows_per_round = DP_SHARDS * int(tp_ids.shape[0])
+
+        def _tp_rate(fn, min_s=0.8):
+            fn()                      # warmup (channel / ring setup)
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < min_s:
+                fn()
+                n += 1
+            dt = time.perf_counter() - t0
+            return (round(n * tp_rows_per_round / dt, 1),
+                    round(1e6 * dt / n, 1))
+
+        t_unary = dp.GrpcTransport({0: addr0, 1: addr1},
+                                   default_timeout_s=budget_s, shm=False)
+        t_fused = dp.GrpcTransport({0: addr0, 1: addr1},
+                                   default_timeout_s=budget_s, shm=False)
+        t_shm = dp.GrpcTransport({0: addr0, 1: addr1},
+                                 default_timeout_s=budget_s, shm=True)
+        try:
+            with tracing.span("data_plane.wire_speed"):
+                unary_rate, unary_round_us = _tp_rate(lambda: [
+                    t_unary.pull(0, "users", s, tp_ids, map_version=1,
+                                 with_watermark=True)
+                    for s in range(DP_SHARDS)
+                ])
+                fused_rate, fused_round_us = _tp_rate(
+                    lambda: t_fused.pull_multi(0, tp_reqs, map_version=1))
+                shm_rate, shm_round_us = _tp_rate(
+                    lambda: t_shm.pull_multi(0, tp_reqs, map_version=1))
+                shm_ok = bool(getattr(t_shm, "_shm_rings", None))
+                # per-CALL wire cost of the ring, payload-free: the
+                # batched watermark probe round-trips the same codec +
+                # ring with no rows — the fused lanes' per-call floor
+                probe_n2 = 256
+                t0 = time.perf_counter()
+                for _ in range(probe_n2):
+                    t_shm.watermark_multi(0, [("users", 0)])
+                shm_call_us = 1e6 * (time.perf_counter() - t0) / probe_n2
+            out["data_plane_layers"] = {
+                "unary_per_table": {
+                    "rows_per_s_per_owner": unary_rate,
+                    "round_us": unary_round_us,
+                    "calls_per_round": DP_SHARDS,
+                },
+                "fused_grpc": {
+                    "rows_per_s_per_owner": fused_rate,
+                    "round_us": fused_round_us,
+                    "calls_per_round": 1,
+                },
+                "fused_shm": {
+                    "rows_per_s_per_owner": shm_rate,
+                    "round_us": shm_round_us,
+                    "calls_per_round": 1,
+                },
+            }
+            # the two acceptance headlines: sustained read rows/s
+            # against one owner over the full stack, and the measured
+            # per-call wire cost on the short-circuit lane
+            out["rows_per_s_per_owner"] = shm_rate if shm_ok else fused_rate
+            out["wire_per_call_us"] = round(
+                shm_call_us if shm_ok else call_us, 1)
+            out["coalesce_speedup"] = round(fused_rate / unary_rate, 2)
+            out["wire_speed_total_speedup"] = round(
+                out["rows_per_s_per_owner"] / unary_rate, 2)
+            out["shm_ring_negotiated"] = shm_ok
+        finally:
+            for t in (t_unary, t_fused, t_shm):
+                t.close()
 
         # ---- phase 2: owner partition ---------------------------------
         # channel blackhole: a socket that accepts and never answers —
@@ -3556,6 +3664,13 @@ _COMPARE_METRICS = (
     # generous absolute slack because both ride loopback RPC noise
     ("*degraded_read_share", "higher", 0.25),
     ("*read_p99_under_partition_ms", "lower", 15.0),
+    # wire-speed data plane (ISSUE 18): the sustained per-owner read
+    # rate must not regress, and the measured per-call wire cost on
+    # the short-circuit lane must stay low — 100 us absolute slack
+    # because a contended runner's sleep() floor dominates the ring's
+    # own cost at this scale
+    ("*rows_per_s_per_owner", "higher", 0.0),
+    ("*wire_per_call_us", "lower", 100.0),
     # absolute slack = the scenario's own 1% gate: a contended runner
     # inside the documented invariant must not fail the compare step
     ("*attribution_worst_error_pct", "lower", 1.0),

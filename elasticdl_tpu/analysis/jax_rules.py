@@ -66,6 +66,17 @@ EDL207 blocking-pull-with-pipeline-available
     (embedding/tier.EmbeddingPullPipeline, or
     EmbeddingTierSession.run's windowed form). `.push` stays exempt —
     writes are the step's own output and cannot be issued ahead.
+
+EDL209 uncoalesced-per-table-pull
+    a tier `.pull(...)`/`.pull_unique(...)` issued once PER TABLE — an
+    inner loop within a step-dispatch hot loop (EDL201/EDL206's
+    definition) whose body passes the loop variable into the tier
+    call. Each iteration pays a full owner round trip for one table's
+    ids; `pull_unique_multi({table: ids, ...})` fuses every table's
+    misses into ONE wire call per owner (EmbeddingPullMulti), and the
+    owner's full watermark set piggybacks on the response for free.
+    EDL206 usually co-fires on the same call (it is also a nested-loop
+    tier call); EDL209 names the fix.
 """
 
 from __future__ import annotations
@@ -548,6 +559,84 @@ class BlockingPullWithPipelineRule(Rule):
                         "overlap — submit() the next batch ahead and "
                         "get() here (EmbeddingPullPipeline)",
                     )
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Names bound by a For target (`for t in ...`, `for t, ids in ...`)."""
+    names: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+    return names
+
+
+@register
+class UncoalescedPerTablePullRule(Rule):
+    id = "EDL209"
+    name = "uncoalesced-per-table-pull"
+    doc = (
+        "tier .pull/.pull_unique issued once per table (inner loop over "
+        "table names inside a step-dispatch hot loop) — one owner round "
+        "trip per table; pull_unique_multi fuses every table into one "
+        "wire call per owner"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        reported: Set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            body = list(node.body) + list(node.orelse)
+            called = set()
+            for stmt in body:
+                called |= _called_attr_names(stmt)
+            if not (called & _DISPATCH_METHODS):
+                # shares EDL201/EDL206's hot-loop definition
+                continue
+            if any(
+                isinstance(n, (ast.For, ast.While))
+                and _called_attr_names(n) & _DISPATCH_METHODS
+                for stmt in body for n in ast.walk(stmt)
+            ):
+                # an INNER loop is the real dispatch loop (epoch wrapper)
+                continue
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if not isinstance(sub, ast.For):
+                        continue
+                    loop_vars = _target_names(sub.target)
+                    if not loop_vars:
+                        continue
+                    yield from self._scan(ctx, sub, loop_vars, reported)
+
+    def _scan(
+        self, ctx: ModuleContext, loop: ast.For, loop_vars: Set[str],
+        reported: Set[int],
+    ) -> Iterator[Finding]:
+        """Flag pull/pull_unique in the inner loop's DIRECT body that
+        receive the loop variable — the per-table shape. (Deeper
+        nesting re-enters check() via the outer walk; pushes are the
+        step's own output and are EDL206's concern.)"""
+        for cand in _direct_body_calls(list(loop.body)
+                                       + list(loop.orelse)):
+            what = _tier_call(cand)
+            if what in (None, "push") or id(cand) in reported:
+                continue
+            args = list(cand.args) + [kw.value for kw in cand.keywords]
+            if not any(
+                isinstance(n, ast.Name) and n.id in loop_vars
+                for a in args for n in ast.walk(a)
+            ):
+                continue
+            reported.add(id(cand))
+            yield self.finding(
+                ctx, cand,
+                f"tier .{what}() once per table in the step-dispatch "
+                "hot loop pays one owner round trip per table; fuse "
+                "the batch into pull_unique_multi({table: ids, ...}) — "
+                "one EmbeddingPullMulti wire call per owner, with the "
+                "owner's watermarks piggybacked",
+            )
 
 
 def _is_set_expr(node: ast.AST) -> bool:

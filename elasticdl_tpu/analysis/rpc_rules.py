@@ -155,6 +155,12 @@ class RpcMissingDeadlineRule(Rule):
 DATA_PLANE_RPCS = {
     "EmbeddingPull", "EmbeddingPush", "EmbeddingFetchShard",
     "EmbeddingFetchDelta", "EmbeddingWatermark",
+    # wire-speed lane (ISSUE 18): fused pulls, shm negotiation, and the
+    # streaming fetch variants are data-plane calls like any other —
+    # a deadline-less call still wedges on a partitioned owner
+    "EmbeddingPullMulti", "EmbeddingWatermarkMulti",
+    "EmbeddingShmNegotiate", "EmbeddingFetchShardStream",
+    "EmbeddingFetchDeltaStream",
 }
 
 

@@ -330,6 +330,13 @@ class JobConfig:
     # Queued pushes journal to <checkpoint_dir>/emb-push-queue.jsonl
     # and drain in order on reconnect under their original seqs.
     embedding_push_queue: int = 1024
+    # same-host shared-memory short-circuit (ISSUE 18): when a tier
+    # client and an owning store share a host, hot data-plane calls
+    # ride a negotiated shared-memory ring instead of the gRPC
+    # loopback (~10x lower per-call cost); any ring failure falls
+    # back to gRPC transparently. grpc transport only; off = always
+    # use the socket.
+    embedding_shm: bool = True
 
     # --- mesh / parallelism (TPU-native; no reference analog) ---
     mesh_shape: str = ""           # "" = all devices on axis "data"; "4,2" = data=4, model=2

@@ -81,6 +81,7 @@ import grpc
 import numpy as np
 
 from elasticdl_tpu.common import faults
+from elasticdl_tpu.embedding import shm as _shm
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.embedding.store import StaleShardMapError
 from elasticdl_tpu.embedding.transport import (
@@ -108,7 +109,31 @@ _DATA_RPCS = {
         pb.EmbeddingFetchDeltaRequest, pb.EmbeddingFetchDeltaResponse),
     "EmbeddingWatermark": (
         pb.EmbeddingWatermarkRequest, pb.EmbeddingWatermarkResponse),
+    # wire-speed lanes (ISSUE 18)
+    "EmbeddingPullMulti": (
+        pb.EmbeddingPullMultiRequest, pb.EmbeddingPullMultiResponse),
+    "EmbeddingWatermarkMulti": (
+        pb.EmbeddingWatermarkMultiRequest,
+        pb.EmbeddingWatermarkMultiResponse),
+    "EmbeddingShmNegotiate": (
+        pb.EmbeddingShmNegotiateRequest, pb.EmbeddingShmNegotiateResponse),
 }
+
+# server-streamed rpcs (ISSUE 18): one call, chunked frames — replica
+# sync and shard migration stop paying a unary round-trip per chunk
+_DATA_STREAM_RPCS = {
+    "EmbeddingFetchShardStream": (
+        pb.EmbeddingFetchShardRequest, pb.EmbeddingShardChunk),
+    "EmbeddingFetchDeltaStream": (
+        pb.EmbeddingFetchDeltaRequest, pb.EmbeddingDeltaChunk),
+}
+
+#: stream chunk sizing: target bytes of row payload per frame (rows per
+#: frame = STREAM_CHUNK_BYTES / (dim * 4), floor 1); delta streams frame
+#: by entry count instead. docs/performance.md discusses the tradeoff.
+STREAM_CHUNK_BYTES = int(float(os.environ.get(
+    "EDL_EMB_STREAM_CHUNK_KB", "512")) * 1024)
+STREAM_DELTA_ENTRIES = 64
 
 _reg = default_registry()
 _RPC_CALLS = _reg.counter(
@@ -172,6 +197,14 @@ _QUEUE_REJECTED = _reg.counter(
     "edl_emb_push_queue_rejected_total",
     "pushes refused because the bounded queue was full (the caller "
     "blocks/raises instead — bounded memory is part of the contract)")
+_COALESCED_TABLES = _reg.histogram(
+    "edl_emb_rpc_coalesced_tables",
+    "(table, shard) sub-pulls fused into each EmbeddingPullMulti call "
+    "— the coalescing factor the per-call amortization rides on")
+_STREAM_CHUNKS = _reg.counter(
+    "edl_emb_stream_chunks_total",
+    "frames served/consumed on the streaming fetch lanes, by method",
+    labels=("method",))
 
 
 # ------------------------------------------------------------------ #
@@ -202,6 +235,128 @@ def rows_from_bytes(data: bytes, dim: int) -> np.ndarray:
 class DeadlineExceededError(OwnerUnavailableError):
     """A data-plane call ran out its deadline budget (the owner may or
     may not have applied it — the seq fence makes the re-send safe)."""
+
+
+# ------------------------------------------------------------------ #
+# fused serving helpers — pure store -> message functions shared by
+# the gRPC servicer and the shared-memory ring dispatcher (the ring
+# replaces the socket, not the codec)
+
+
+def _serve_pull_multi(store, request) -> "pb.EmbeddingPullMultiResponse":
+    """Serve one fused multi-(table, shard) pull: the flat id blob is
+    segmented by `counts` with frombuffer views (no per-table copies
+    in), the per-sub row blocks flatten into ONE response blob (one
+    memcpy out), and the owner's full primary watermark set piggybacks.
+    Raises StaleShardMapError for the caller to map onto its wire."""
+    ids_flat = ids_from_bytes(request.ids)
+    mv = request.map_version or None
+    blocks: List[np.ndarray] = []
+    dims: List[int] = []
+    wms: List[int] = []
+    off = 0
+    for table, shard, count in zip(request.tables, request.shards,
+                                   request.counts):
+        sub = ids_flat[off:off + count]
+        off += count
+        rows, wm = store.pull(
+            table, int(shard), sub, map_version=mv,
+            with_watermark=True, replica=request.replica)
+        blocks.append(
+            np.ascontiguousarray(np.asarray(rows, np.float32)).reshape(-1))
+        dims.append(int(rows.shape[1]))
+        wms.append(int(wm))
+    rows_bytes = (np.concatenate(blocks).astype("<f4", copy=False).tobytes()
+                  if blocks else b"")
+    resp = pb.EmbeddingPullMultiResponse(
+        rows=rows_bytes, dims=dims, wms=wms)
+    for t, s in store.resident_shards():
+        resp.wm_tables.append(t)
+        resp.wm_shards.append(int(s))
+        resp.wm_values.append(int(store.shard_watermark(t, s)))
+    return resp
+
+
+def _serve_watermark_multi(store, request):
+    return pb.EmbeddingWatermarkMultiResponse(wms=[
+        int(store.shard_watermark(t, int(s), replica=request.replica))
+        for t, s in zip(request.tables, request.shards)
+    ])
+
+
+def _decode_pull_multi(requests, resp):
+    """Client side of the fused pull: segment the flat row blob into
+    per-sub-request views (frombuffer — zero copies until the tier
+    scatters into its output buffer) plus the piggybacked owner
+    watermark map."""
+    flat = np.frombuffer(resp.rows, dtype="<f4").astype(
+        np.float32, copy=False)
+    results = []
+    off = 0
+    for (_t, _s, ids), dim, wm in zip(requests, resp.dims, resp.wms):
+        n = int(np.asarray(ids).shape[0])
+        dim = int(dim)
+        results.append((flat[off:off + n * dim].reshape(n, dim), int(wm)))
+        off += n * dim
+    owner_wms = {
+        (t, int(s)): int(wm)
+        for t, s, wm in zip(resp.wm_tables, resp.wm_shards, resp.wm_values)
+    }
+    return results, owner_wms
+
+
+def _shm_dispatch(servicer, method_id: int, payload: bytes):
+    """Serve one shared-memory ring request against the servicer's
+    store. Mirrors the gRPC handlers' error mapping onto the ring's
+    tiny status vocabulary (the 'shard map' marker keeps the client
+    classifier routing to StaleShardMapError)."""
+    store = servicer._store  # noqa: SLF001 - servicer-internal by design
+    if store is None:
+        return (_shm.S_STALE,
+                b"stale shard map: no store bound on this owner yet")
+    try:
+        if method_id == _shm.M_PULL_MULTI:
+            _RPC_SERVER_CALLS.inc(method="EmbeddingPullMulti")
+            req = pb.EmbeddingPullMultiRequest.FromString(payload)
+            resp = _serve_pull_multi(store, req)
+        elif method_id == _shm.M_WATERMARK_MULTI:
+            _RPC_SERVER_CALLS.inc(method="EmbeddingWatermarkMulti")
+            req = pb.EmbeddingWatermarkMultiRequest.FromString(payload)
+            resp = _serve_watermark_multi(store, req)
+        elif method_id == _shm.M_PULL:
+            _RPC_SERVER_CALLS.inc(method="EmbeddingPull")
+            req = pb.EmbeddingPullRequest.FromString(payload)
+            rows, wm = store.pull(
+                req.table, req.shard, ids_from_bytes(req.ids),
+                map_version=req.map_version or None,
+                with_watermark=True, replica=req.replica)
+            resp = pb.EmbeddingPullResponse(
+                rows=rows_to_bytes(rows), dim=int(rows.shape[1]),
+                wm=int(wm))
+        elif method_id == _shm.M_PUSH:
+            _RPC_SERVER_CALLS.inc(method="EmbeddingPush")
+            req = pb.EmbeddingPushRequest.FromString(payload)
+            applied, wm = store.push(
+                req.table, req.shard, ids_from_bytes(req.ids),
+                rows_from_bytes(req.rows, req.dim),
+                client_id=req.client_id, seq=int(req.seq),
+                map_version=req.map_version or None,
+                scale=float(req.scale or 1.0), with_watermark=True)
+            resp = pb.EmbeddingPushResponse(
+                applied=bool(applied), wm=int(wm))
+        elif method_id == _shm.M_WATERMARK:
+            _RPC_SERVER_CALLS.inc(method="EmbeddingWatermark")
+            req = pb.EmbeddingWatermarkRequest.FromString(payload)
+            resp = pb.EmbeddingWatermarkResponse(wm=int(
+                store.shard_watermark(req.table, req.shard,
+                                      replica=req.replica)))
+        else:
+            return _shm.S_ERROR, f"unknown method {method_id}".encode()
+    except StaleShardMapError as e:
+        return _shm.S_STALE, f"stale shard map: {e}".encode("utf-8")
+    except Exception as e:
+        return _shm.S_ERROR, str(e).encode("utf-8")
+    return _shm.S_OK, resp.SerializeToString()
 
 
 # ------------------------------------------------------------------ #
@@ -330,6 +485,110 @@ class EmbeddingDataServicer:
             self._abort_stale(context, e)
         return pb.EmbeddingWatermarkResponse(wm=int(wm))
 
+    # ---- wire-speed lanes (ISSUE 18) ------------------------------- #
+
+    def bind_shm(self, shm_server) -> None:
+        """Late-bind the shared-memory ring server (EmbeddingDataServer
+        owns its lifetime) so EmbeddingShmNegotiate can mint rings."""
+        self._shm_server = shm_server
+
+    def EmbeddingPullMulti(self, request, context):
+        store = self._serve_guard("EmbeddingPullMulti", context)
+        try:
+            return _serve_pull_multi(store, request)
+        except StaleShardMapError as e:
+            self._abort_stale(context, e)
+
+    def EmbeddingWatermarkMulti(self, request, context):
+        store = self._serve_guard("EmbeddingWatermarkMulti", context)
+        try:
+            return _serve_watermark_multi(store, request)
+        except StaleShardMapError as e:
+            self._abort_stale(context, e)
+
+    def EmbeddingShmNegotiate(self, request, context):
+        # no store guard: negotiation only mints a ring; every ring
+        # request re-checks store binding at serve time
+        _RPC_SERVER_CALLS.inc(method="EmbeddingShmNegotiate")
+        shm_server = getattr(self, "_shm_server", None)
+        if shm_server is None:
+            return pb.EmbeddingShmNegotiateResponse(ok=False)
+        granted = shm_server.negotiate(int(request.slot_bytes))
+        if granted is None:
+            return pb.EmbeddingShmNegotiateResponse(ok=False)
+        name, slot_bytes = granted
+        logger.info("shm ring %s (%d B slots) negotiated for client "
+                    "%s pid %d", name, slot_bytes,
+                    request.client_host or "?", request.client_pid)
+        return pb.EmbeddingShmNegotiateResponse(
+            ok=True, segment=name, slot_bytes=int(slot_bytes))
+
+    def EmbeddingFetchShardStream(self, request, context):
+        store = self._serve_guard("EmbeddingFetchShardStream", context)
+        try:
+            payload = store.extract_shard(
+                request.table, request.shard, replica=request.replica)
+        except StaleShardMapError as e:
+            self._abort_stale(context, e)
+        rows = np.asarray(payload["rows"], np.float32)
+        n, dim = int(rows.shape[0]), int(rows.shape[1])
+        per_frame = max(1, STREAM_CHUNK_BYTES // max(1, dim * 4))
+        off = 0
+        first = True
+        while True:
+            end = min(n, off + per_frame)
+            frame = pb.EmbeddingShardChunk(
+                rows=rows_to_bytes(rows[off:end]), offset=off,
+                last=end >= n)
+            if first:
+                # the fence rides the FIRST frame: a consumer that saw
+                # frame 0 knows the full extent and the exactly-once
+                # watermarks even if the stream dies right after
+                frame.rows_n = n
+                frame.dim = dim
+                frame.applied_json = json.dumps(payload["applied"])
+                frame.wm = int(payload.get("wm", 0))
+                first = False
+            _STREAM_CHUNKS.inc(method="EmbeddingFetchShardStream")
+            yield frame
+            off = end
+            if off >= n:
+                return
+
+    def EmbeddingFetchDeltaStream(self, request, context):
+        store = self._serve_guard("EmbeddingFetchDeltaStream", context)
+        try:
+            delta = store.fetch_delta(
+                request.table, request.shard, int(request.since_wm))
+        except StaleShardMapError as e:
+            self._abort_stale(context, e)
+        if delta is None:
+            _STREAM_CHUNKS.inc(method="EmbeddingFetchDeltaStream")
+            yield pb.EmbeddingDeltaChunk(found=False, last=True)
+            return
+        entries = delta["entries"]
+        wm = int(delta["wm"])
+        if not entries:
+            _STREAM_CHUNKS.inc(method="EmbeddingFetchDeltaStream")
+            yield pb.EmbeddingDeltaChunk(found=True, wm=wm, last=True)
+            return
+        for off in range(0, len(entries), STREAM_DELTA_ENTRIES):
+            frame = pb.EmbeddingDeltaChunk(
+                found=True, wm=wm,
+                last=off + STREAM_DELTA_ENTRIES >= len(entries))
+            for e in entries[off:off + STREAM_DELTA_ENTRIES]:
+                erows = np.asarray(e["rows"], np.float32)
+                frame.entries.add(
+                    wm=int(e["wm"]), ids=ids_to_bytes(e["ids"]),
+                    rows=rows_to_bytes(erows),
+                    dim=int(erows.shape[1]) if erows.ndim == 2 else 0,
+                    scale=float(e.get("scale", 1.0)),
+                    client_id=str(e.get("client_id", "")),
+                    seq=int(e.get("seq", -1)),
+                )
+            _STREAM_CHUNKS.inc(method="EmbeddingFetchDeltaStream")
+            yield frame
+
 
 def add_data_servicer(server: grpc.Server, servicer: Any) -> None:
     """Register the EmbeddingData handlers on a grpc server (generic
@@ -337,6 +596,12 @@ def add_data_servicer(server: grpc.Server, servicer: Any) -> None:
     handlers = {}
     for name, (req_t, _resp_t) in _DATA_RPCS.items():
         handlers[name] = grpc.unary_unary_rpc_method_handler(
+            getattr(servicer, name),
+            request_deserializer=req_t.FromString,
+            response_serializer=lambda msg: msg.SerializeToString(),
+        )
+    for name, (req_t, _resp_t) in _DATA_STREAM_RPCS.items():
+        handlers[name] = grpc.unary_stream_rpc_method_handler(
             getattr(servicer, name),
             request_deserializer=req_t.FromString,
             response_serializer=lambda msg: msg.SerializeToString(),
@@ -353,7 +618,7 @@ class EmbeddingDataServer:
     ride the RegisterWorker request)."""
 
     def __init__(self, store=None, host: str = "127.0.0.1",
-                 max_workers: int = 8):
+                 max_workers: int = 8, shm: bool = True):
         from elasticdl_tpu.proto.service import make_server
 
         self.host = host
@@ -361,6 +626,15 @@ class EmbeddingDataServer:
         self._server = make_server(max_workers=max_workers)
         add_data_servicer(self._server, self.servicer)
         self.port: Optional[int] = None
+        self._shm_server = None
+        if shm:
+            from elasticdl_tpu.embedding.shm import HAVE_SHM, ShmRingServer
+
+            if HAVE_SHM:
+                self._shm_server = ShmRingServer(
+                    lambda method, payload: _shm_dispatch(
+                        self.servicer, method, payload))
+                self.servicer.bind_shm(self._shm_server)
 
     def start(self, port: int = 0) -> int:
         bound = self._server.add_insecure_port(f"{self.host}:{port}")
@@ -375,6 +649,8 @@ class EmbeddingDataServer:
 
     def stop(self, grace: float = 0.5) -> None:
         self._server.stop(grace)
+        if self._shm_server is not None:
+            self._shm_server.stop()
 
     @property
     def address(self) -> Optional[str]:
@@ -392,6 +668,12 @@ class DataPlaneStub:
         self._methods = {}
         for name, (_req_t, resp_t) in _DATA_RPCS.items():
             self._methods[name] = channel.unary_unary(
+                f"/{DATA_SERVICE_NAME}/{name}",
+                request_serializer=lambda msg: msg.SerializeToString(),
+                response_deserializer=resp_t.FromString,
+            )
+        for name, (_req_t, resp_t) in _DATA_STREAM_RPCS.items():
+            self._methods[name] = channel.unary_stream(
                 f"/{DATA_SERVICE_NAME}/{name}",
                 request_serializer=lambda msg: msg.SerializeToString(),
                 response_deserializer=resp_t.FromString,
@@ -417,12 +699,16 @@ class GrpcTransport:
     accepts_deadline = True
 
     def __init__(self, addresses: Optional[Dict[int, str]] = None,
-                 default_timeout_s: float = 2.0):
+                 default_timeout_s: float = 2.0, shm: bool = True):
         self._lock = threading.Lock()
         self._addrs: Dict[int, str] = dict(addresses or {})  # guarded_by: _lock
         self._channels: Dict[int, Tuple[grpc.Channel, DataPlaneStub]] = {}  # guarded_by: _lock
         self._local: Dict[int, Any] = {}                     # guarded_by: _lock
         self._default_timeout_s = default_timeout_s
+        self._shm_enabled = bool(shm)
+        self._shm_rings: Dict[int, Any] = {}                 # guarded_by: _lock
+        self._shm_tried: Dict[int, str] = {}  # owner -> addr attempted; guarded_by: _lock
+        self._shm_negotiating: Dict[int, threading.Thread] = {}  # guarded_by: _lock
 
     # ---- registry / address book ---------------------------------- #
 
@@ -453,6 +739,7 @@ class GrpcTransport:
         response's). A changed address drops the cached channel — the
         old owner process is gone; its channel must not be trusted."""
         drop = []
+        rings = []
         with self._lock:
             for owner, addr in addresses.items():
                 owner = int(owner)
@@ -461,6 +748,14 @@ class GrpcTransport:
                     drop.append(owner)
             for owner in drop:
                 self._channels.pop(owner, None)
+                # the shm short-circuit never outlives the address that
+                # negotiated it: a moved/blackholed owner must not keep
+                # serving through a stale ring
+                ring = self._shm_rings.pop(owner, None)
+                if ring is not None:
+                    rings.append(ring)
+        for ring in rings:
+            ring.close()
 
     def address_of(self, owner: int) -> Optional[str]:
         with self._lock:
@@ -535,6 +830,113 @@ class GrpcTransport:
         except grpc.RpcError as e:
             raise self._map_error(e, owner, method) from e
 
+    # ---- same-host shared-memory short-circuit (ISSUE 18) ---------- #
+
+    def _shm_ring(self, owner: int, timeout_s: Optional[float]):
+        """The owner's attached ring, kicking off negotiation on first
+        use. Negotiation is attempted AT MOST ONCE per (owner,
+        address) — a declined/failed negotiate must not tax every
+        later call, and a partitioned owner must not pay a negotiate
+        round per pull on top of its gRPC deadline — and it runs in a
+        BACKGROUND thread: the negotiate RPC + segment attach cost
+        ~10ms on a loaded box, and the call that happened to arrive
+        first must not eat that on its latency; it rides the socket
+        while the ring comes up."""
+        if not self._shm_enabled:
+            return None
+        if not _shm.HAVE_SHM:
+            return None
+        with self._lock:
+            ring = self._shm_rings.get(owner)
+            if ring is not None:
+                return ring
+            addr = self._addrs.get(owner)
+            if addr is None or self._shm_tried.get(owner) == addr:
+                return None
+            self._shm_tried[owner] = addr
+        host = addr.rsplit(":", 1)[0]
+        if not _shm.same_host(host):
+            return None
+        t = threading.Thread(target=self._negotiate_ring, args=(owner,),
+                             name=f"edl-shm-negotiate-{owner}",
+                             daemon=True)
+        with self._lock:
+            self._shm_negotiating[owner] = t
+        t.start()
+        return None
+
+    def _negotiate_ring(self, owner: int) -> None:
+        """Background half of `_shm_ring`: one negotiate RPC, one
+        attach, publish the ring (or give up — the gRPC lane keeps
+        serving either way)."""
+        import socket
+
+        try:
+            try:
+                resp = self._call(
+                    "EmbeddingShmNegotiate", owner,
+                    pb.EmbeddingShmNegotiateRequest(
+                        client_host=socket.gethostname(),
+                        client_pid=os.getpid(),
+                        slot_bytes=_shm.DEFAULT_SLOT_BYTES),
+                    min(self._default_timeout_s, 1.0))
+            except OwnerUnavailableError:
+                _shm.SHM_FALLBACKS.inc(reason="negotiate")
+                return
+            if not resp.ok:
+                return
+            try:
+                ring = _shm.ShmRingClient(resp.segment,
+                                          int(resp.slot_bytes))
+            except _shm.ShmRingError as e:
+                logger.warning("shm attach to owner %d failed: %s",
+                               owner, e)
+                _shm.SHM_FALLBACKS.inc(reason="attach")
+                return
+            with self._lock:
+                # a concurrent negotiator may have won; keep the first
+                ring = self._shm_rings.setdefault(owner, ring)
+            logger.info("shm short-circuit to owner %d via %s", owner,
+                        resp.segment)
+        finally:
+            with self._lock:
+                self._shm_negotiating.pop(owner, None)
+
+    def _drop_ring(self, owner: int, reason: str) -> None:
+        with self._lock:
+            ring = self._shm_rings.pop(owner, None)
+        if ring is not None:
+            ring.close()
+            _shm.SHM_FALLBACKS.inc(reason=reason)
+            logger.warning(
+                "shm ring to owner %d dropped (%s); gRPC lane takes over",
+                owner, reason)
+
+    def _shm_call(self, owner: int, method_id: int, req_bytes: bytes,
+                  timeout_s: Optional[float]):
+        """One ring round-trip, or None when the shm lane is
+        unavailable (caller proceeds over gRPC). Ring failures drop
+        the ring — the segment is gone or the owner stopped serving
+        it; gRPC is the lane that still has liveness semantics."""
+        ring = self._shm_ring(owner, timeout_s)
+        if ring is None:
+            return None
+        try:
+            return ring.call(
+                method_id, req_bytes,
+                timeout_s=min(timeout_s or self._default_timeout_s, 1.0))
+        except _shm.ShmRingError:
+            self._drop_ring(owner, "gone")
+            return None
+
+    def _shm_status(self, owner: int, method: str, status: int,
+                    payload: bytes):
+        detail = payload.decode("utf-8", "replace")
+        if status == _shm.S_STALE:
+            raise StaleShardMapError(detail)
+        raise OwnerUnavailableError(
+            f"{method} to owner {owner} failed over shm: {detail}")
+
     # ---- the transport contract ----------------------------------- #
 
     def pull(self, owner: int, table: str, shard: int,
@@ -600,6 +1002,9 @@ class GrpcTransport:
 
     def fetch_shard(self, owner: int, table: str, shard: int,
                     timeout_s: Optional[float] = None) -> Dict[str, Any]:
+        """Shard migration copy — served over the streaming lane (one
+        call, chunked frames, fence in frame 0) and assembled back
+        into the unary payload shape every caller already expects."""
         faults.fire("emb.fetch_shard")
         with self._lock:
             local = self._local.get(owner)
@@ -607,18 +1012,38 @@ class GrpcTransport:
             payload = local.extract_shard(table, shard)
             faults.fire("emb.fetch_shard.recv")
             return payload
-        resp = self._call(
-            "EmbeddingFetchShard", owner,
-            pb.EmbeddingFetchShardRequest(table=table, shard=int(shard)),
-            timeout_s,
-        )
+        stub = self._stub(owner)
+        req = pb.EmbeddingFetchShardRequest(table=table, shard=int(shard))
+        buf = None
+        dim = 0
+        meta: Dict[str, Any] = {"applied": {}, "wm": 0}
+        try:
+            for frame in stub.EmbeddingFetchShardStream(
+                    req, timeout=(timeout_s if timeout_s is not None
+                                  else self._default_timeout_s)):
+                _STREAM_CHUNKS.inc(method="EmbeddingFetchShardStream.recv")
+                if buf is None:
+                    dim = int(frame.dim)
+                    buf = np.zeros((int(frame.rows_n), dim), np.float32)
+                    meta = {
+                        "applied": {
+                            str(k): int(v) for k, v in json.loads(
+                                frame.applied_json or "{}").items()},
+                        "wm": int(frame.wm),
+                    }
+                if frame.rows:
+                    blk = rows_from_bytes(frame.rows, dim)
+                    buf[frame.offset:frame.offset + blk.shape[0]] = blk
+        except grpc.RpcError as e:
+            raise self._map_error(e, owner, "EmbeddingFetchShardStream") \
+                from e
+        if buf is None:
+            raise OwnerUnavailableError(
+                f"fetch_shard {table}/{shard}: owner {owner} closed the "
+                "stream before the first frame")
         faults.fire("emb.fetch_shard.recv")
-        return {
-            "rows": rows_from_bytes(resp.rows, resp.dim),
-            "applied": {str(k): int(v)
-                        for k, v in json.loads(resp.applied_json).items()},
-            "wm": int(resp.wm),
-        }
+        return {"rows": buf, "applied": meta["applied"],
+                "wm": meta["wm"]}
 
     def shard_watermark(self, owner: int, table: str, shard: int,
                         replica: bool = False,
@@ -671,10 +1096,144 @@ class GrpcTransport:
             ],
         }
 
+    # ---- wire-speed lanes (ISSUE 18) ------------------------------- #
+
+    def pull_multi(self, owner: int, requests,
+                   map_version: Optional[int] = None,
+                   replica: bool = False,
+                   timeout_s: Optional[float] = None):
+        """Fused multi-(table, shard) pull — LocalTransport.pull_multi's
+        contract over one RPC (or one shm ring round-trip when the
+        owner is same-host). One request-side and one response-side
+        fault site per FUSED call: dropping it loses every sub-pull
+        together, exactly what one lost wire call does."""
+        faults.fire("emb.pull")
+        _COALESCED_TABLES.observe(float(len(requests)))
+        with self._lock:
+            local = self._local.get(owner)
+        if local is not None:
+            results = [
+                local.pull(t, s, ids, map_version=map_version,
+                           with_watermark=True, replica=replica)
+                for t, s, ids in requests
+            ]
+            owner_wms = {
+                key: local.shard_watermark(*key)
+                for key in local.resident_shards()
+            }
+            faults.fire("emb.pull.recv")
+            return results, owner_wms
+        req = pb.EmbeddingPullMultiRequest(
+            tables=[t for t, _, _ in requests],
+            shards=[int(s) for _, s, _ in requests],
+            counts=[int(np.asarray(ids).shape[0])
+                    for _, _, ids in requests],
+            ids=ids_to_bytes(
+                np.concatenate([
+                    np.asarray(ids, np.int32).reshape(-1)
+                    for _, _, ids in requests
+                ]) if requests else np.zeros((0,), np.int32)),
+            map_version=int(map_version or 0),
+            replica=bool(replica),
+        )
+        got = self._shm_call(owner, _shm.M_PULL_MULTI,
+                             req.SerializeToString(), timeout_s)
+        if got is not None:
+            status, payload = got
+            if status != _shm.S_OK:
+                self._shm_status(owner, "pull_multi", status, payload)
+            resp = pb.EmbeddingPullMultiResponse.FromString(payload)
+        else:
+            resp = self._call("EmbeddingPullMulti", owner, req, timeout_s)
+        faults.fire("emb.pull.recv")
+        return _decode_pull_multi(requests, resp)
+
+    def watermark_multi(self, owner: int, pairs, replica: bool = False,
+                        timeout_s: Optional[float] = None):
+        faults.fire("emb.watermark")
+        with self._lock:
+            local = self._local.get(owner)
+        if local is not None:
+            return [local.shard_watermark(t, s, replica=replica)
+                    for t, s in pairs]
+        req = pb.EmbeddingWatermarkMultiRequest(
+            tables=[t for t, _ in pairs],
+            shards=[int(s) for _, s in pairs],
+            replica=bool(replica),
+        )
+        got = self._shm_call(owner, _shm.M_WATERMARK_MULTI,
+                             req.SerializeToString(), timeout_s)
+        if got is not None:
+            status, payload = got
+            if status != _shm.S_OK:
+                self._shm_status(owner, "watermark_multi", status, payload)
+            resp = pb.EmbeddingWatermarkMultiResponse.FromString(payload)
+        else:
+            resp = self._call(
+                "EmbeddingWatermarkMulti", owner, req, timeout_s)
+        return [int(wm) for wm in resp.wms]
+
+    def fetch_delta_stream(self, owner: int, table: str, shard: int,
+                           since_wm: int, chunk_entries: int = 64,
+                           timeout_s: Optional[float] = None):
+        """Streaming replica sync (transport.py's reference framing
+        over a real server stream). A mid-stream transport failure
+        surfaces as OwnerUnavailableError from the generator — the
+        caller resumes from whatever watermark its applied prefix
+        reached."""
+        faults.fire("emb.fetch_delta")
+        with self._lock:
+            local = self._local.get(owner)
+        if local is not None:
+            from elasticdl_tpu.embedding.transport import _delta_frames
+
+            delta = local.fetch_delta(table, shard, since_wm)
+            faults.fire("emb.fetch_delta.recv")
+            return _delta_frames(delta, chunk_entries)
+        stub = self._stub(owner)
+        req = pb.EmbeddingFetchDeltaRequest(
+            table=table, shard=int(shard), since_wm=int(since_wm))
+
+        def gen():
+            try:
+                for frame in stub.EmbeddingFetchDeltaStream(
+                        req, timeout=(timeout_s if timeout_s is not None
+                                      else self._default_timeout_s)):
+                    _STREAM_CHUNKS.inc(
+                        method="EmbeddingFetchDeltaStream.recv")
+                    yield {
+                        "found": bool(frame.found),
+                        "wm": int(frame.wm),
+                        "entries": [
+                            {
+                                "wm": int(e.wm),
+                                "ids": ids_from_bytes(e.ids),
+                                "rows": rows_from_bytes(e.rows, e.dim),
+                                "scale": float(e.scale),
+                                "client_id": e.client_id,
+                                "seq": int(e.seq),
+                            }
+                            for e in frame.entries
+                        ],
+                        "last": bool(frame.last),
+                    }
+                    if not frame.found:
+                        return
+            except grpc.RpcError as e:
+                raise self._map_error(
+                    e, owner, "EmbeddingFetchDeltaStream") from e
+            faults.fire("emb.fetch_delta.recv")
+
+        return gen()
+
     def close(self) -> None:
         with self._lock:
             channels = [c for c, _ in self._channels.values()]
             self._channels.clear()
+            rings = list(self._shm_rings.values())
+            self._shm_rings.clear()
+        for ring in rings:
+            ring.close()
         for c in channels:
             try:
                 c.close()
@@ -700,6 +1259,8 @@ class CallPolicy:
 def default_policies(budget_s: float = 2.0) -> Dict[str, CallPolicy]:
     return {
         "pull": CallPolicy(budget_s=budget_s, max_attempts=3),
+        # one fused call IS one wire call: same budget shape as pull
+        "pull_multi": CallPolicy(budget_s=budget_s, max_attempts=3),
         "push": CallPolicy(budget_s=budget_s, max_attempts=3),
         # a shard copy is bulk data (recovery path, not the hot path)
         "fetch_shard": CallPolicy(budget_s=max(30.0, budget_s),
@@ -1179,21 +1740,31 @@ class ResilientTransport:
     def _pull_hedged(self, owner: int, reps: List[int], table: str,
                      shard: int, local_ids, map_version,
                      timeout_s: float):
+        return self._hedged_race(
+            owner,
+            lambda: self._pull_once(
+                owner, table, shard, local_ids, map_version, False,
+                timeout_s),
+            lambda: self._pull_replica_any(
+                reps, table, shard, local_ids, map_version, timeout_s),
+            f"hedged pull {table}/{shard}: primary {owner} and "
+            f"replicas {reps} all failed")
+
+    def _hedged_race(self, owner: int, primary_call, hedge_call,
+                     fail_msg: str):
         """Race the primary against a replica launched after the hedge
         delay; first credible answer wins, the loser is cancelled (or
         abandoned to its own deadline — gRPC has no mid-flight recall
-        for a blocking call) and counted."""
+        for a blocking call) and counted. `hedge_call` must return
+        None (not raise) on failure; both the unary and the fused pull
+        lanes race through here."""
         pool = self._hedge_pool()
-        primary = pool.submit(
-            self._pull_once, owner, table, shard, local_ids,
-            map_version, False, timeout_s)
+        primary = pool.submit(primary_call)
         done, _ = wait([primary], timeout=self.hedge_delay_s())
         if done:
             return primary.result()   # fast path: no hedge launched
         _HEDGED.inc()
-        hedge = pool.submit(
-            self._pull_replica_any, reps, table, shard, local_ids,
-            map_version, timeout_s)
+        hedge = pool.submit(hedge_call)
         pending = {primary, hedge}
         primary_err: Optional[BaseException] = None
         while pending:
@@ -1236,9 +1807,7 @@ class ResilientTransport:
         if isinstance(primary_err, StaleShardMapError):
             raise primary_err
         raise primary_err if primary_err is not None else (
-            OwnerUnavailableError(
-                f"hedged pull {table}/{shard}: primary {owner} and "
-                f"replicas {reps} all failed"))
+            OwnerUnavailableError(fail_msg))
 
     def _retry_simple(self, method: str, policy: CallPolicy,
                       t_end: float, owner: int, call,
@@ -1274,6 +1843,176 @@ class ResilientTransport:
                                     max(0.0, t_end - time.monotonic())))
         raise last if last is not None else DeadlineExceededError(
             f"{method} to owner {owner}: deadline budget spent")
+
+    # ---- fused pull (ISSUE 18): one budget/hedge/breaker round per
+    # fused call — the robustness machinery amortizes with the wire
+
+    def supports_pull_multi(self) -> bool:
+        return hasattr(self._inner, "pull_multi")
+
+    def pull_multi(self, owner: int, requests,
+                   map_version: Optional[int] = None,
+                   replica: bool = False):
+        """The fused LocalTransport.pull_multi contract with pull()'s
+        full degraded ladder. The whole fused call gets ONE deadline
+        budget, ONE hedge race, and ONE breaker verdict — n tables in
+        a step no longer mean n chances to trip the breaker."""
+        policy = self._policies["pull_multi"]
+        t_end = time.monotonic() + policy.budget_s
+        if replica:
+            return self._retry_simple(
+                "pull_multi", policy, t_end, owner,
+                lambda to: self._pull_multi_once(
+                    owner, requests, map_version, replica=True,
+                    timeout_s=to),
+                with_watermark=True)
+        last: Optional[BaseException] = None
+        for attempt in range(policy.max_attempts):
+            remaining = t_end - time.monotonic()
+            if remaining <= 0:
+                break
+            _RPC_CALLS.inc(method="pull_multi")
+            try:
+                return self._pull_multi_round(
+                    owner, requests, map_version, remaining,
+                    policy.max_attempts - attempt)
+            except StaleShardMapError:
+                raise
+            except self.RETRYABLE as e:
+                last = e
+                _RPC_FAILURES.inc(method="pull_multi")
+                if isinstance(e, DeadlineExceededError):
+                    _RPC_DEADLINE.inc(method="pull_multi")
+                if attempt + 1 < policy.max_attempts:
+                    _RPC_RETRIES.inc(method="pull_multi")
+                    self._sleep(min(self._backoff(attempt),
+                                    max(0.0, t_end - time.monotonic())))
+        DEGRADED_READS.inc(mode="blocked")
+        raise last if last is not None else DeadlineExceededError(
+            f"fused pull of {len(requests)} sub-pulls from owner "
+            f"{owner}: deadline budget ({policy.budget_s:.3f}s) spent")
+
+    def _pull_multi_once(self, owner: int, requests, map_version,
+                         replica: bool, timeout_s: Optional[float]):
+        t0 = time.perf_counter()
+        try:
+            results, owner_wms = self._inner.pull_multi(
+                owner, requests, map_version=map_version,
+                replica=replica, **self._kw(timeout_s))
+        except StaleShardMapError:
+            self._note_success(owner)
+            raise
+        except self.RETRYABLE:
+            self._note_failure(owner)
+            raise
+        self._note_success(owner)
+        dt = time.perf_counter() - t0
+        _RPC_LATENCY.observe(dt, method="pull_multi")
+        if not replica:
+            with self._lock:
+                # ONE reservoir sample per FUSED call: the hedge delay
+                # is p99-of-calls, and a fused call is one call — per
+                # sub-table samples would multiply the window's weight
+                # by the fan-in and self-inflate the derived delay as
+                # coalescing grows
+                self._pull_lat.append(dt)
+            for (table, shard, _ids), (_rows, wm) in zip(requests,
+                                                         results):
+                self._note_wm(table, int(shard), int(wm))
+        # the piggybacked watermarks are the OWNER'S primary set —
+        # authoritative regardless of which namespace served this call
+        for (table, shard), wm in owner_wms.items():
+            self._note_wm(table, int(shard), int(wm))
+        self._maybe_drain(owner)
+        return results, owner_wms
+
+    def _common_replicas(self, requests, exclude: int) -> List[int]:
+        """Owners holding replicas of EVERY shard in the fused request
+        — the only peers a fused call can hedge to wholesale."""
+        common: Optional[set] = None
+        for _t, shard, _ids in requests:
+            reps = set(self._replicas_of(int(shard), exclude=exclude))
+            common = reps if common is None else (common & reps)
+            if not common:
+                return []
+        return sorted(common or ())
+
+    def _pull_multi_replica_any(self, reps: List[int], requests,
+                                map_version, timeout_s: float):
+        """First replica owner whose fused answer is credible on EVERY
+        sub-pull, or None. One stale sub-shard poisons the whole fused
+        answer — partial acceptance would hand the tier a mix of fresh
+        and beyond-bound rows under one watermark story."""
+        for _ in range(2):
+            for rep in reps:
+                try:
+                    results, owner_wms = self._pull_multi_once(
+                        rep, requests, map_version, replica=True,
+                        timeout_s=timeout_s)
+                except (StaleShardMapError, *self.RETRYABLE):
+                    continue
+                credible = all(
+                    wm + self.staleness_bound >= self.observed_wm(
+                        table, int(shard))
+                    for (table, shard, _ids), (_rows, wm)
+                    in zip(requests, results)
+                )
+                if credible:
+                    return results, owner_wms
+        return None
+
+    def _pull_multi_round(self, owner: int, requests, map_version,
+                          remaining_s: float, attempts_left: int):
+        breaker = self._breaker(owner)
+        reps = self._common_replicas(requests, exclude=owner)
+        attempt_timeout = remaining_s / max(1, attempts_left)
+        if not breaker.allow():
+            got = self._pull_multi_replica_any(
+                reps, requests, map_version, attempt_timeout)
+            if got is not None:
+                DEGRADED_READS.inc(mode="replica")
+                return got
+            raise OwnerUnavailableError(
+                f"owner {owner} breaker open and no credible replica "
+                f"for fused pull of {len(requests)} sub-pulls")
+        if not (self._hedge_enabled and reps):
+            return self._pull_multi_once(
+                owner, requests, map_version, replica=False,
+                timeout_s=attempt_timeout)
+        return self._hedged_race(
+            owner,
+            lambda: self._pull_multi_once(
+                owner, requests, map_version, replica=False,
+                timeout_s=attempt_timeout),
+            lambda: self._pull_multi_replica_any(
+                reps, requests, map_version, attempt_timeout),
+            f"fused pull of {len(requests)} sub-pulls: primary "
+            f"{owner} and replicas {reps} all failed")
+
+    def watermark_multi(self, owner: int, pairs,
+                        replica: bool = False) -> List[int]:
+        """Batched freshness probe with shard_watermark()'s budget and
+        breaker handling — one call per owner instead of one per
+        (table, shard)."""
+        policy = self._policies["watermark"]
+        t_end = time.monotonic() + policy.budget_s
+
+        def call(to):
+            try:
+                wms = self._inner.watermark_multi(
+                    owner, pairs, replica=replica, **self._kw(to))
+            except self.RETRYABLE:
+                self._note_failure(owner)
+                raise
+            self._note_success(owner)
+            return wms, 0
+
+        wms, _ = self._retry_simple(
+            "watermark", policy, t_end, owner, call)
+        if not replica:
+            for (table, shard), wm in zip(pairs, wms):
+                self._note_wm(table, int(shard), int(wm))
+        return [int(w) for w in wms]
 
     # ---- push: deadline budget + queue-behind-the-breaker ---------- #
 
@@ -1549,7 +2288,7 @@ def run_owner(spec: Dict[str, Any], stop: Optional[threading.Event] = None):
     view = _runner_view(spec)
     store = EmbeddingShardStore(owner, device=bool(spec.get("device")))
     store.attach(view)
-    server = EmbeddingDataServer(store)
+    server = EmbeddingDataServer(store, shm=bool(spec.get("shm", True)))
     port = server.start(int(spec.get("port", 0)))
     port_file = spec.get("port_file")
     if port_file:

@@ -652,9 +652,34 @@ class EmbeddingShardStore:
         except StaleShardMapError:
             since = -1
         if since >= 0:
-            delta = transport.fetch_delta(primary, table, shard, since)
-            if delta is not None:
-                return self.apply_replica_delta(table, shard, delta)
+            if hasattr(transport, "fetch_delta_stream"):
+                # streaming lane (ISSUE 18): apply chunk by chunk so a
+                # mid-stream drop leaves the replica consistently at
+                # whatever watermark the applied prefix reached — the
+                # next round resumes from there, and any re-sent
+                # entries fall to apply_replica_delta's idempotent
+                # watermark fence (no double-apply)
+                found = True
+                wm = since
+                for frame in transport.fetch_delta_stream(
+                        primary, table, shard, since):
+                    if not frame.get("found", True):
+                        found = False
+                        break
+                    if frame["entries"]:
+                        wm = self.apply_replica_delta(
+                            table, shard,
+                            {"wm": frame["wm"],
+                             "entries": frame["entries"]})
+                    else:
+                        wm = max(wm, int(frame.get("wm", wm)))
+                if found:
+                    return wm
+            else:
+                delta = transport.fetch_delta(
+                    primary, table, shard, since)
+                if delta is not None:
+                    return self.apply_replica_delta(table, shard, delta)
             _REPLICA_RESYNCS.inc()
         payload = transport.fetch_shard(primary, table, shard)
         self.install_replica(table, shard, payload)

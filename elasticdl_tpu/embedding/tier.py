@@ -197,6 +197,25 @@ def _dedupe_sum(ids: np.ndarray, rows: np.ndarray):
     return sids[starts], np.add.reduceat(rows[order], starts, axis=0)
 
 
+class _MultiSub:
+    """One (table, shard) sub-pull of a fused multi-table round (ISSUE
+    18): the per-shard padded request plus where its rows scatter back
+    (`sel` indexes the table's miss stream)."""
+
+    __slots__ = ("table", "shard", "sel", "n", "padded", "target",
+                 "is_replica")
+
+    def __init__(self, table: str, shard: int, sel: np.ndarray, n: int,
+                 padded: np.ndarray, target: int, is_replica: bool):
+        self.table = table
+        self.shard = shard
+        self.sel = sel
+        self.n = n
+        self.padded = padded
+        self.target = target
+        self.is_replica = is_replica
+
+
 class EmbeddingTierClient:
     """Per-worker handle on the tier: a shard-map view + a transport.
 
@@ -224,6 +243,7 @@ class EmbeddingTierClient:
         self._map_fetch = map_fetch
         self._transport = transport
         self._wm_replica_ok: Optional[bool] = None  # lazy capability probe
+        self._pull_multi_ok: Optional[bool] = None  # lazy capability probe
         # incarnation-scoped identity: the stores' seq watermarks OUTLIVE
         # this client (they ride drain checkpoints and shard migrations),
         # so a relaunched worker reusing a bare worker-id client_id would
@@ -544,25 +564,81 @@ class EmbeddingTierClient:
         if n < self.wm_probe_every:
             return
         for shard in range(view.num_shards):
-            wm = None
+            wm = self._probe_shard_wm(table, shard, view)
+            if wm is not None:
+                self._note_wm(table, view.num_shards, shard, int(wm))
+
+    def _probe_shard_wm(self, table: str, shard: int,
+                        view) -> Optional[int]:
+        """One shard's bare freshness probe with the partition ladder
+        (ISSUE 15): the primary first; on failure, any replica's
+        watermark (a lower bound on the primary's). None when every
+        rung failed — best-effort, the fence keeps its last bound."""
+        try:
+            return self._transport.shard_watermark(
+                view.owner_of(shard), table, shard)
+        except (StaleShardMapError, OwnerUnavailableError,
+                faults.FaultInjected):
+            if not self._wm_probe_accepts_replica():
+                return None
+            for rep in view.replicas_of(shard):
+                if rep == view.owner_of(shard):
+                    continue
+                try:
+                    return self._transport.shard_watermark(
+                        rep, table, shard, replica=True)
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected):
+                    continue
+            return None
+
+    def _maybe_probe_watermarks_multi(self, tables: List[str],
+                                      view) -> None:
+        """The fused probe cadence (ISSUE 18): tables served entirely
+        from cache advance the same per-table counter as the unary
+        path, and the ones that come due probe TOGETHER — one
+        `watermark_multi` call per owner covering every due table's
+        shards, instead of tables x shards bare probes. An owner whose
+        fused probe fails falls back to the unary ladder (primary,
+        then replicas) per shard. In a steady-state training loop this
+        rarely fires at all: any fused pull's piggybacked owner
+        watermarks reset the counters first."""
+        due = []
+        with self._lock:
+            for table in tables:
+                n = self._full_hits.get(table, 0) + 1
+                self._full_hits[table] = (
+                    0 if n >= self.wm_probe_every else n)
+                if n >= self.wm_probe_every:
+                    due.append(table)
+        if not due:
+            return
+        wmm = getattr(self._transport, "watermark_multi", None)
+        if wmm is None:
+            for table in due:
+                for shard in range(view.num_shards):
+                    wm = self._probe_shard_wm(table, shard, view)
+                    if wm is not None:
+                        self._note_wm(
+                            table, view.num_shards, shard, int(wm))
+            return
+        by_owner: Dict[int, list] = {}
+        for shard in range(view.num_shards):
+            owner = view.owner_of(shard)
+            for table in due:
+                by_owner.setdefault(owner, []).append((table, shard))
+        for owner, pairs in sorted(by_owner.items()):
             try:
-                wm = self._transport.shard_watermark(
-                    view.owner_of(shard), table, shard)
+                wms = wmm(owner, pairs)
             except (StaleShardMapError, OwnerUnavailableError,
                     faults.FaultInjected):
-                if not self._wm_probe_accepts_replica():
-                    continue
-                for rep in view.replicas_of(shard):
-                    if rep == view.owner_of(shard):
-                        continue
-                    try:
-                        wm = self._transport.shard_watermark(
-                            rep, table, shard, replica=True)
-                        break
-                    except (StaleShardMapError, OwnerUnavailableError,
-                            faults.FaultInjected):
-                        continue
-            if wm is not None:
+                for table, shard in pairs:
+                    wm = self._probe_shard_wm(table, shard, view)
+                    if wm is not None:
+                        self._note_wm(
+                            table, view.num_shards, shard, int(wm))
+                continue
+            for (table, shard), wm in zip(pairs, wms):
                 self._note_wm(table, view.num_shards, shard, int(wm))
 
     def _pull_owner(self, table: str, spec,
@@ -573,6 +649,12 @@ class EmbeddingTierClient:
         ``(rows, per_id_watermarks)``. The wall across ALL rounds lands
         in the owner-RPC latency window — an outage pull records the
         outage, which is exactly what the pull-p99 alert needs to see."""
+        if self._supports_pull_multi():
+            # fused lane (ISSUE 18): even a single table's misses
+            # coalesce across shards into ONE call per owner — under a
+            # per-call-dominated wire the per-shard loop was most of
+            # the pull (4 owned shards = 4x the per-call tax)
+            return self._pull_owner_multi({table: uniq})[table]
         t0 = time.perf_counter()
         try:
             for attempt in range(self._max_retries + 1):
@@ -733,6 +815,294 @@ class EmbeddingTierClient:
         # shard-imbalance alert reads it (mid-resharding)
         self._note_shard_loads(shards, view.num_shards)
         return out, wms
+
+    # -------------------------------------------------------------- #
+    # fused multi-table pull (ISSUE 18)
+
+    def _supports_pull_multi(self) -> bool:
+        """Whether the transport offers the fused `pull_multi` lane.
+        Wrappers with a `__getattr__` passthrough (ResilientTransport)
+        make a plain hasattr() true even when their INNER transport
+        lacks the method, so they export `supports_pull_multi()` and
+        that answer wins. Decided once."""
+        ok = self._pull_multi_ok
+        if ok is None:
+            probe = getattr(self._transport, "supports_pull_multi", None)
+            if callable(probe):
+                ok = bool(probe())
+            else:
+                ok = hasattr(self._transport, "pull_multi")
+            self._pull_multi_ok = ok
+        return ok
+
+    def pull_unique_multi(
+        self, table_ids: Dict[str, np.ndarray],
+    ) -> Dict[str, Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+        """Fused multi-table lookup (ISSUE 18): `pull_unique` semantics
+        for every table in ``table_ids`` — same dedupe, sentinel
+        rotation, cache and staleness fences — but all tables' misses
+        travel in ONE `pull_multi` call per read target instead of one
+        call per (table, shard). Under a per-call-dominated wire (the
+        measured loopback truth) this is where the per-call gap closes;
+        the response's piggybacked owner watermarks refresh EVERY
+        table's freshness fence, so a steady-state training loop stops
+        paying watermark probe calls entirely. Returns ``{table:
+        (unique_rows, inverse, unique_ids)}`` exactly as per-table
+        `pull_unique` would. Transports without the fused lane fall
+        back to per-table calls — same results, per-table wire cost."""
+        if not self._supports_pull_multi():
+            return {
+                table: self.pull_unique(table, ids)
+                for table, ids in table_ids.items()
+            }
+        t0 = time.perf_counter()
+        states: Dict[str, Dict[str, Any]] = {}
+        for table, ids in table_ids.items():
+            spec = self.table(table)
+            flat = np.asarray(ids).reshape(-1).astype(np.int64)
+            valid = (flat >= 0) & (flat < spec.vocab)
+            _PULL_IDS.inc(int(flat.shape[0]))
+            uniq, inverse, id_counts = np.unique(
+                np.where(valid, flat, np.int64(-1)),
+                return_inverse=True, return_counts=True)
+            has_pad = bool(uniq.shape[0]) and uniq[0] < 0
+            if has_pad:
+                # sentinel slot rotated to the END, as in pull_unique:
+                # slot U-1 is the reserved zero row
+                uniq = np.concatenate([uniq[1:], uniq[:1]])
+                inverse = np.where(
+                    inverse == 0, uniq.shape[0] - 1, inverse - 1)
+                id_counts = np.concatenate([id_counts[1:], id_counts[:1]])
+            _PULL_UNIQUE.inc(int(uniq.shape[0]) - int(has_pad))
+            real = uniq.shape[0] - int(has_pad)
+            if real and self._sketch_due():
+                self.sketch.update_batch(uniq[:real], id_counts[:real])
+            states[table] = {
+                "spec": spec, "uniq": uniq, "counts": id_counts,
+                "real": real, "miss_mask": None,
+                "rows": np.zeros((uniq.shape[0], spec.dim), np.float32),
+                "inverse": inverse.reshape(np.asarray(ids).shape),
+            }
+        view = self.view
+        misses: Dict[str, np.ndarray] = {}
+        full_hit: List[str] = []
+        for table, st in states.items():
+            real = st["real"]
+            if not real:
+                continue
+            uniq_r = st["uniq"][:real]
+            if self.cache is None:
+                misses[table] = uniq_r
+                continue
+            counts_r = st["counts"][:real]
+            with self._lock:
+                owner_arr = self._owner_wm_locked(
+                    table, view.num_shards).copy()
+            hit_mask, hit_rows = self.cache.lookup(
+                table, st["spec"].vocab, st["spec"].dim, uniq_r,
+                owner_arr, view.num_shards, counts_r)
+            if hit_rows is not None:
+                st["rows"][:real][hit_mask] = hit_rows
+                self._attribute_degraded_hits(
+                    view, uniq_r, hit_mask, counts_r)
+            miss = ~hit_mask
+            if miss.any():
+                misses[table] = uniq_r[miss]
+                st["miss_mask"] = miss
+            else:
+                full_hit.append(table)
+        if misses:
+            served = self._pull_owner_multi(misses)
+            for table, (rows_m, wms_m) in served.items():
+                st = states[table]
+                miss = st["miss_mask"]
+                if miss is None:
+                    st["rows"][:st["real"]] = rows_m
+                else:
+                    st["rows"][:st["real"]][miss] = rows_m
+                if self.cache is not None:
+                    self.cache.insert(
+                        table, st["spec"].vocab, st["spec"].dim,
+                        misses[table], rows_m, wms_m)
+                    with self._lock:
+                        self._full_hits[table] = 0
+        if full_hit:
+            # fully-cache-served tables keep the probe cadence honest;
+            # a fused pull's piggyback just reset their counters, so
+            # the residual probe only fires for a client whose batches
+            # stopped missing entirely
+            self._maybe_probe_watermarks_multi(full_hit, view)
+        dt = time.perf_counter() - t0
+        _PULL_S.observe(dt)
+        _goodput_pull(dt)
+        self._note_read_time(dt)
+        return {
+            table: (st["rows"], st["inverse"], st["uniq"])
+            for table, st in states.items()
+        }
+
+    def _pull_owner_multi(
+        self, misses: Dict[str, np.ndarray],
+    ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """The fused analogue of `_pull_owner`: one `pull_multi` call
+        per read target covering EVERY table's misses on it, retried
+        whole against a refreshed map on stale/dead-owner errors (reads
+        are idempotent). Returns ``{table: (rows, per_id_watermarks)}``
+        parallel to each table's miss stream; the wall across ALL
+        rounds lands in the owner-RPC latency window."""
+        t0 = time.perf_counter()
+        try:
+            for attempt in range(self._max_retries + 1):
+                view = self.view
+                try:
+                    return self._pull_once_multi(view, misses)
+                except (StaleShardMapError, OwnerUnavailableError,
+                        faults.FaultInjected) as e:
+                    self._note_retry("pull", attempt, e)
+            raise OwnerUnavailableError(
+                f"fused embedding pull over {sorted(misses)} failed "
+                f"after {self._max_retries} retries"
+            )
+        finally:
+            with self._lock:
+                self._pull_times.append(time.perf_counter() - t0)
+
+    def _pull_once_multi(
+        self, view, misses: Dict[str, np.ndarray],
+    ) -> Dict[str, Tuple[np.ndarray, np.ndarray]]:
+        """One fused serving round: per-shard sub-pulls built exactly
+        as `_pull_once` would (padded, least-loaded read target), then
+        grouped by (target, replica) so each owner serves ONE
+        `pull_multi` covering every table that misses on it. Replica
+        groups go first; a sub whose replica failed OR answered past
+        the staleness bound falls back to its primary's group within
+        the SAME attempt. Each response's piggybacked owner watermarks
+        advance the freshness fence for every resident shard — the
+        probe traffic this kills is the point of the piggyback."""
+        out: Dict[str, Tuple[np.ndarray, np.ndarray]] = {}
+        shard_arrays: Dict[str, np.ndarray] = {}
+        subs: List[_MultiSub] = []
+        for table, miss in misses.items():
+            dim = self.table(table).dim
+            out[table] = (np.empty((miss.shape[0], dim), np.float32),
+                          np.zeros(miss.shape[0], np.int64))
+            shards = sharding.shard_of(miss, view.num_shards)
+            local = sharding.local_rows(miss, view.num_shards)
+            shard_arrays[table] = shards
+            for shard in np.unique(shards):
+                sel = shards == shard
+                ids_s = local[sel].astype(np.int32)
+                _SHARD_CALLS.observe(float(ids_s.shape[0]))
+                n = pad_pow2(ids_s.shape[0])
+                padded = np.full((n,), -1, np.int32)
+                padded[: ids_s.shape[0]] = ids_s
+                target, is_rep = self._pick_read_target(view, int(shard))
+                subs.append(_MultiSub(
+                    table, int(shard), sel, int(ids_s.shape[0]),
+                    padded, target, is_rep))
+        groups: Dict[Tuple[int, bool], List[_MultiSub]] = {}
+        for sub in subs:
+            groups.setdefault((sub.target, sub.is_replica),
+                              []).append(sub)
+        known_tables = {t.name for t in view.tables}
+        errs: List[Exception] = []
+        fallback: List[_MultiSub] = []
+        box_lock = threading.Lock()
+
+        def note_piggyback(owner_wms) -> None:
+            # every resident primary on the serving store rode back;
+            # advancing their fences here is what lets steady-state
+            # freshness probes stop being calls (monotonic _note_wm —
+            # a replica's own primaries are authoritative too)
+            refreshed = set()
+            for (t, s), wm in owner_wms.items():
+                if t in known_tables and int(s) < view.num_shards:
+                    self._note_wm(t, view.num_shards, int(s), int(wm))
+                    refreshed.add(t)
+            if refreshed:
+                with self._lock:
+                    for t in refreshed:
+                        self._full_hits[t] = 0
+
+        def accept(sub: _MultiSub, rows, wm, target: int) -> None:
+            rows_t, wms_t = out[sub.table]
+            rows_t[sub.sel] = rows[: sub.n]
+            wms_t[sub.sel] = int(wm)
+            self._note_wm(sub.table, view.num_shards, sub.shard, int(wm))
+            self._note_target_load(target, sub.n)
+
+        def serve_replica(target: int, group: List[_MultiSub]) -> None:
+            with self._lock:
+                known = {
+                    sub: int(self._owner_wm_locked(
+                        sub.table, view.num_shards)[sub.shard])
+                    for sub in group
+                }
+            try:
+                results, owner_wms = self._transport.pull_multi(
+                    target,
+                    [(s.table, s.shard, s.padded) for s in group],
+                    map_version=view.version, replica=True)
+            except (StaleShardMapError, OwnerUnavailableError,
+                    faults.FaultInjected):
+                # replica miss/death is never an error round: the
+                # primary serves these subs within the SAME attempt
+                with box_lock:
+                    fallback.extend(group)
+                return
+            note_piggyback(owner_wms)
+            for sub, (rows, wm) in zip(group, results):
+                if wm + self.staleness_bound < known[sub]:
+                    # further behind the owner than the bound allows —
+                    # the primary serves; the lagging answer is
+                    # discarded (never cached)
+                    _REPLICA_STALE.inc()
+                    with box_lock:
+                        fallback.append(sub)
+                else:
+                    # bounded by the shard map's num_shards:
+                    # edl-lint: disable=EDL405
+                    _REPLICA_READS.inc(shard=str(sub.shard))
+                    accept(sub, rows, wm, target)
+
+        def serve_primary(owner: int, group: List[_MultiSub]) -> None:
+            try:
+                results, owner_wms = self._transport.pull_multi(
+                    owner,
+                    [(s.table, s.shard, s.padded) for s in group],
+                    map_version=view.version)
+            except (StaleShardMapError, OwnerUnavailableError,
+                    faults.FaultInjected) as e:
+                with box_lock:
+                    errs.append(e)
+                return
+            note_piggyback(owner_wms)
+            for sub, (rows, wm) in zip(group, results):
+                accept(sub, rows, wm, owner)
+
+        rep_groups = [(t, g) for (t, r), g in groups.items() if r]
+        if rep_groups:
+            self._fanout([
+                (lambda tg=tg: serve_replica(*tg)) for tg in rep_groups
+            ])
+        primary: Dict[int, List[_MultiSub]] = {}
+        for (t, r), g in groups.items():
+            if not r:
+                primary.setdefault(t, []).extend(g)
+        for sub in fallback:
+            primary.setdefault(view.owner_of(sub.shard), []).append(sub)
+        if primary:
+            self._fanout([
+                (lambda og=og: serve_primary(*og))
+                for og in sorted(primary.items())
+            ])
+        if errs:
+            raise errs[0]
+        # load accounting only for the round that SERVED (see
+        # _pull_once: a retried round must not double-count)
+        for table, shards in shard_arrays.items():
+            self._note_shard_loads(shards, view.num_shards)
+        return out
 
     # -------------------------------------------------------------- #
     # skew telemetry (ISSUE 11)
@@ -1420,10 +1790,16 @@ class EmbeddingTierSession:
         self._pipes: Dict[str, EmbeddingPullPipeline] = {}
 
     def pull_batch(self, batch: Dict[str, Any]) -> Dict[str, np.ndarray]:
-        return {
-            name: self.client.pull(name, np.asarray(batch[key]))
+        """Full (expanded) vectors for every table, one FUSED pull per
+        owner across tables (ISSUE 18). The unique-row gather happens
+        here client-side; invalid/padding ids land on the reserved
+        zero row, matching `pull` semantics."""
+        pulled = self.client.pull_unique_multi({
+            name: np.asarray(batch[key])
             for name, key in self.tables.items()
-        }
+        })
+        return {name: rows[inverse]
+                for name, (rows, inverse, _uniq) in pulled.items()}
 
     def _pipe(self, name: str) -> EmbeddingPullPipeline:
         p = self._pipes.get(name)
@@ -1519,9 +1895,15 @@ class EmbeddingTierSession:
         vectors: Dict[str, Any] = {}
         inverses: Dict[str, Any] = {}
         uniq_ids: Dict[str, Any] = {}
-        for name, key in self.tables.items():
-            rows, inverse, uniq = self.client.pull_unique(
-                name, np.asarray(batch[key]))
+        # ONE fused pull per owner across every table (ISSUE 18) —
+        # under a per-call-dominated wire the per-table loop was the
+        # step's dominant cost; transports without the fused lane
+        # degrade to per-table calls inside pull_unique_multi
+        pulled = self.client.pull_unique_multi({
+            name: np.asarray(batch[key])
+            for name, key in self.tables.items()
+        })
+        for name, (rows, inverse, uniq) in pulled.items():
             vectors[name], inverses[name], uniq_ids[name] = (
                 rows, inverse, uniq)
         return self._finish_step(

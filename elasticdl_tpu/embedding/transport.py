@@ -148,6 +148,81 @@ class LocalTransport:
         faults.fire("emb.fetch_delta.recv")
         return delta
 
+    # -------------------------------------------------------------- #
+    # wire-speed lanes (ISSUE 18) — optional contract extensions a
+    # client feature-detects with hasattr; the unary methods above stay
+    # the floor every transport must provide
+
+    def pull_multi(self, owner: int, requests,
+                   map_version: Optional[int] = None,
+                   replica: bool = False):
+        """One fused call serving every (table, shard, local_ids)
+        sub-pull in ``requests`` against one owner. Returns
+        ``(results, owner_wms)``: ``results`` is a list of ``(rows,
+        wm)`` parallel to ``requests``; ``owner_wms`` maps EVERY
+        resident primary ``(table, shard)`` on the owner to its push
+        watermark — the piggyback that keeps steady-state freshness
+        probes off the wire. One request-side and one response-side
+        fault site fire per FUSED call (the wire sees one call), so a
+        chaos drop loses every sub-pull together, exactly like the
+        real fused RPC."""
+        faults.fire("emb.pull")
+        store = self.store_of(owner)
+        results = []
+        for table, shard, local_ids in requests:
+            results.append(store.pull(
+                table, shard, local_ids, map_version=map_version,
+                with_watermark=True, replica=replica))
+        owner_wms = {
+            key: store.shard_watermark(*key)
+            for key in store.resident_shards()
+        }
+        faults.fire("emb.pull.recv")
+        return results, owner_wms
+
+    def watermark_multi(self, owner: int, pairs,
+                        replica: bool = False):
+        """Batched freshness probe: one call returns the watermark of
+        every ``(table, shard)`` in ``pairs`` (parallel list) — the
+        residual probe lane for clients so fully cache-served that no
+        pull piggyback refreshes them."""
+        faults.fire("emb.watermark")
+        store = self.store_of(owner)
+        return [store.shard_watermark(t, s, replica=replica)
+                for t, s in pairs]
+
+    def fetch_delta_stream(self, owner: int, table: str, shard: int,
+                           since_wm: int, chunk_entries: int = 64):
+        """Streaming replica sync: yields delta CHUNKS (each a
+        ``{"found", "wm", "entries", "last"}`` frame, fence fields in
+        the first) so the replica applies incrementally and a
+        mid-stream drop resumes from wherever the applied watermark
+        got to — re-sent entries fall to the idempotent wm fence."""
+        faults.fire("emb.fetch_delta")
+        delta = self.store_of(owner).fetch_delta(table, shard, since_wm)
+        faults.fire("emb.fetch_delta.recv")
+        return _delta_frames(delta, chunk_entries)
+
+
+def _delta_frames(delta: Optional[Dict[str, Any]],
+                  chunk_entries: int):
+    """Chunk one fetch_delta payload into stream frames (the reference
+    framing GrpcTransport's server stream mirrors on the real wire)."""
+    if delta is None:
+        yield {"found": False, "wm": 0, "entries": [], "last": True}
+        return
+    entries = delta["entries"]
+    wm = delta["wm"]
+    if not entries:
+        yield {"found": True, "wm": wm, "entries": [], "last": True}
+        return
+    for off in range(0, len(entries), chunk_entries):
+        batch = entries[off:off + chunk_entries]
+        yield {
+            "found": True, "wm": wm, "entries": batch,
+            "last": off + chunk_entries >= len(entries),
+        }
+
 
 class SimWireTransport:
     """Any transport behind a deterministic simulated wire: every
@@ -203,3 +278,28 @@ class SimWireTransport:
             self._wire(sum(int(e["ids"].shape[0])
                            for e in delta["entries"]))
         return delta
+
+    # wire-speed lanes (ISSUE 18): ONE per-call cost per fused call —
+    # the whole point of coalescing under a per-call-dominated wire
+
+    def pull_multi(self, owner, requests, **kw):
+        self._wire(sum(int((ids >= 0).sum())
+                       for _, _, ids in requests))
+        return self._inner.pull_multi(owner, requests, **kw)
+
+    def watermark_multi(self, owner, pairs, replica=False):
+        self._wire(0)
+        return self._inner.watermark_multi(owner, pairs, replica=replica)
+
+    def fetch_delta_stream(self, owner, table, shard, since_wm,
+                           chunk_entries: int = 64):
+        # one per-call cost up front (one streaming call), then the
+        # per-row cost lands frame by frame as chunks are consumed
+        self._wire(0)
+        for frame in self._inner.fetch_delta_stream(
+                owner, table, shard, since_wm,
+                chunk_entries=chunk_entries):
+            if self._row_s and frame["entries"]:
+                time.sleep(self._row_s * sum(
+                    int(e["ids"].shape[0]) for e in frame["entries"]))
+            yield frame

@@ -276,6 +276,77 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
     changed += _new_msg("EmbeddingWatermarkResponse", [
         ("wm", 1, "int64", {}),
     ])
+
+    # Wire-speed data plane (ISSUE 18). One fused request carries every
+    # (table, shard) sub-pull a step routes to one owner: ids travel as
+    # ONE flat int32 blob segmented by `counts`, rows come back as ONE
+    # flat float32 blob segmented by counts x dims — both decoded as
+    # numpy frombuffer views, no per-table pack/unpack. The response
+    # piggybacks the owner's FULL primary watermark set (wm_tables /
+    # wm_shards / wm_values triples) so steady-state freshness probes
+    # stop being calls at all.
+    changed += _new_msg("EmbeddingPullMultiRequest", [
+        ("tables", 1, "string", {"repeated": True}),
+        ("shards", 2, "int32", {"repeated": True}),
+        ("counts", 3, "int32", {"repeated": True}),
+        ("ids", 4, "bytes", {}),          # flat int32 LE, all sub-pulls
+        ("map_version", 5, "int32", {}),
+        ("replica", 6, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingPullMultiResponse", [
+        ("rows", 1, "bytes", {}),         # flat float32 LE, all sub-pulls
+        ("dims", 2, "int32", {"repeated": True}),
+        ("wms", 3, "int64", {"repeated": True}),
+        ("wm_tables", 4, "string", {"repeated": True}),
+        ("wm_shards", 5, "int32", {"repeated": True}),
+        ("wm_values", 6, "int64", {"repeated": True}),
+    ])
+    changed += _new_msg("EmbeddingWatermarkMultiRequest", [
+        ("tables", 1, "string", {"repeated": True}),
+        ("shards", 2, "int32", {"repeated": True}),
+        ("replica", 3, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingWatermarkMultiResponse", [
+        ("wms", 1, "int64", {"repeated": True}),
+    ])
+    # Streaming replica sync / shard migration: server-streamed chunks
+    # under ONE call instead of unary call-per-chunk. The seq fence
+    # (applied_json + wm for a shard copy, the target watermark for a
+    # delta) travels in the FIRST frame; `last` closes the stream so a
+    # mid-stream drop is distinguishable from completion.
+    changed += _new_msg("EmbeddingShardChunk", [
+        ("rows", 1, "bytes", {}),         # this frame's row slab
+        ("offset", 2, "int32", {}),       # first row index of the slab
+        ("rows_n", 3, "int32", {}),       # total rows (first frame)
+        ("dim", 4, "int32", {}),          # first frame
+        ("applied_json", 5, "string", {}),  # seq fence (first frame)
+        ("wm", 6, "int64", {}),           # first frame
+        ("last", 7, "bool", {}),
+    ])
+    changed += _new_msg("EmbeddingDeltaChunk", [
+        ("found", 1, "bool", {}),         # first frame
+        ("wm", 2, "int64", {}),           # target watermark (first frame)
+        ("entries", 3, "", {
+            "repeated": True,
+            "type_name": ".elasticdl_tpu.EmbeddingDeltaEntry",
+        }),
+        ("last", 4, "bool", {}),
+    ])
+    # Same-host shared-memory short-circuit: the client asks the owner
+    # to create a dedicated SPSC ring segment for this (client, owner)
+    # pair; the owner answers with the segment name to attach. Any
+    # failure (no shm on the box, segment gone, payload too big) falls
+    # back to gRPC transparently.
+    changed += _new_msg("EmbeddingShmNegotiateRequest", [
+        ("client_host", 1, "string", {}),
+        ("client_pid", 2, "int32", {}),
+        ("slot_bytes", 3, "int32", {}),
+    ])
+    changed += _new_msg("EmbeddingShmNegotiateResponse", [
+        ("ok", 1, "bool", {}),
+        ("segment", 2, "string", {}),
+        ("slot_bytes", 3, "int32", {}),
+    ])
     return changed
 
 

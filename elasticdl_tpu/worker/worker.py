@@ -197,7 +197,8 @@ class Worker:
                 EmbeddingDataServer,
             )
 
-            self._data_server = EmbeddingDataServer()
+            self._data_server = EmbeddingDataServer(
+                shm=self.cfg.embedding_shm)
             self._data_server.start()
         except Exception as e:
             self._data_server = None
@@ -1155,7 +1156,8 @@ class Worker:
                         self.cfg.checkpoint_dir,
                         f"emb-push-queue-{self.worker_id}.jsonl")
                 transport = ResilientTransport(
-                    GrpcTransport(default_timeout_s=budget_s),
+                    GrpcTransport(default_timeout_s=budget_s,
+                                  shm=self.cfg.embedding_shm),
                     policies=default_policies(budget_s),
                     staleness_bound=self.cfg.embedding_cache_staleness,
                     hedge=self.cfg.embedding_hedge_ms >= 0,

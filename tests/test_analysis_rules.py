@@ -500,6 +500,85 @@ def test_blocking_pull_with_pipeline_suppressible():
 
 
 # ------------------------------------------------------------------ #
+# EDL209 uncoalesced-per-table-pull
+
+
+def test_per_table_pull_loop_fires_and_names_the_fused_call():
+    bad = """
+        def run(trainer, tier_client, batches, tables):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for name in tables:
+                    rows, inv, u = tier_client.pull_unique(name, batch[name])
+    """
+    fs = findings_for(bad, select={"EDL209"})
+    assert len(fs) == 1 and fs[0].rule == "EDL209"
+    assert "pull_unique_multi" in fs[0].message
+    # EDL206 co-fires: the same call is also a nested-loop tier call —
+    # EDL209 exists to name the FIX, not to replace the detection
+    assert len(findings_for(bad, select={"EDL206", "EDL209"})) == 2
+
+
+def test_per_table_pull_with_tuple_target_and_kwarg_fires():
+    bad = """
+        def run(trainer, client, batches, specs):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for name, ids in specs.items():
+                    vecs = client.pull(table=name, ids=batch["cat"])
+    """
+    assert len(findings_for(bad, select={"EDL209"})) == 1
+
+
+def test_fused_and_unrelated_inner_loops_are_quiet():
+    # the sanctioned shape: one fused call in the dispatch body
+    good = """
+        def run(trainer, tier_client, batches, tables):
+            for batch in batches:
+                pulled = tier_client.pull_unique_multi(
+                    {name: batch[name] for name in tables})
+                state, m = trainer.train_step(state, batch)
+    """
+    assert findings_for(good, select={"EDL209"}) == []
+    # inner loop not feeding the loop var into the call: not per-table
+    good2 = """
+        def run(trainer, tier_client, batches):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for _ in range(2):
+                    vecs = tier_client.pull("users", batch["cat"])
+    """
+    assert findings_for(good2, select={"EDL209"}) == []
+    # per-table PUSH loops are the step's own output — EDL206 territory
+    good3 = """
+        def run(trainer, tier_client, batches, tables):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for name in tables:
+                    tier_client.push(name, batch[name], state.grads[name])
+    """
+    assert findings_for(good3, select={"EDL209"}) == []
+    # cold loop (no dispatch): warmup sweeps stay legal
+    good4 = """
+        def warm(tier_client, tables, all_ids):
+            for name in tables:
+                tier_client.pull(name, all_ids)
+    """
+    assert findings_for(good4, select={"EDL209"}) == []
+
+
+def test_per_table_pull_suppressible():
+    bad = """
+        def run(trainer, tier_client, batches, tables):
+            for batch in batches:
+                state, m = trainer.train_step(state, batch)
+                for name in tables:
+                    vecs = tier_client.pull(name, batch[name])  # edl-lint: disable=EDL209
+    """
+    assert findings_for(bad, select={"EDL209"}) == []
+
+
+# ------------------------------------------------------------------ #
 # EDL301 / EDL302 bare stub + deadlines
 
 
@@ -1320,7 +1399,8 @@ def test_cli_list_rules(capsys):
     assert cli.main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rid in ("EDL101", "EDL201", "EDL202", "EDL203", "EDL204", "EDL205",
-                "EDL206", "EDL207", "EDL301", "EDL302", "EDL303", "EDL304",
+                "EDL206", "EDL207", "EDL209", "EDL301", "EDL302", "EDL303",
+                "EDL304",
                 "EDL305", "EDL401", "EDL402", "EDL403", "EDL404", "EDL405",
                 "EDL406"):
         assert rid in out
@@ -1778,6 +1858,9 @@ EXPECTED_EDL103_DISABLES = {
     "elasticdl_tpu/data/nativelib.py": 1,
     "elasticdl_tpu/data/reader.py": 2,
     "elasticdl_tpu/embedding/data_plane.py": 1,
+    # shm ring client: the lock IS the SPSC serialization — the
+    # deadline-bounded response wait holds it by design (ISSUE 18)
+    "elasticdl_tpu/embedding/shm.py": 1,
     "elasticdl_tpu/master/journal.py": 8,
     "elasticdl_tpu/master/process_manager.py": 2,
     "elasticdl_tpu/master/summary_service.py": 1,

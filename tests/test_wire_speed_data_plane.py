@@ -1,0 +1,291 @@
+"""Wire-speed embedding data plane (ISSUE 18).
+
+Covers the fused pull lane (EmbeddingPullMulti: bit-exact equivalence
+with LocalTransport on rows, per-sub watermarks, AND the piggybacked
+owner watermark set), the same-host shared-memory ring (served calls
+match the socket lane bit-exactly; a yanked segment falls back to gRPC
+transparently), streaming delta sync (a mid-stream drop resumes with no
+double-apply), the hedge-reservoir accounting fix (ONE p99 sample per
+fused call, not per sub-table), and the tier's fused read lane
+(pull_unique_multi == per-table pull_unique, with watermark piggyback
+covering tables the call never touched).
+
+Host-mode stores on loopback gRPC — no jax, no subprocesses; tier-1.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from elasticdl_tpu.embedding import data_plane as dp
+from elasticdl_tpu.embedding import shm as shm_mod
+from elasticdl_tpu.embedding import sharding, tier
+from elasticdl_tpu.embedding.store import EmbeddingShardStore
+from elasticdl_tpu.embedding.transport import (
+    LocalTransport,
+    OwnerUnavailableError,
+)
+
+SPEC = sharding.TableSpec("users", vocab=4096, dim=8, seed=3)
+ITEMS = sharding.TableSpec("items", vocab=2048, dim=4, seed=11)
+
+
+def make_view(tables=(SPEC,), num_shards=2, owners=(0, 0),
+              replicas=((1,), (1,)), version=1):
+    return sharding.ShardMapView(
+        version=version, num_shards=num_shards, owners=tuple(owners),
+        tables=tuple(tables), replicas=tuple(tuple(r) for r in replicas),
+    )
+
+
+@pytest.fixture()
+def served_store():
+    """One primary store behind a real gRPC server, two tables."""
+    view = make_view(tables=(SPEC, ITEMS))
+    st0 = EmbeddingShardStore(0, device=False)
+    st0.attach(view)
+    st0.set_delta_logging(True)
+    srv0 = dp.EmbeddingDataServer(st0)
+    p0 = srv0.start()
+    yield {"view": view, "st0": st0, "srv0": srv0,
+           "addr0": f"127.0.0.1:{p0}"}
+    srv0.stop()
+
+
+def _wait_ring(tr, owner, deadline_s=5.0):
+    """Negotiation runs off the hot path; tests that need the ring lane
+    join it explicitly."""
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        with tr._lock:
+            t = tr._shm_negotiating.get(owner)
+            if tr._shm_rings.get(owner) is not None:
+                return tr._shm_rings[owner]
+        if t is not None:
+            t.join(timeout=0.2)
+        else:
+            time.sleep(0.01)
+    raise AssertionError("shm ring never negotiated")
+
+
+REQS = [
+    ("users", 0, np.array([0, 2, 4, -1], np.int32)),
+    ("users", 1, np.array([1, 3], np.int32)),
+    ("items", 0, np.array([5, -1, 9], np.int32)),
+]
+
+
+def _assert_fused_equal(got, want):
+    (res_a, wms_a), (res_b, wms_b) = got, want
+    assert wms_a == wms_b
+    assert len(res_a) == len(res_b)
+    for (rows_a, wm_a), (rows_b, wm_b) in zip(res_a, res_b):
+        assert wm_a == wm_b
+        assert np.array_equal(np.asarray(rows_a), np.asarray(rows_b))
+
+
+# ------------------------------------------------------------------ #
+# fused pull: gRPC == Local, bit-exact
+
+
+def test_fused_pull_grpc_matches_local_bit_exact(served_store):
+    pair = served_store
+    tr = dp.GrpcTransport({0: pair["addr0"]}, shm=False)
+    local = LocalTransport()
+    local.register(pair["st0"])
+
+    got = tr.pull_multi(0, REQS, map_version=1)
+    want = local.pull_multi(0, REQS, map_version=1)
+    _assert_fused_equal(got, want)
+    # the piggyback is the owner's FULL primary set — both tables, all
+    # resident shards, touched by the call or not
+    assert set(got[1]) == {("users", 0), ("users", 1),
+                           ("items", 0), ("items", 1)}
+    # sentinel rows zeroed over the wire exactly like locally
+    assert np.all(np.asarray(got[0][0][0])[3] == 0.0)
+
+    # after a push the piggybacked watermark advances on both lanes
+    g = np.ones((2, 8), np.float32)
+    tr.push(0, "users", 1, np.array([1, 3], np.int32), g,
+            client_id="c", seq=1, map_version=1)
+    got2 = tr.pull_multi(0, REQS, map_version=1)
+    want2 = local.pull_multi(0, REQS, map_version=1)
+    _assert_fused_equal(got2, want2)
+    assert got2[1][("users", 1)] > got[1][("users", 1)]
+    tr.close()
+
+
+def test_fused_watermark_multi_matches_unary(served_store):
+    pair = served_store
+    tr = dp.GrpcTransport({0: pair["addr0"]}, shm=False)
+    pairs = [("users", 0), ("users", 1), ("items", 0)]
+    fused = tr.watermark_multi(0, pairs)
+    unary = [tr.shard_watermark(0, t, s) for t, s in pairs]
+    assert fused == unary
+    tr.close()
+
+
+# ------------------------------------------------------------------ #
+# shm ring: same bytes, transparent fallback
+
+
+def test_fused_pull_over_shm_ring_matches_socket(served_store):
+    pair = served_store
+    sock = dp.GrpcTransport({0: pair["addr0"]}, shm=False)
+    ring_tr = dp.GrpcTransport({0: pair["addr0"]}, shm=True)
+    want = sock.pull_multi(0, REQS, map_version=1)
+
+    # first fused call kicks negotiation off the hot path and rides
+    # the socket; join the background negotiate, then the ring serves
+    first = ring_tr.pull_multi(0, REQS, map_version=1)
+    _assert_fused_equal(first, want)
+    _wait_ring(ring_tr, 0)
+
+    before = shm_mod.SHM_READS.value(method="pull_multi")
+    got = ring_tr.pull_multi(0, REQS, map_version=1)
+    _assert_fused_equal(got, want)
+    assert shm_mod.SHM_READS.value(method="pull_multi") == before + 1
+
+    wm_ring = ring_tr.watermark_multi(0, [("users", 0), ("items", 1)])
+    wm_sock = sock.watermark_multi(0, [("users", 0), ("items", 1)])
+    assert wm_ring == wm_sock
+    sock.close()
+    ring_tr.close()
+
+
+def test_shm_ring_gone_falls_back_to_grpc(served_store):
+    pair = served_store
+    tr = dp.GrpcTransport({0: pair["addr0"]}, shm=True)
+    tr.pull_multi(0, REQS, map_version=1)
+    _wait_ring(tr, 0)
+
+    # yank every segment out from under the client (owner restarted its
+    # shm lane / /dev/shm wiped) while the gRPC server keeps serving
+    pair["srv0"]._shm_server.stop()
+    before = shm_mod.SHM_FALLBACKS.value(reason="gone")
+    got = tr.pull_multi(0, REQS, map_version=1)
+    want = dp.GrpcTransport({0: pair["addr0"]}, shm=False).pull_multi(
+        0, REQS, map_version=1)
+    _assert_fused_equal(got, want)
+    assert shm_mod.SHM_FALLBACKS.value(reason="gone") == before + 1
+    with tr._lock:
+        assert tr._shm_rings == {}   # dropped, not retried per call
+    tr.close()
+
+
+# ------------------------------------------------------------------ #
+# streaming delta sync: mid-stream drop resumes, no double-apply
+
+
+class _DropAfterOneFrame:
+    """Transport wrapper whose delta stream dies after the first
+    frame — the mid-stream partition shape."""
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def fetch_delta_stream(self, owner, table, shard, since_wm,
+                           chunk_entries=1):
+        it = self._inner.fetch_delta_stream(
+            owner, table, shard, since_wm, chunk_entries=1)
+        yield next(it)
+        raise OwnerUnavailableError("stream dropped mid-flight")
+
+
+def test_streaming_delta_sync_resumes_without_double_apply(
+        served_store, monkeypatch):
+    # one entry per frame so the drop lands mid-delta, not past it
+    monkeypatch.setattr(dp, "STREAM_DELTA_ENTRIES", 1)
+    pair = served_store
+    st1 = EmbeddingShardStore(1, device=False)
+    st1.attach(pair["view"])
+    tr = dp.GrpcTransport({0: pair["addr0"]}, shm=False)
+    st1.sync_replica_from(tr, 0, "users", 0)
+    base_wm = st1.replica_watermark("users", 0)
+
+    # several distinct pushes -> several delta entries to stream
+    ids = np.array([0, 2], np.int32)
+    for seq in range(1, 4):
+        tr.push(0, "users", 0, ids, np.full((2, 8), 0.125, np.float32),
+                client_id="w", seq=seq, map_version=1)
+
+    with pytest.raises(OwnerUnavailableError):
+        st1.sync_replica_from(_DropAfterOneFrame(tr), 0, "users", 0)
+    mid_wm = st1.replica_watermark("users", 0)
+    assert base_wm <= mid_wm < tr.shard_watermark(0, "users", 0)
+
+    # resume over the healthy transport: the applied prefix stands, the
+    # re-sent overlap falls to the idempotent watermark fence
+    final_wm = st1.sync_replica_from(tr, 0, "users", 0)
+    assert final_wm == tr.shard_watermark(0, "users", 0)
+    primary_rows = tr.fetch_shard(0, "users", 0)["rows"]
+    replica_rows, _ = st1.pull("users", 0, np.arange(4, dtype=np.int32),
+                               map_version=1, with_watermark=True,
+                               replica=True)
+    assert np.array_equal(np.asarray(replica_rows),
+                          np.asarray(primary_rows)[:4])
+    tr.close()
+
+
+# ------------------------------------------------------------------ #
+# hedge reservoir: one sample per fused call
+
+
+def test_hedge_reservoir_one_sample_per_fused_call():
+    view = make_view(tables=(SPEC, ITEMS))
+    st0 = EmbeddingShardStore(0, device=False)
+    st0.attach(view)
+    local = LocalTransport()
+    local.register(st0)
+    res = dp.ResilientTransport(local, view_fn=lambda: view)
+    assert len(res._pull_lat) == 0
+    res.pull_multi(0, REQS, map_version=1)
+    assert len(res._pull_lat) == 1   # NOT one per sub-table
+    res.pull_multi(0, REQS, map_version=1)
+    assert len(res._pull_lat) == 2
+
+
+# ------------------------------------------------------------------ #
+# tier fused lane: pull_unique_multi == per-table pull_unique
+
+
+def _tier_pair():
+    view = make_view(tables=(SPEC, ITEMS), replicas=((), ()))
+    st0 = EmbeddingShardStore(0, device=False)
+    st0.attach(view)
+    local = LocalTransport()
+    local.register(st0)
+    fused = tier.EmbeddingTierClient(lambda: view, local,
+                                     client_id="fused", cache_rows=0)
+    ref = tier.EmbeddingTierClient(lambda: view, local,
+                                   client_id="ref", cache_rows=0)
+    ref._pull_multi_ok = False       # force the per-table lane
+    return fused, ref
+
+
+def test_tier_pull_unique_multi_matches_per_table():
+    fused, ref = _tier_pair()
+    batches = {
+        "users": np.array([7, 1, 7, -1, 300], np.int64),
+        "items": np.array([5, 5, 2], np.int64),
+    }
+    got = fused.pull_unique_multi(batches)
+    for name, ids in batches.items():
+        rows_f, inv_f, uniq_f = got[name]
+        rows_r, inv_r, uniq_r = ref.pull_unique(name, ids)
+        assert np.array_equal(uniq_f, uniq_r)
+        assert np.array_equal(inv_f, inv_r)
+        assert np.array_equal(np.asarray(rows_f), np.asarray(rows_r))
+
+
+def test_tier_fused_pull_piggybacks_untouched_tables():
+    fused, _ = _tier_pair()
+    fused.pull_unique_multi({"users": np.array([1, 2], np.int64)})
+    with fused._lock:
+        # the owner's piggyback covered `items` without a single items
+        # pull or watermark probe
+        assert "items" in fused._owner_wm
