@@ -827,7 +827,12 @@ def bench_observability_overhead(mesh, np):
       polling over-states the cost on purpose);
     - skew sketch (ISSUE 11): a Space-Saving update_batch over a
       pre-deduped zipf id chunk per step — the per-pull cost a tier
-      worker pays (embedding/sketch.py).
+      worker pays (embedding/sketch.py);
+    - request diaries (ISSUE 19): one full diary start/stage/finish
+      cycle per step against a live DiaryRecorder — the tail sampler's
+      DROP path (the overwhelmingly common case), which is exactly the
+      per-call cost every data-plane pull now pays
+      (observability/reqtrace.py).
 
     Emits median/p90 per-step wall time for both modes and
     `overhead_pct` = (on - off) / off over the medians; acceptance: <= 2%.
@@ -878,13 +883,16 @@ def bench_observability_overhead(mesh, np):
 
     def run(instrumented: bool):
         nonlocal state
+        from elasticdl_tpu.observability import reqtrace as reqtrace_lib
         from elasticdl_tpu.observability.goodput import GoodputLedger
+        from elasticdl_tpu.observability.reqtrace import DiaryRecorder
 
         # the goodput-ledger tee (ISSUE 12) is hot-path cost the real
         # worker pays on every profiler add — it belongs inside the gate
         prof = profile_lib.StepProfiler(ledger=GoodputLedger())
         stats = WorkerStepStats()
         rec = flight_lib.FlightRecorder(ring=4096, role="bench")
+        diaries = DiaryRecorder()
         # per-step maybe_sample against a 0.5 s interval: real registry
         # snapshots land mid-run, at ~10x the production cadence (a real
         # worker samples every 5 s from its heartbeat thread, and polls
@@ -917,6 +925,14 @@ def bench_observability_overhead(mesh, np):
                     stats.observe_step(compute_s, batch_size)
                     rec.record("step", "bench.step", i=i, loss=loss)
                     sketch.update_batch(*sketch_chunks[i])
+                    # diaries ON (ISSUE 19): a per-step diary cycle —
+                    # start, one timed stage, the tail sampler's O(1)
+                    # drop at finish — the per-call cost a data-plane
+                    # pull pays under tail-based sampling
+                    dd = diaries.start("bench_pull")
+                    with reqtrace_lib.stage("wire"):
+                        pass
+                    diaries.finish(dd)
                     tstore.maybe_sample()
                 else:
                     state, logs = trainer.train_step(state, batch)
@@ -956,7 +972,8 @@ def bench_observability_overhead(mesh, np):
     out["overhead_pct"] = round(100.0 * (on - off) / off, 3) if off else 0.0
     out["gate"] = (
         "<= 2% median step time (ISSUE 9 acceptance; ISSUE 11 adds the "
-        "time-series ring + skew sketch to the ON leg)"
+        "time-series ring + skew sketch, ISSUE 19 the request-diary "
+        "cycle, to the ON leg)"
     )
     return out
 
@@ -2196,9 +2213,14 @@ def bench_data_plane(mesh=None, np=None):
     from elasticdl_tpu.embedding import data_plane as dp
     from elasticdl_tpu.embedding import sharding, tier
     from elasticdl_tpu.embedding.transport import DEGRADED_READS
+    from elasticdl_tpu.observability import reqtrace as reqtrace_lib
     from elasticdl_tpu.observability import tracing
 
     tracing.configure(role="bench-data-plane")
+    # fresh diary recorder: the scenario's attribution record must not
+    # inherit retained tails from earlier legs in this process
+    reqtrace_lib.reset_for_tests()
+    rec_tr = reqtrace_lib.get_recorder()
     leg_records = []
 
     def _collect(rec):
@@ -2232,6 +2254,7 @@ def bench_data_plane(mesh=None, np=None):
     had_env_faults = bool(os.environ.get(faults.FAULTS_ENV))
     dp_faults_installed = False
     client = ctrl = res = None
+    diaries_bundle_path = None
     try:
         base_spec = {
             "num_shards": DP_SHARDS, "owners": owners,
@@ -2261,6 +2284,7 @@ def bench_data_plane(mesh=None, np=None):
             # cost: two lost races condemn the primary
             breaker_failures=2,
             backoff_base_s=0.005,
+            trace_tag="hedged",
         )
         client = tier.EmbeddingTierClient(
             lambda: view, res, client_id="bench-dp",
@@ -2280,6 +2304,10 @@ def bench_data_plane(mesh=None, np=None):
                                             max_attempts=1)},
             hedge=False, queue_max=0,
             breaker_failures=10_000,   # never fails fast: pure blocking
+            # its diaries are WANTED in the flight bundle (they show
+            # what no-hedge costs) but must not pollute the hedged
+            # lane's read-tail attribution below
+            trace_tag="control",
         )
         ctrl_ids = np.arange(256, dtype=np.int32)
 
@@ -2439,6 +2467,14 @@ def bench_data_plane(mesh=None, np=None):
             for t in (t_unary, t_fused, t_shm):
                 t.close()
 
+        # ISSUE 19: pre-partition diary snapshot — the partition phase's
+        # attribution is the delta past this point, and the healthy
+        # tail's dominant stage is recorded for contrast (wire/shm when
+        # healthy, hedge/budget under partition)
+        pre_part_snap = rec_tr.snapshot()
+        out["healthy_dominant_stage"] = rec_tr.dominant_stage()
+        t_part0 = time.time()   # diary ts is wall-clock, for filtering
+
         # ---- phase 2: owner partition ---------------------------------
         # channel blackhole: a socket that accepts and never answers —
         # the connect succeeds, the call hangs to its deadline (the
@@ -2517,6 +2553,96 @@ def bench_data_plane(mesh=None, np=None):
             and max(ctrl_lats) >= 0.8 * budget_s)
         out["control_blocked_p99_ms"] = round(1e3 * p99(ctrl_lats), 3)
         out["push_queue_depth_at_heal"] = res.queue.depth()
+
+        # ---- ISSUE 19: name WHERE the partition p99 went --------------
+        # the retained request diaries carry the answer. Three views:
+        # the full partition-phase attribution delta (honest: it is
+        # wire-heavy, because the pre-breaker push burned its whole
+        # deadline on the wire to the dead owner), the READ tail's
+        # decomposition over the worst retained pull diaries (the p99
+        # the read gate above measures — hedge/budget under partition,
+        # wire/shm when healthy), and the incident CLI's slow_calls
+        # section over the scenario's own flight bundle.
+        part_snap = rec_tr.snapshot()
+        part_attr = {}
+        for s in reqtrace_lib.STAGES:
+            dv = (part_snap["attribution"].get(s, 0.0)
+                  - pre_part_snap["attribution"].get(s, 0.0))
+            if dv > 0:
+                part_attr[s] = round(dv, 6)
+        part_wall = (part_snap["slow_wall_s"]
+                     - pre_part_snap["slow_wall_s"])
+        part_named = {s: v for s, v in part_attr.items() if s != "other"}
+        out["p99_attribution"] = part_attr
+        out["p99_attribution_known_share"] = (
+            round(sum(part_named.values()) / part_wall, 4)
+            if part_wall > 0 else 0.0)
+
+        def _dominant(stages):
+            named = {s: v for s, v in stages.items()
+                     if s != "other" and v > 0} or dict(stages)
+            return (max(sorted(named), key=lambda s: named[s])
+                    if named else None)
+
+        part_reads = sorted(
+            (c for c in rec_tr.retained()
+             if c["ts"] >= t_part0 and c["op"] in ("pull", "pull_multi")
+             # the unhedged control's deadline-blocked pulls are
+             # wire-by-construction — the read gate above measures the
+             # HEDGED lane's p99, so its tail is the one decomposed
+             and (c.get("meta") or {}).get("tag") != "control"),
+            key=lambda c: c["wall_s"], reverse=True)[:8]
+        read_attr = {}
+        for c in part_reads:
+            for s, v in c["stages"].items():
+                read_attr[s] = read_attr.get(s, 0.0) + v
+        dom_read = _dominant(read_attr)
+        out["p99_read_attribution"] = {
+            s: round(v, 6) for s, v in sorted(read_attr.items())}
+        out["p99_read_dominant_stage"] = dom_read
+        # only assert the signature when the scenario's OWN fault
+        # schedule ran — a CI-exported schedule may shape the tail
+        # differently (e.g. injected wire delays)
+        out["p99_dominant_is_hedge_or_budget"] = bool(
+            dom_read in ("hedge", "budget_wait", "breaker")
+            or had_env_faults)
+        # the sum-to-wall invariant, over EVERY retained diary: the
+        # per-stage decomposition must account for the whole wall
+        worst_err = 0.0
+        for c in rec_tr.retained():
+            if c["wall_s"] > 0:
+                worst_err = max(
+                    worst_err,
+                    abs(sum(c["stages"].values()) - c["wall_s"])
+                    / c["wall_s"])
+        out["p99_attribution_worst_error_pct"] = round(
+            100.0 * worst_err, 4)
+        out["p99_attribution_sums_to_wall"] = bool(worst_err <= 0.01)
+
+        # incident CLI over the scenario's own flight bundle: the
+        # slow_calls section must exist, render the retained diaries,
+        # contain a read whose own dominant stage is the hedge/budget
+        # machinery, and pass the strict diary checks
+        from elasticdl_tpu.observability import flight as flight_lib
+        from elasticdl_tpu.observability import incident as incident_lib
+        fbundle = flight_lib.FlightRecorder(
+            ring=64, role="bench-data-plane").bundle("partition scenario")
+        diaries_bundle_path = os.path.join(
+            tmp, "flight-bench-data-plane.json")
+        with open(diaries_bundle_path, "w") as f:
+            json.dump(fbundle, f, default=repr)
+        inc_report = incident_lib.correlate([diaries_bundle_path])
+        sc = inc_report.get("slow_calls") or {}
+        out["incident_slow_calls_dominant"] = sc.get("dominant_stage")
+        out["incident_slow_calls_retained"] = sc.get("retained")
+        out["incident_names_read_tail_stage"] = any(
+            c.get("op") in ("pull", "pull_multi")
+            and _dominant(c.get("stages") or {}) in (
+                "hedge", "budget_wait", "breaker")
+            for c in sc.get("calls") or [])
+        diary_viol = [v for v in inc_report.get("strict_violations") or []
+                      if "diary" in str(v.get("problem", ""))]
+        out["incident_diary_strict_clean"] = not diary_viol
 
         # ---- phase 3: heal + drain + audits ---------------------------
         res.update_addresses({0: addr0})
@@ -2599,6 +2725,13 @@ def bench_data_plane(mesh=None, np=None):
                 shutil.copyfile(
                     queue_journal,
                     os.path.join(art_dir, "bench-data-plane-pushes.jsonl"))
+            if diaries_bundle_path and os.path.exists(diaries_bundle_path):
+                # the retained request diaries ride the flight bundle —
+                # CI uploads this and runs the incident CLI --strict
+                # over it (ISSUE 19)
+                shutil.copyfile(
+                    diaries_bundle_path,
+                    os.path.join(art_dir, "flight-bench-data-plane.json"))
             with open(os.path.join(art_dir,
                                    "bench-data-plane.health.json"),
                       "w") as f:
@@ -3674,6 +3807,11 @@ _COMPARE_METRICS = (
     # absolute slack = the scenario's own 1% gate: a contended runner
     # inside the documented invariant must not fail the compare step
     ("*attribution_worst_error_pct", "lower", 1.0),
+    # ISSUE 19: the diary tail must stay EXPLAINED — the attributed
+    # (non-`other`) fraction of the partition tail's slow wall. 0.1
+    # absolute slack: the `other` residual is scheduler-noise shaped
+    # on a contended box
+    ("*p99_attribution_known_share", "higher", 0.1),
     # ISSUE 14: the autoscaled-vs-control goodput gap is sleep-
     # structured (the injected straggle dominates scheduler noise) but
     # both fractions carry a contended-box overhead residual — 0.1

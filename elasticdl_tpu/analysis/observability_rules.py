@@ -75,6 +75,24 @@ EDL406 wall-clock-duration-measurement
     flag, and the rare intended case carries a reviewed
     ``# edl-lint: disable=EDL406`` with justification.
 
+EDL407 per-call-span-in-data-plane-hot-path
+    A span opened or an event emitted (same call shapes as EDL402/404)
+    inside a per-call function of the embedding data plane's fused
+    pull/push hot path — the modules behind `pull_unique_multi`
+    (embedding/data_plane.py, tier.py, shm.py, transport.py), in
+    functions on the per-call path (pull*/push*/serve*/hedge*/retry*/
+    the wire-call shims and codec helpers). These paths run per fused
+    read — thousands of times per step at wire speed — and every raw
+    span/event emission writes (and flushes) trace.jsonl under the
+    tracer lock. Per-call telemetry on the data plane goes through the
+    request-diary recorder (observability/reqtrace.py): `stage()` /
+    `event()` land in the caller's open diaries cheaply when diaries
+    are active and no-op otherwise, and tail-based sampling decides
+    AFTER the call whether anything is worth keeping. Spans stay at
+    phase/reshard granularity. Same emit detection as EDL404; distinct
+    rule because the data plane's hot path is per-CALL (no train_step
+    dispatch in sight for the hot-loop heuristic to catch).
+
 EDL403 fsync-under-lock
     An ``os.fsync`` call lexically inside a `guarded_by:`-annotated
     lock's critical section. An fsync is milliseconds on local disk and
@@ -443,6 +461,83 @@ class SpanSinkInHotLoopRule(Rule):
                             "spans stay at task/rescale granularity "
                             "(EDL404)",
                         )
+
+
+# ------------------------------------------------------------------ #
+# EDL407 per-call-span-in-data-plane-hot-path
+
+
+#: the fused pull/push data plane — every module a `pull_unique_multi`
+#: traverses between the tier and the owner's store
+_DATA_PLANE_HOT_MODULES = (
+    "elasticdl_tpu/embedding/data_plane.py",
+    "elasticdl_tpu/embedding/tier.py",
+    "elasticdl_tpu/embedding/shm.py",
+    "elasticdl_tpu/embedding/transport.py",
+)
+
+#: per-call function names inside those modules: the pull/push ladders,
+#: the hedge race, retry rungs, wire-call shims (gRPC + shm ring), the
+#: server-side serve path and the codec helpers. Case-insensitive so
+#: the CamelCase gRPC servicer methods (EmbeddingPullMulti) match.
+_HOT_FUNC_RE = re.compile(
+    r"^_?(pull|push|serve|hedge|retry|call|shm|wire|codec|"
+    r"encode|decode|embedding)",
+    re.IGNORECASE,
+)
+
+
+def _in_data_plane_module(ctx: ModuleContext) -> bool:
+    return any(ctx.rel_path.endswith(m) for m in _DATA_PLANE_HOT_MODULES)
+
+
+@register
+class PerCallSpanInDataPlaneHotPathRule(Rule):
+    id = "EDL407"
+    name = "per-call-span-in-data-plane-hot-path"
+    doc = (
+        "span/event emitted inside the fused pull/push data-plane hot "
+        "path — per-call telemetry goes through the request-diary "
+        "recorder (reqtrace.stage()/event(), tail-sampled); spans stay "
+        "at phase/reshard granularity"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if not _in_data_plane_module(ctx):
+            return
+        direct_names = _direct_emit_imports(ctx.tree)
+        reported: Set[int] = set()   # nested hot defs fire once
+        for node in ast.walk(ctx.tree):
+            if not isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            if not _HOT_FUNC_RE.match(node.name):
+                continue
+            for sub in ast.walk(node):
+                if (
+                    isinstance(sub, ast.Call)
+                    and _is_emit_call(sub, direct_names)
+                    and id(sub) not in reported
+                ):
+                    reported.add(id(sub))
+                    kind = (
+                        sub.func.attr
+                        if isinstance(sub.func, ast.Attribute)
+                        else sub.func.id
+                    )
+                    yield self.finding(
+                        ctx, sub,
+                        f"{kind} emission inside the data plane's "
+                        f"per-call hot path ({node.name}) — trace "
+                        "emission writes trace.jsonl per fused call; "
+                        "route per-call telemetry through the request-"
+                        "diary recorder (observability/reqtrace.py: "
+                        "stage()/event() land in the caller's diary, "
+                        "tail-based sampling keeps only the slow/"
+                        "errored/degraded ones), and keep spans at "
+                        "phase/reshard granularity (EDL407)",
+                    )
 
 
 # ------------------------------------------------------------------ #

@@ -88,6 +88,7 @@ from elasticdl_tpu.embedding.transport import (
     DEGRADED_READS,
     OwnerUnavailableError,
 )
+from elasticdl_tpu.observability import reqtrace
 from elasticdl_tpu.observability.registry import (
     default_registry,
     quantile_sorted,
@@ -249,27 +250,31 @@ def _serve_pull_multi(store, request) -> "pb.EmbeddingPullMultiResponse":
     in), the per-sub row blocks flatten into ONE response blob (one
     memcpy out), and the owner's full primary watermark set piggybacks.
     Raises StaleShardMapError for the caller to map onto its wire."""
-    ids_flat = ids_from_bytes(request.ids)
+    with reqtrace.stage("codec"):
+        ids_flat = ids_from_bytes(request.ids)
     mv = request.map_version or None
     blocks: List[np.ndarray] = []
     dims: List[int] = []
     wms: List[int] = []
     off = 0
-    for table, shard, count in zip(request.tables, request.shards,
-                                   request.counts):
-        sub = ids_flat[off:off + count]
-        off += count
-        rows, wm = store.pull(
-            table, int(shard), sub, map_version=mv,
-            with_watermark=True, replica=request.replica)
-        blocks.append(
-            np.ascontiguousarray(np.asarray(rows, np.float32)).reshape(-1))
-        dims.append(int(rows.shape[1]))
-        wms.append(int(wm))
-    rows_bytes = (np.concatenate(blocks).astype("<f4", copy=False).tobytes()
-                  if blocks else b"")
-    resp = pb.EmbeddingPullMultiResponse(
-        rows=rows_bytes, dims=dims, wms=wms)
+    with reqtrace.stage("store"):
+        for table, shard, count in zip(request.tables, request.shards,
+                                       request.counts):
+            sub = ids_flat[off:off + count]
+            off += count
+            rows, wm = store.pull(
+                table, int(shard), sub, map_version=mv,
+                with_watermark=True, replica=request.replica)
+            blocks.append(np.ascontiguousarray(
+                np.asarray(rows, np.float32)).reshape(-1))
+            dims.append(int(rows.shape[1]))
+            wms.append(int(wm))
+    with reqtrace.stage("codec"):
+        rows_bytes = (
+            np.concatenate(blocks).astype("<f4", copy=False).tobytes()
+            if blocks else b"")
+        resp = pb.EmbeddingPullMultiResponse(
+            rows=rows_bytes, dims=dims, wms=wms)
     for t, s in store.resident_shards():
         resp.wm_tables.append(t)
         resp.wm_shards.append(int(s))
@@ -494,10 +499,21 @@ class EmbeddingDataServicer:
 
     def EmbeddingPullMulti(self, request, context):
         store = self._serve_guard("EmbeddingPullMulti", context)
+        # server-side diary: codec/store stages land via the TLS stack
+        # inside _serve_pull_multi; retained tails surface in the
+        # OWNER's flight bundles next to the client's
+        rec = reqtrace.get_recorder()
+        d = rec.start("serve", method="pull_multi")
         try:
-            return _serve_pull_multi(store, request)
+            resp = _serve_pull_multi(store, request)
         except StaleShardMapError as e:
+            rec.finish(d, "error", f"stale shard map: {e}")
             self._abort_stale(context, e)
+        except BaseException as e:
+            rec.finish(d, "error", repr(e))
+            raise
+        rec.finish(d, "ok")
+        return resp
 
     def EmbeddingWatermarkMulti(self, request, context):
         store = self._serve_guard("EmbeddingWatermarkMulti", context)
@@ -822,11 +838,12 @@ class GrpcTransport:
               timeout_s: Optional[float]):
         stub = self._stub(owner)
         try:
-            return getattr(stub, method)(
-                request,
-                timeout=(timeout_s if timeout_s is not None
-                         else self._default_timeout_s),
-            )
+            with reqtrace.stage("wire"):
+                return getattr(stub, method)(
+                    request,
+                    timeout=(timeout_s if timeout_s is not None
+                             else self._default_timeout_s),
+                )
         except grpc.RpcError as e:
             raise self._map_error(e, owner, method) from e
 
@@ -857,7 +874,8 @@ class GrpcTransport:
         host = addr.rsplit(":", 1)[0]
         if not _shm.same_host(host):
             return None
-        t = threading.Thread(target=self._negotiate_ring, args=(owner,),
+        t = threading.Thread(target=self._negotiate_ring,
+                             args=(owner, addr),
                              name=f"edl-shm-negotiate-{owner}",
                              daemon=True)
         with self._lock:
@@ -865,10 +883,15 @@ class GrpcTransport:
         t.start()
         return None
 
-    def _negotiate_ring(self, owner: int) -> None:
+    def _negotiate_ring(self, owner: int, addr: str) -> None:
         """Background half of `_shm_ring`: one negotiate RPC, one
         attach, publish the ring (or give up — the gRPC lane keeps
-        serving either way)."""
+        serving either way). `addr` is the address book entry the
+        negotiation was initiated for: if the owner moved while the
+        RPC was in flight, the ring must NOT be published —
+        `update_addresses` already dropped this owner's lane, and a
+        late publish would resurrect a short-circuit to the old
+        process."""
         import socket
 
         try:
@@ -894,8 +917,19 @@ class GrpcTransport:
                 _shm.SHM_FALLBACKS.inc(reason="attach")
                 return
             with self._lock:
-                # a concurrent negotiator may have won; keep the first
-                ring = self._shm_rings.setdefault(owner, ring)
+                if self._addrs.get(owner) != addr:
+                    stale, ring = ring, None
+                else:
+                    # a concurrent negotiator may have won; keep the
+                    # first
+                    ring = self._shm_rings.setdefault(owner, ring)
+            if ring is None:
+                stale.close()
+                _shm.SHM_FALLBACKS.inc(reason="stale")
+                logger.warning(
+                    "shm negotiate to owner %d raced an address change; "
+                    "ring discarded", owner)
+                return
             logger.info("shm short-circuit to owner %d via %s", owner,
                         resp.segment)
         finally:
@@ -921,10 +955,18 @@ class GrpcTransport:
         ring = self._shm_ring(owner, timeout_s)
         if ring is None:
             return None
+        if len(req_bytes) > ring.slot_bytes:
+            # this one request outgrew the slot; the ring itself is
+            # fine — fall back per-call without dropping it
+            _shm.SHM_FALLBACKS.inc(reason="too_big")
+            return None
         try:
             return ring.call(
                 method_id, req_bytes,
                 timeout_s=min(timeout_s or self._default_timeout_s, 1.0))
+        except _shm.ShmRingTimeout:
+            self._drop_ring(owner, "timeout")
+            return None
         except _shm.ShmRingError:
             self._drop_ring(owner, "gone")
             return None
@@ -947,24 +989,24 @@ class GrpcTransport:
         with self._lock:
             local = self._local.get(owner)
         if local is not None:
-            out = local.pull(
-                table, shard, local_ids, map_version=map_version,
-                with_watermark=True, replica=replica)
+            with reqtrace.stage("store"):
+                out = local.pull(
+                    table, shard, local_ids, map_version=map_version,
+                    with_watermark=True, replica=replica)
             faults.fire("emb.pull.recv")
             rows, wm = out
             return (rows, wm) if with_watermark else rows
-        resp = self._call(
-            "EmbeddingPull", owner,
-            pb.EmbeddingPullRequest(
+        with reqtrace.stage("codec"):
+            req = pb.EmbeddingPullRequest(
                 table=table, shard=int(shard),
                 ids=ids_to_bytes(local_ids),
                 map_version=int(map_version or 0),
                 with_watermark=True, replica=bool(replica),
-            ),
-            timeout_s,
-        )
+            )
+        resp = self._call("EmbeddingPull", owner, req, timeout_s)
         faults.fire("emb.pull.recv")
-        rows = rows_from_bytes(resp.rows, resp.dim)
+        with reqtrace.stage("codec"):
+            rows = rows_from_bytes(resp.rows, resp.dim)
         return (rows, int(resp.wm)) if with_watermark else rows
 
     def push(self, owner: int, table: str, shard: int,
@@ -976,24 +1018,23 @@ class GrpcTransport:
         with self._lock:
             local = self._local.get(owner)
         if local is not None:
-            applied, wm = local.push(
-                table, shard, local_ids, rows, client_id=client_id,
-                seq=seq, map_version=map_version, scale=scale,
-                with_watermark=True)
+            with reqtrace.stage("store"):
+                applied, wm = local.push(
+                    table, shard, local_ids, rows, client_id=client_id,
+                    seq=seq, map_version=map_version, scale=scale,
+                    with_watermark=True)
             faults.fire("emb.push.recv")
             return (applied, wm) if with_watermark else applied
         dim = int(rows.shape[1]) if rows.ndim == 2 else 0
-        resp = self._call(
-            "EmbeddingPush", owner,
-            pb.EmbeddingPushRequest(
+        with reqtrace.stage("codec"):
+            req = pb.EmbeddingPushRequest(
                 table=table, shard=int(shard),
                 ids=ids_to_bytes(local_ids), rows=rows_to_bytes(rows),
                 dim=dim, client_id=client_id, seq=int(seq),
                 map_version=int(map_version or 0), scale=float(scale),
                 with_watermark=True,
-            ),
-            timeout_s,
-        )
+            )
+        resp = self._call("EmbeddingPush", owner, req, timeout_s)
         # lost-ack injection: the owner DID apply; the caller never
         # hears back and re-sends under the same seq (fence absorbs)
         faults.fire("emb.push.recv")
@@ -1112,41 +1153,46 @@ class GrpcTransport:
         with self._lock:
             local = self._local.get(owner)
         if local is not None:
-            results = [
-                local.pull(t, s, ids, map_version=map_version,
-                           with_watermark=True, replica=replica)
-                for t, s, ids in requests
-            ]
-            owner_wms = {
-                key: local.shard_watermark(*key)
-                for key in local.resident_shards()
-            }
+            with reqtrace.stage("store"):
+                results = [
+                    local.pull(t, s, ids, map_version=map_version,
+                               with_watermark=True, replica=replica)
+                    for t, s, ids in requests
+                ]
+                owner_wms = {
+                    key: local.shard_watermark(*key)
+                    for key in local.resident_shards()
+                }
             faults.fire("emb.pull.recv")
             return results, owner_wms
-        req = pb.EmbeddingPullMultiRequest(
-            tables=[t for t, _, _ in requests],
-            shards=[int(s) for _, s, _ in requests],
-            counts=[int(np.asarray(ids).shape[0])
-                    for _, _, ids in requests],
-            ids=ids_to_bytes(
-                np.concatenate([
-                    np.asarray(ids, np.int32).reshape(-1)
-                    for _, _, ids in requests
-                ]) if requests else np.zeros((0,), np.int32)),
-            map_version=int(map_version or 0),
-            replica=bool(replica),
-        )
+        with reqtrace.stage("codec"):
+            req = pb.EmbeddingPullMultiRequest(
+                tables=[t for t, _, _ in requests],
+                shards=[int(s) for _, s, _ in requests],
+                counts=[int(np.asarray(ids).shape[0])
+                        for _, _, ids in requests],
+                ids=ids_to_bytes(
+                    np.concatenate([
+                        np.asarray(ids, np.int32).reshape(-1)
+                        for _, _, ids in requests
+                    ]) if requests else np.zeros((0,), np.int32)),
+                map_version=int(map_version or 0),
+                replica=bool(replica),
+            )
+            req_bytes = req.SerializeToString()
         got = self._shm_call(owner, _shm.M_PULL_MULTI,
-                             req.SerializeToString(), timeout_s)
+                             req_bytes, timeout_s)
         if got is not None:
             status, payload = got
             if status != _shm.S_OK:
                 self._shm_status(owner, "pull_multi", status, payload)
-            resp = pb.EmbeddingPullMultiResponse.FromString(payload)
+            with reqtrace.stage("codec"):
+                resp = pb.EmbeddingPullMultiResponse.FromString(payload)
         else:
             resp = self._call("EmbeddingPullMulti", owner, req, timeout_s)
         faults.fire("emb.pull.recv")
-        return _decode_pull_multi(requests, resp)
+        with reqtrace.stage("codec"):
+            return _decode_pull_multi(requests, resp)
 
     def watermark_multi(self, owner: int, pairs, replica: bool = False,
                         timeout_s: Optional[float] = None):
@@ -1410,6 +1456,16 @@ HEDGE_FLOOR_MS = 1.0
 _HEDGE_WINDOW = 128
 
 
+def _diary_status(d: "reqtrace.Diary") -> str:
+    """A call that answered but leaned on the degraded ladder (replica
+    serve, hedge win) finishes its diary as `degraded` — the tail
+    sampler retains those unconditionally."""
+    for ev in d.events:
+        if ev.get("name") == "degraded":
+            return "degraded"
+    return "ok"
+
+
 class ResilientTransport:
     """The robustness layer over any transport (docstring at module
     top). Implements the same call contract, so the tier client, the
@@ -1434,12 +1490,18 @@ class ResilientTransport:
         backoff_max_s: float = 0.5,
         rng=None,
         sleep: Callable[[float], None] = time.sleep,
+        trace_tag: str = "",
     ):
         import random
 
         from elasticdl_tpu.proto.service import CircuitBreaker
 
         self._inner = inner
+        # stamped into every request diary's meta: lets one process
+        # running several transports (a hedged lane and an unhedged
+        # control, a reader and a writer) slice its retained tail per
+        # lane instead of per process
+        self._trace_tag = str(trace_tag)
         self._policies = default_policies()
         if policies:
             self._policies.update(policies)
@@ -1625,35 +1687,52 @@ class ResilientTransport:
                     owner, table, shard, local_ids, map_version,
                     replica=True, timeout_s=to),
                 with_watermark=with_watermark)
+        rec = reqtrace.get_recorder()
+        d = rec.start("pull", owner=int(owner), table=table,
+                      shard=int(shard), tag=self._trace_tag)
         last: Optional[BaseException] = None
-        for attempt in range(policy.max_attempts):
-            remaining = t_end - time.monotonic()
-            if remaining <= 0:
-                break
-            _RPC_CALLS.inc(method="pull")
-            try:
-                rows, wm = self._pull_round(
-                    owner, table, shard, local_ids, map_version,
-                    remaining, policy.max_attempts - attempt)
-                return (rows, wm) if with_watermark else rows
-            except StaleShardMapError:
-                raise
-            except self.RETRYABLE as e:
-                last = e
-                _RPC_FAILURES.inc(method="pull")
-                if isinstance(e, DeadlineExceededError):
-                    _RPC_DEADLINE.inc(method="pull")
-                if attempt + 1 < policy.max_attempts:
-                    _RPC_RETRIES.inc(method="pull")
-                    self._sleep(min(self._backoff(attempt),
-                                    max(0.0, t_end - time.monotonic())))
+        try:
+            for attempt in range(policy.max_attempts):
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                _RPC_CALLS.inc(method="pull")
+                try:
+                    rows, wm = self._pull_round(
+                        owner, table, shard, local_ids, map_version,
+                        remaining, policy.max_attempts - attempt)
+                    rec.finish(d, status=_diary_status(d))
+                    return (rows, wm) if with_watermark else rows
+                except StaleShardMapError:
+                    raise
+                except self.RETRYABLE as e:
+                    last = e
+                    _RPC_FAILURES.inc(method="pull")
+                    if isinstance(e, DeadlineExceededError):
+                        _RPC_DEADLINE.inc(method="pull")
+                    if attempt + 1 < policy.max_attempts:
+                        _RPC_RETRIES.inc(method="pull")
+                        reqtrace.event("retry", attempt=attempt,
+                                       error=type(e).__name__)
+                        with reqtrace.stage("budget_wait"):
+                            self._sleep(
+                                min(self._backoff(attempt),
+                                    max(0.0,
+                                        t_end - time.monotonic())))
+        except BaseException as e:
+            rec.finish(d, status="error",
+                       detail=f"{type(e).__name__}: {e}")
+            raise
         # the ladder's last rung: no primary, no credible replica — the
         # read blocks (the caller's retry loop / deadline decides how
         # long). Counted so partitions can't hide inside retry loops.
         DEGRADED_READS.inc(mode="blocked")
-        raise last if last is not None else DeadlineExceededError(
+        err = last if last is not None else DeadlineExceededError(
             f"pull {table}/{shard} from owner {owner}: deadline budget "
             f"({policy.budget_s:.3f}s) spent")
+        rec.finish(d, status="error",
+                   detail=f"{type(err).__name__}: {err}")
+        raise err
 
     def _pull_once(self, owner: int, table: str, shard: int,
                    local_ids, map_version, replica: bool,
@@ -1696,11 +1775,13 @@ class ResilientTransport:
             # fail-fast rung: the primary is known-partitioned; a
             # credible replica serves (honestly counted), else this
             # round fails without burning wire time on a dead peer
+            reqtrace.event("breaker_open", owner=int(owner))
             rows_wm = self._pull_replica_any(
                 reps, table, shard, local_ids, map_version,
                 attempt_timeout)
             if rows_wm is not None:
                 DEGRADED_READS.inc(mode="replica")
+                reqtrace.event("degraded", mode="replica")
                 return rows_wm
             raise OwnerUnavailableError(
                 f"owner {owner} breaker open and no credible replica "
@@ -1760,15 +1841,28 @@ class ResilientTransport:
         lanes race through here."""
         pool = self._hedge_pool()
         primary = pool.submit(primary_call)
+        # the pre-hedge wait is attributed by how it RESOLVES: a primary
+        # that answers inside the hedge window spent caller-side wire
+        # time, one that forces the hedge spent the hedge DELAY — that
+        # delay is the hedge mechanism's transient, and charging it to
+        # `wire` would make a partition tail read as wire-bound. The
+        # attempt runs on a pool thread (no diary there by design), so
+        # the caller attributes its own wait either way.
+        t0 = time.monotonic()
         done, _ = wait([primary], timeout=self.hedge_delay_s())
+        reqtrace.attribute("wire" if done else "hedge",
+                           time.monotonic() - t0)
         if done:
             return primary.result()   # fast path: no hedge launched
         _HEDGED.inc()
+        reqtrace.event("hedge_fired", owner=int(owner))
         hedge = pool.submit(hedge_call)
         pending = {primary, hedge}
         primary_err: Optional[BaseException] = None
         while pending:
-            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            with reqtrace.stage("hedge"):
+                done, pending = wait(pending,
+                                     return_when=FIRST_COMPLETED)
             for fut in done:
                 if fut is primary:
                     try:
@@ -1779,11 +1873,13 @@ class ResilientTransport:
                     if hedge in pending and hedge.cancel():
                         pending.discard(hedge)
                     _HEDGE_CANCELLED.inc()
+                    reqtrace.event("hedge_loss", owner=int(owner))
                     return result
                 # hedge future: never raises (returns None on failure)
                 rows_wm = fut.result()
                 if rows_wm is not None:
                     _HEDGE_WINS.inc()
+                    reqtrace.event("hedge_win", owner=int(owner))
                     if primary in pending:
                         # the primary call cannot be recalled mid-
                         # flight; it dies at its own wire deadline
@@ -1793,6 +1889,7 @@ class ResilientTransport:
                         # the primary did not answer inside the hedge
                         # window AND lost the race: attribute the read
                         DEGRADED_READS.inc(mode="replica")
+                        reqtrace.event("degraded", mode="replica")
                         # a lost race is a missed SLO: strike the
                         # primary's breaker NOW rather than when its
                         # abandoned call times out — a partitioned
@@ -1803,6 +1900,7 @@ class ResilientTransport:
                         self._note_failure(owner)
                     elif primary_err is not None:
                         DEGRADED_READS.inc(mode="replica")
+                        reqtrace.event("degraded", mode="replica")
                     return rows_wm
         if isinstance(primary_err, StaleShardMapError):
             raise primary_err
@@ -1839,8 +1937,10 @@ class ResilientTransport:
                     _RPC_DEADLINE.inc(method=method)
                 if attempt + 1 < policy.max_attempts:
                     _RPC_RETRIES.inc(method=method)
-                    self._sleep(min(self._backoff(attempt),
-                                    max(0.0, t_end - time.monotonic())))
+                    with reqtrace.stage("budget_wait"):
+                        self._sleep(
+                            min(self._backoff(attempt),
+                                max(0.0, t_end - time.monotonic())))
         raise last if last is not None else DeadlineExceededError(
             f"{method} to owner {owner}: deadline budget spent")
 
@@ -1866,31 +1966,49 @@ class ResilientTransport:
                     owner, requests, map_version, replica=True,
                     timeout_s=to),
                 with_watermark=True)
+        rec = reqtrace.get_recorder()
+        d = rec.start("pull_multi", owner=int(owner),
+                      fanin=len(requests), tag=self._trace_tag)
         last: Optional[BaseException] = None
-        for attempt in range(policy.max_attempts):
-            remaining = t_end - time.monotonic()
-            if remaining <= 0:
-                break
-            _RPC_CALLS.inc(method="pull_multi")
-            try:
-                return self._pull_multi_round(
-                    owner, requests, map_version, remaining,
-                    policy.max_attempts - attempt)
-            except StaleShardMapError:
-                raise
-            except self.RETRYABLE as e:
-                last = e
-                _RPC_FAILURES.inc(method="pull_multi")
-                if isinstance(e, DeadlineExceededError):
-                    _RPC_DEADLINE.inc(method="pull_multi")
-                if attempt + 1 < policy.max_attempts:
-                    _RPC_RETRIES.inc(method="pull_multi")
-                    self._sleep(min(self._backoff(attempt),
-                                    max(0.0, t_end - time.monotonic())))
+        try:
+            for attempt in range(policy.max_attempts):
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                _RPC_CALLS.inc(method="pull_multi")
+                try:
+                    got = self._pull_multi_round(
+                        owner, requests, map_version, remaining,
+                        policy.max_attempts - attempt)
+                    rec.finish(d, status=_diary_status(d))
+                    return got
+                except StaleShardMapError:
+                    raise
+                except self.RETRYABLE as e:
+                    last = e
+                    _RPC_FAILURES.inc(method="pull_multi")
+                    if isinstance(e, DeadlineExceededError):
+                        _RPC_DEADLINE.inc(method="pull_multi")
+                    if attempt + 1 < policy.max_attempts:
+                        _RPC_RETRIES.inc(method="pull_multi")
+                        reqtrace.event("retry", attempt=attempt,
+                                       error=type(e).__name__)
+                        with reqtrace.stage("budget_wait"):
+                            self._sleep(
+                                min(self._backoff(attempt),
+                                    max(0.0,
+                                        t_end - time.monotonic())))
+        except BaseException as e:
+            rec.finish(d, status="error",
+                       detail=f"{type(e).__name__}: {e}")
+            raise
         DEGRADED_READS.inc(mode="blocked")
-        raise last if last is not None else DeadlineExceededError(
+        err = last if last is not None else DeadlineExceededError(
             f"fused pull of {len(requests)} sub-pulls from owner "
             f"{owner}: deadline budget ({policy.budget_s:.3f}s) spent")
+        rec.finish(d, status="error",
+                   detail=f"{type(err).__name__}: {err}")
+        raise err
 
     def _pull_multi_once(self, owner: int, requests, map_version,
                          replica: bool, timeout_s: Optional[float]):
@@ -1967,10 +2085,12 @@ class ResilientTransport:
         reps = self._common_replicas(requests, exclude=owner)
         attempt_timeout = remaining_s / max(1, attempts_left)
         if not breaker.allow():
+            reqtrace.event("breaker_open", owner=int(owner))
             got = self._pull_multi_replica_any(
                 reps, requests, map_version, attempt_timeout)
             if got is not None:
                 DEGRADED_READS.inc(mode="replica")
+                reqtrace.event("degraded", mode="replica")
                 return got
             raise OwnerUnavailableError(
                 f"owner {owner} breaker open and no credible replica "
@@ -2023,63 +2143,91 @@ class ResilientTransport:
         policy = self._policies["push"]
         t_end = time.monotonic() + policy.budget_s
         breaker = self._breaker(owner)
-        # ORDER FENCE: while this owner has a backlog, every new push
-        # must join the queue behind it (a later seq applied before an
-        # earlier one would make the earlier drain a swallowed
-        # duplicate). A healthy owner drains the backlog first.
-        if self.queue is not None and self.queue.depth(owner):
-            if not (breaker.allow() and self._drain_owner(owner)):
-                return self._enqueue_or_raise(
+        rec = reqtrace.get_recorder()
+        d = rec.start("push", owner=int(owner), table=table,
+                      shard=int(shard), tag=self._trace_tag)
+        try:
+            # ORDER FENCE: while this owner has a backlog, every new
+            # push must join the queue behind it (a later seq applied
+            # before an earlier one would make the earlier drain a
+            # swallowed duplicate). A healthy owner drains the backlog
+            # first.
+            if self.queue is not None and self.queue.depth(owner):
+                if not (breaker.allow() and self._drain_owner(owner)):
+                    got = self._enqueue_or_raise(
+                        owner, table, shard, local_ids, rows,
+                        client_id, seq, map_version, scale,
+                        with_watermark)
+                    rec.finish(d, status="degraded",
+                               detail="queued behind owner backlog")
+                    return got
+            last: Optional[BaseException] = None
+            for attempt in range(policy.max_attempts):
+                remaining = t_end - time.monotonic()
+                if remaining <= 0:
+                    break
+                if not breaker.allow():
+                    reqtrace.event("breaker_open", owner=int(owner))
+                    last = OwnerUnavailableError(
+                        f"owner {owner} breaker open")
+                    break
+                _RPC_CALLS.inc(method="push")
+                t0 = time.perf_counter()
+                try:
+                    applied, wm = self._inner.push(
+                        owner, table, shard, local_ids, rows,
+                        client_id=client_id, seq=seq,
+                        map_version=map_version, scale=scale,
+                        with_watermark=True,
+                        **self._kw(
+                            remaining
+                            / max(1, policy.max_attempts - attempt)))
+                except StaleShardMapError:
+                    self._note_success(owner)
+                    raise
+                except self.RETRYABLE as e:
+                    last = e
+                    self._note_failure(owner)
+                    _RPC_FAILURES.inc(method="push")
+                    if isinstance(e, DeadlineExceededError):
+                        _RPC_DEADLINE.inc(method="push")
+                    if attempt + 1 < policy.max_attempts:
+                        _RPC_RETRIES.inc(method="push")
+                        reqtrace.event("retry", attempt=attempt,
+                                       error=type(e).__name__)
+                        # SAME seq on the re-send: an ambiguous
+                        # failure (the owner may have applied before
+                        # the reply was lost) is absorbed by the
+                        # store's fence
+                        with reqtrace.stage("budget_wait"):
+                            self._sleep(
+                                min(self._backoff(attempt),
+                                    max(0.0,
+                                        t_end - time.monotonic())))
+                    continue
+                self._note_success(owner)
+                _RPC_LATENCY.observe(time.perf_counter() - t0,
+                                     method="push")
+                self._note_wm(table, shard, int(wm))
+                rec.finish(d, status="ok")
+                return (applied, int(wm)) if with_watermark else applied
+            # the breaker rung: park the push durably instead of
+            # blocking the training step for the whole partition
+            if self.queue is not None:
+                got = self._enqueue_or_raise(
                     owner, table, shard, local_ids, rows, client_id,
                     seq, map_version, scale, with_watermark)
-        last: Optional[BaseException] = None
-        for attempt in range(policy.max_attempts):
-            remaining = t_end - time.monotonic()
-            if remaining <= 0:
-                break
-            if not breaker.allow():
-                last = OwnerUnavailableError(
-                    f"owner {owner} breaker open")
-                break
-            _RPC_CALLS.inc(method="push")
-            t0 = time.perf_counter()
-            try:
-                applied, wm = self._inner.push(
-                    owner, table, shard, local_ids, rows,
-                    client_id=client_id, seq=seq,
-                    map_version=map_version, scale=scale,
-                    with_watermark=True,
-                    **self._kw(remaining
-                               / max(1, policy.max_attempts - attempt)))
-            except StaleShardMapError:
-                self._note_success(owner)
-                raise
-            except self.RETRYABLE as e:
-                last = e
-                self._note_failure(owner)
-                _RPC_FAILURES.inc(method="push")
-                if isinstance(e, DeadlineExceededError):
-                    _RPC_DEADLINE.inc(method="push")
-                if attempt + 1 < policy.max_attempts:
-                    _RPC_RETRIES.inc(method="push")
-                    # SAME seq on the re-send: an ambiguous failure
-                    # (the owner may have applied before the reply was
-                    # lost) is absorbed by the store's fence
-                    self._sleep(min(self._backoff(attempt),
-                                    max(0.0, t_end - time.monotonic())))
-                continue
-            self._note_success(owner)
-            _RPC_LATENCY.observe(time.perf_counter() - t0, method="push")
-            self._note_wm(table, shard, int(wm))
-            return (applied, int(wm)) if with_watermark else applied
-        # the breaker rung: park the push durably instead of blocking
-        # the training step for the whole partition
-        if self.queue is not None:
-            return self._enqueue_or_raise(
-                owner, table, shard, local_ids, rows, client_id, seq,
-                map_version, scale, with_watermark)
-        raise last if last is not None else DeadlineExceededError(
-            f"push {table}/{shard} seq {seq}: deadline budget spent")
+                rec.finish(d, status="degraded",
+                           detail="queued behind open breaker")
+                return got
+            err = last if last is not None else DeadlineExceededError(
+                f"push {table}/{shard} seq {seq}: deadline budget "
+                f"spent")
+            raise err
+        except BaseException as e:
+            rec.finish(d, status="error",
+                       detail=f"{type(e).__name__}: {e}")
+            raise
 
     def _enqueue_or_raise(self, owner, table, shard, local_ids, rows,
                           client_id, seq, map_version, scale,

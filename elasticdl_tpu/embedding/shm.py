@@ -45,6 +45,7 @@ import time
 from typing import Callable, Optional, Tuple
 
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import reqtrace
 from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
@@ -84,6 +85,12 @@ MAX_SLOT_BYTES = 1 << 22
 POLL_S = float(os.environ.get("EDL_EMB_SHM_POLL_US", "20")) * 1e-6
 _SPIN_ITERS = 200
 
+SHM_CALLS = default_registry().counter(
+    "edl_emb_shm_calls_total",
+    "client-side shm ring round-trips ATTEMPTED, by method — the "
+    "fallback share's denominator (edl_emb_shm_reads_total counts "
+    "only the ones that completed)",
+    labels=("method",))
 SHM_READS = default_registry().counter(
     "edl_emb_shm_reads_total",
     "data-plane calls served over the same-host shared-memory ring, "
@@ -97,6 +104,10 @@ SHM_FALLBACKS = default_registry().counter(
 SHM_RINGS = default_registry().gauge(
     "edl_emb_shm_rings",
     "shared-memory rings currently served by this owner")
+SHM_OCCUPANCY = default_registry().gauge(
+    "edl_emb_shm_ring_occupancy",
+    "rings on this owner currently serving a request (busy rings; "
+    "occupancy near edl_emb_shm_rings means poll threads saturated)")
 
 _METHOD_NAMES = {
     M_PULL_MULTI: "pull_multi", M_PULL: "pull", M_PUSH: "push",
@@ -107,6 +118,11 @@ _METHOD_NAMES = {
 class ShmRingError(RuntimeError):
     """The ring is unusable (gone / timed out / payload too big) —
     the caller falls back to gRPC and drops the ring."""
+
+
+class ShmRingTimeout(ShmRingError):
+    """The response deadline passed — lets the caller count the
+    fallback as `timeout` rather than `gone`."""
 
 
 def same_host(host: str) -> bool:
@@ -207,6 +223,8 @@ class ShmRingServer:
     def _serve_ring(self, ring: _Ring, stop: threading.Event) -> None:
         hdr = ring.hdr
         idle = 0
+        last_sleep = 0.0
+        rec = reqtrace.get_recorder()
         while not stop.is_set():
             req = int(hdr[_I_REQ_SEQ])
             if req == int(hdr[_I_RESP_SEQ]):
@@ -219,21 +237,36 @@ class ShmRingServer:
                 # or its poll threads starve everything else on a
                 # small box, including the owner's own gRPC lane
                 if idle < 16:
-                    time.sleep(POLL_S)
+                    last_sleep = POLL_S
                 else:
-                    time.sleep(min(1e-3,
-                                   POLL_S * (1 << min(8, idle >> 4))))
+                    last_sleep = min(1e-3,
+                                     POLL_S * (1 << min(8, idle >> 4)))
+                time.sleep(last_sleep)
                 continue
             idle = 0
             method = int(hdr[_I_REQ_METHOD])
             n = int(hdr[_I_REQ_LEN])
             payload = ring.read_slot(ring.req_off, n)
+            # serve-side request diary: the request waited at most one
+            # poll interval before we saw it — the honest serve_queue
+            # bound this lane can observe; the dispatcher's codec/store
+            # stages land via the thread-local stack
+            d = rec.start("serve", lane="shm",
+                          method=_METHOD_NAMES.get(method, str(method)))
+            d.add("serve_queue", last_sleep)
+            last_sleep = 0.0
+            SHM_OCCUPANCY.add(1)
             try:
                 status, out = self._serve_fn(method, payload)
             except Exception as e:
                 status, out = S_ERROR, str(e).encode("utf-8")
+            finally:
+                SHM_OCCUPANCY.add(-1)
             if len(out) > ring.slot_bytes:
                 status, out = S_ERROR, b"shm response exceeds slot"
+            rec.finish(d, "ok" if status == S_OK else "error",
+                       "" if status == S_OK
+                       else out.decode("utf-8", "replace")[:128])
             ring.write_slot(ring.resp_off, out)
             hdr[_I_RESP_LEN] = len(out)
             hdr[_I_RESP_STATUS] = status
@@ -283,9 +316,39 @@ class ShmRingClient:
         if int(self._ring.hdr[_I_MAGIC]) != _MAGIC:
             seg.close()
             raise ShmRingError(f"bad magic in {name}")
+        self._name = name
         self.slot_bytes = int(slot_bytes)
         self._lock = threading.Lock()
         self._dead = False
+
+    def _segment_exists(self) -> bool:
+        """Is the owner's segment still linked? Our own mapping stays
+        valid after an unlink, so a response deadline alone cannot
+        distinguish a slow owner (`timeout`) from one that tore the
+        lane down (`gone`)."""
+        path = "/dev/shm/" + self._name.lstrip("/")
+        if os.path.isdir("/dev/shm"):
+            return os.path.exists(path)
+        try:
+            probe = _shm_mod.SharedMemory(name=self._name)
+        except FileNotFoundError:
+            return False
+        except Exception:
+            # probe failed for a reason OTHER than unlink — treat the
+            # segment as alive; the caller's timeout label is the
+            # conservative one: edl-lint: disable=EDL303
+            return True
+        try:
+            from multiprocessing import resource_tracker
+            resource_tracker.unregister(probe._name,  # noqa: SLF001
+                                        "shared_memory")
+        except Exception:
+            # tracker internals shifted — cosmetic only (a spurious
+            # resource_tracker warning at exit):
+            # edl-lint: disable=EDL303
+            pass
+        probe.close()
+        return True
 
     def call(self, method: int, payload: bytes,
              timeout_s: float = 1.0) -> Tuple[int, bytes]:
@@ -295,7 +358,8 @@ class ShmRingClient:
             raise ShmRingError(
                 f"payload {len(payload)}B exceeds slot "
                 f"{self.slot_bytes}B")
-        with self._lock:
+        SHM_CALLS.inc(method=_METHOD_NAMES.get(method, str(method)))
+        with self._lock, reqtrace.stage("shm"):
             ring = self._ring
             hdr = ring.hdr
             try:
@@ -310,7 +374,10 @@ class ShmRingClient:
                     spins += 1
                     if spins > _SPIN_ITERS:
                         if time.monotonic() > deadline:
-                            raise ShmRingError("ring response timeout")
+                            if not self._segment_exists():
+                                raise ShmRingError(
+                                    "ring segment unlinked under us")
+                            raise ShmRingTimeout("ring response timeout")
                         # the lock IS the SPSC serialization: one
                         # in-flight request per ring, so the response
                         # wait holds it by design (deadline-bounded):

@@ -68,6 +68,7 @@ from elasticdl_tpu.embedding.transport import (
     DEGRADED_READS,
     OwnerUnavailableError,
 )
+from elasticdl_tpu.observability import reqtrace
 from elasticdl_tpu.observability.registry import (
     default_registry,
     quantile_sorted,
@@ -522,6 +523,7 @@ class EmbeddingTierClient:
         else:
             n = int(counts[hit_mask][sel].sum())
         DEGRADED_READS.inc(n, mode="cache")
+        reqtrace.event("degraded", mode="cache", ids=n)
 
     def _wm_probe_accepts_replica(self) -> bool:
         """Whether the transport's `shard_watermark` takes `replica=`
@@ -856,82 +858,96 @@ class EmbeddingTierClient:
                 for table, ids in table_ids.items()
             }
         t0 = time.perf_counter()
+        rec = reqtrace.get_recorder()
+        diary = rec.start("tier_pull", tables=len(table_ids))
         states: Dict[str, Dict[str, Any]] = {}
-        for table, ids in table_ids.items():
-            spec = self.table(table)
-            flat = np.asarray(ids).reshape(-1).astype(np.int64)
-            valid = (flat >= 0) & (flat < spec.vocab)
-            _PULL_IDS.inc(int(flat.shape[0]))
-            uniq, inverse, id_counts = np.unique(
-                np.where(valid, flat, np.int64(-1)),
-                return_inverse=True, return_counts=True)
-            has_pad = bool(uniq.shape[0]) and uniq[0] < 0
-            if has_pad:
-                # sentinel slot rotated to the END, as in pull_unique:
-                # slot U-1 is the reserved zero row
-                uniq = np.concatenate([uniq[1:], uniq[:1]])
-                inverse = np.where(
-                    inverse == 0, uniq.shape[0] - 1, inverse - 1)
-                id_counts = np.concatenate([id_counts[1:], id_counts[:1]])
-            _PULL_UNIQUE.inc(int(uniq.shape[0]) - int(has_pad))
-            real = uniq.shape[0] - int(has_pad)
-            if real and self._sketch_due():
-                self.sketch.update_batch(uniq[:real], id_counts[:real])
-            states[table] = {
-                "spec": spec, "uniq": uniq, "counts": id_counts,
-                "real": real, "miss_mask": None,
-                "rows": np.zeros((uniq.shape[0], spec.dim), np.float32),
-                "inverse": inverse.reshape(np.asarray(ids).shape),
-            }
+        with reqtrace.stage("dedupe"):
+            for table, ids in table_ids.items():
+                spec = self.table(table)
+                flat = np.asarray(ids).reshape(-1).astype(np.int64)
+                valid = (flat >= 0) & (flat < spec.vocab)
+                _PULL_IDS.inc(int(flat.shape[0]))
+                uniq, inverse, id_counts = np.unique(
+                    np.where(valid, flat, np.int64(-1)),
+                    return_inverse=True, return_counts=True)
+                has_pad = bool(uniq.shape[0]) and uniq[0] < 0
+                if has_pad:
+                    # sentinel slot rotated to the END, as in
+                    # pull_unique: slot U-1 is the reserved zero row
+                    uniq = np.concatenate([uniq[1:], uniq[:1]])
+                    inverse = np.where(
+                        inverse == 0, uniq.shape[0] - 1, inverse - 1)
+                    id_counts = np.concatenate(
+                        [id_counts[1:], id_counts[:1]])
+                _PULL_UNIQUE.inc(int(uniq.shape[0]) - int(has_pad))
+                real = uniq.shape[0] - int(has_pad)
+                if real and self._sketch_due():
+                    self.sketch.update_batch(
+                        uniq[:real], id_counts[:real])
+                states[table] = {
+                    "spec": spec, "uniq": uniq, "counts": id_counts,
+                    "real": real, "miss_mask": None,
+                    "rows": np.zeros((uniq.shape[0], spec.dim),
+                                     np.float32),
+                    "inverse": inverse.reshape(np.asarray(ids).shape),
+                }
         view = self.view
-        misses: Dict[str, np.ndarray] = {}
-        full_hit: List[str] = []
-        for table, st in states.items():
-            real = st["real"]
-            if not real:
-                continue
-            uniq_r = st["uniq"][:real]
-            if self.cache is None:
-                misses[table] = uniq_r
-                continue
-            counts_r = st["counts"][:real]
-            with self._lock:
-                owner_arr = self._owner_wm_locked(
-                    table, view.num_shards).copy()
-            hit_mask, hit_rows = self.cache.lookup(
-                table, st["spec"].vocab, st["spec"].dim, uniq_r,
-                owner_arr, view.num_shards, counts_r)
-            if hit_rows is not None:
-                st["rows"][:real][hit_mask] = hit_rows
-                self._attribute_degraded_hits(
-                    view, uniq_r, hit_mask, counts_r)
-            miss = ~hit_mask
-            if miss.any():
-                misses[table] = uniq_r[miss]
-                st["miss_mask"] = miss
-            else:
-                full_hit.append(table)
-        if misses:
-            served = self._pull_owner_multi(misses)
-            for table, (rows_m, wms_m) in served.items():
-                st = states[table]
-                miss = st["miss_mask"]
-                if miss is None:
-                    st["rows"][:st["real"]] = rows_m
+        try:
+            misses: Dict[str, np.ndarray] = {}
+            full_hit: List[str] = []
+            for table, st in states.items():
+                real = st["real"]
+                if not real:
+                    continue
+                uniq_r = st["uniq"][:real]
+                if self.cache is None:
+                    misses[table] = uniq_r
+                    continue
+                counts_r = st["counts"][:real]
+                with self._lock:
+                    owner_arr = self._owner_wm_locked(
+                        table, view.num_shards).copy()
+                hit_mask, hit_rows = self.cache.lookup(
+                    table, st["spec"].vocab, st["spec"].dim, uniq_r,
+                    owner_arr, view.num_shards, counts_r)
+                if hit_rows is not None:
+                    st["rows"][:real][hit_mask] = hit_rows
+                    self._attribute_degraded_hits(
+                        view, uniq_r, hit_mask, counts_r)
+                miss = ~hit_mask
+                if miss.any():
+                    misses[table] = uniq_r[miss]
+                    st["miss_mask"] = miss
                 else:
-                    st["rows"][:st["real"]][miss] = rows_m
-                if self.cache is not None:
-                    self.cache.insert(
-                        table, st["spec"].vocab, st["spec"].dim,
-                        misses[table], rows_m, wms_m)
-                    with self._lock:
-                        self._full_hits[table] = 0
-        if full_hit:
-            # fully-cache-served tables keep the probe cadence honest;
-            # a fused pull's piggyback just reset their counters, so
-            # the residual probe only fires for a client whose batches
-            # stopped missing entirely
-            self._maybe_probe_watermarks_multi(full_hit, view)
+                    full_hit.append(table)
+            if misses:
+                served = self._pull_owner_multi(misses)
+                for table, (rows_m, wms_m) in served.items():
+                    st = states[table]
+                    miss = st["miss_mask"]
+                    if miss is None:
+                        st["rows"][:st["real"]] = rows_m
+                    else:
+                        st["rows"][:st["real"]][miss] = rows_m
+                    if self.cache is not None:
+                        self.cache.insert(
+                            table, st["spec"].vocab, st["spec"].dim,
+                            misses[table], rows_m, wms_m)
+                        with self._lock:
+                            self._full_hits[table] = 0
+            if full_hit:
+                # fully-cache-served tables keep the probe cadence
+                # honest; a fused pull's piggyback just reset their
+                # counters, so the residual probe only fires for a
+                # client whose batches stopped missing entirely
+                self._maybe_probe_watermarks_multi(full_hit, view)
+        except BaseException as e:
+            rec.finish(diary, status="error",
+                       detail=f"{type(e).__name__}: {e}")
+            raise
+        rec.finish(diary, status=(
+            "degraded" if any(ev.get("name") == "degraded"
+                              for ev in diary.events) else "ok"))
         dt = time.perf_counter() - t0
         _PULL_S.observe(dt)
         _goodput_pull(dt)

@@ -34,6 +34,7 @@ import numpy as np
 
 from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
+from elasticdl_tpu.observability import reqtrace
 from elasticdl_tpu.observability.registry import default_registry
 
 logger = default_logger(__name__)
@@ -97,8 +98,10 @@ class LocalTransport:
              with_watermark: bool = False, replica: bool = False):
         faults.fire("emb.pull")
         store = self.store_of(owner)
-        out = store.pull(table, shard, local_ids, map_version=map_version,
-                         with_watermark=with_watermark, replica=replica)
+        with reqtrace.stage("store"):
+            out = store.pull(
+                table, shard, local_ids, map_version=map_version,
+                with_watermark=with_watermark, replica=replica)
         # response-side injection: the owner DID serve; the reply is lost
         # on the way back (reads are idempotent — the caller re-pulls)
         faults.fire("emb.pull.recv")
@@ -110,11 +113,12 @@ class LocalTransport:
              scale: float = 1.0, with_watermark: bool = False):
         faults.fire("emb.push")
         store = self.store_of(owner)
-        applied = store.push(
-            table, shard, local_ids, rows, client_id=client_id, seq=seq,
-            map_version=map_version, scale=scale,
-            with_watermark=with_watermark,
-        )
+        with reqtrace.stage("store"):
+            applied = store.push(
+                table, shard, local_ids, rows, client_id=client_id,
+                seq=seq, map_version=map_version, scale=scale,
+                with_watermark=with_watermark,
+            )
         # lost-ack injection: the store DID apply; the caller never hears
         # back and must re-send — the store's seq fence absorbs the dup
         faults.fire("emb.push.recv")
@@ -168,15 +172,16 @@ class LocalTransport:
         real fused RPC."""
         faults.fire("emb.pull")
         store = self.store_of(owner)
-        results = []
-        for table, shard, local_ids in requests:
-            results.append(store.pull(
-                table, shard, local_ids, map_version=map_version,
-                with_watermark=True, replica=replica))
-        owner_wms = {
-            key: store.shard_watermark(*key)
-            for key in store.resident_shards()
-        }
+        with reqtrace.stage("store"):
+            results = []
+            for table, shard, local_ids in requests:
+                results.append(store.pull(
+                    table, shard, local_ids, map_version=map_version,
+                    with_watermark=True, replica=replica))
+            owner_wms = {
+                key: store.shard_watermark(*key)
+                for key in store.resident_shards()
+            }
         faults.fire("emb.pull.recv")
         return results, owner_wms
 
@@ -250,7 +255,8 @@ class SimWireTransport:
 
     def _wire(self, rows: int) -> None:
         if self._call_s or self._row_s:
-            time.sleep(self._call_s + rows * self._row_s)
+            with reqtrace.stage("wire"):
+                time.sleep(self._call_s + rows * self._row_s)
 
     def pull(self, owner, table, shard, local_ids, **kw):
         self._wire(int((local_ids >= 0).sum()))

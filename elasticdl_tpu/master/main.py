@@ -192,6 +192,14 @@ class Master:
 
         self.goodput = FleetGoodput(self.membership, self.dispatcher)
 
+        # Fleet tail attribution (ISSUE 19, observability/reqtrace.py):
+        # the rollup over heartbeat rt_* diary payloads — names the
+        # fleet-dominant slow-request stage and pulses when it shifts
+        # (the emb_attr_dominant_shift default rule's input).
+        from elasticdl_tpu.observability.reqtrace import FleetAttribution
+
+        self.attribution = FleetAttribution()
+
         # Closed-loop autoscaler (ISSUE 14, master/autoscaler.py): turns
         # the two decision seams above — ClusterHealth straggler onsets
         # and the backlog/data-wait alert rules — into journaled, fenced
@@ -440,6 +448,10 @@ class Master:
         # goodput series join the same sample: the fraction + wasted
         # ratio the default alert rules window over
         series.update(self.goodput.series())
+        # tail-attribution series (dominant stage + shift pulse) join
+        # too — emb_attr_dominant_shift reads the pulse from this store
+        series.update(self.attribution.series(
+            self.membership.health_snapshot()))
         return series
 
     def wait(

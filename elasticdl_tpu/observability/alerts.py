@@ -234,6 +234,25 @@ def default_rules() -> List[AlertRule]:
                         "crash/expiry) — crash-replay or lease-timeout "
                         "churn",
         ),
+        # ISSUE 19 (observability/reqtrace.py): the fleet's dominant
+        # slow-request stage MOVED (e.g. wire -> budget_wait, the
+        # partition signature). FleetAttribution emits a 1.0 pulse on
+        # the sample where the worst reporter's dominant stage differs
+        # from the previous rollup, 0.0 otherwise — a plain value rule
+        # turns that into an edge-triggered alert that clears on the
+        # next steady sample. The absolute p99 level already has
+        # embedding_pull_p99; this rule fires on the SHAPE changing.
+        AlertRule(
+            "emb_attr_dominant_shift",
+            series="edl_fleet_emb_attr_dom_shift",
+            threshold=0.5, mode="value", window_s=60.0,
+            severity="warn",
+            description="the dominant per-stage attribution of slow "
+                        "embedding reads shifted (see "
+                        "edl_fleet_emb_attr_dom_stage and the incident "
+                        "CLI's slow_calls waterfalls for where the p99 "
+                        "moved)",
+        ),
     ]
 
 

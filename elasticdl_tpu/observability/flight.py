@@ -274,6 +274,20 @@ class FlightRecorder:
             # advisory, same as the profiler block:
             # edl-lint: disable=EDL303
             pass
+        try:
+            from elasticdl_tpu.observability import reqtrace
+
+            # retained request diaries (ISSUE 19): the tail-sampled
+            # slow/error/degraded calls the incident CLI renders as
+            # `slow_calls` stage waterfalls. None when the data plane
+            # never ran here — absence means no-data, not a clean tail.
+            diaries = reqtrace.get_recorder().bundle_block()
+            if diaries is not None:
+                out["diaries"] = diaries
+        except Exception:
+            # advisory, same as the profiler block:
+            # edl-lint: disable=EDL303
+            pass
         return out
 
     def dump(self, reason: str, dir: Optional[str] = None,

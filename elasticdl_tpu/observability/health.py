@@ -54,11 +54,13 @@ STATS_METADATA_KEY = "edl-worker-stats"
 
 #: decode() rejects payloads past this — a corrupt/hostile value must cost
 #: a bounded parse attempt, never master memory (key budget raised for
-#: ISSUE 11's embedding skew ride-along — emb_* keys below — and again
-#: for ISSUE 12's goodput-ledger ride-along: up to 9 gp_* keys per
-#: worker, observability/goodput.py payload schema)
-MAX_PAYLOAD_BYTES = 3072
-MAX_PAYLOAD_KEYS = 48
+#: ISSUE 11's embedding skew ride-along — emb_* keys below — again for
+#: ISSUE 12's goodput-ledger ride-along: up to 9 gp_* keys per worker,
+#: observability/goodput.py payload schema, and again for ISSUE 19's
+#: request-diary rollup: up to 7 rt_*/share keys per worker,
+#: observability/reqtrace.py payload schema)
+MAX_PAYLOAD_BYTES = 3584
+MAX_PAYLOAD_KEYS = 56
 
 #: step-profiler keys (observability/profile.py snapshot schema) plus the
 #: embedding-tier skew keys (embedding/tier.tier_stats) carried from a
@@ -71,6 +73,11 @@ _PROFILE_KEYS = (
     # ISSUE 13 read path: effective (cache-included) read p99, recent
     # cache hit rate (the hot-set-migration sensor), pipeline lookahead
     "emb_read_p99_ms", "emb_cache_hit_rate", "emb_pipeline_depth",
+    # ISSUE 19 tail attribution: the request-diary recorder's compact
+    # rollup (observability/reqtrace.py payload schema) plus the
+    # degraded/shm-fallback shares the fleet series aggregates
+    "rt_slow", "rt_slow_wall_s", "rt_dom", "rt_dom_share",
+    "rt_known_share", "emb_degraded_share", "emb_shm_fallback_share",
 )
 
 # cluster rollup gauges (master-side; docs/observability.md)

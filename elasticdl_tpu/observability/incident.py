@@ -332,6 +332,8 @@ def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
         goodput_summary["non_productive_worker_seconds"] = round(
             sum(v for c, v in cats.items() if c != "train_compute"), 3)
 
+    slow_calls, diary_violations = _collect_slow_calls(bundles.bundles)
+
     report = {
         "paths": paths,
         "bundles": [
@@ -353,6 +355,7 @@ def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
                 {"file": p, "line": n, "problem": f"unparseable line: {t}"}
                 for p, n, t in traces.strict_violations
             ]
+            + diary_violations
         ),
         "unreadable_files": (
             list(bundles.unreadable) + list(traces.unreadable_files)
@@ -366,12 +369,103 @@ def correlate(paths: Iterable[str], tail: int = TAIL_DEFAULT) -> dict:
         "journal": journal,
         "health": health,
         "goodput": goodput_summary,
+        "slow_calls": slow_calls,
     }
     return report
 
 
+#: worst retained diaries rendered in the text report
+SLOW_CALLS_SHOWN = 8
+#: a retained diary's stages must sum to its wall within this (the
+#: recorder completes the `other` residual at retain time, so a larger
+#: gap is a writer bug, not timing noise)
+ATTRIBUTION_TOL = 0.01
+
+
+def _collect_slow_calls(bundles: List[dict]) -> Tuple[dict, List[dict]]:
+    """Pool the `diaries` blocks across bundles (ISSUE 19): the worst
+    retained request diaries plus the merged per-stage attribution —
+    the section that names where the incident's p99 went. Returns
+    (summary, strict_violations): a retained diary whose stages do NOT
+    sum to its wall within 1% is a writer bug."""
+    calls: List[dict] = []
+    attr: Dict[str, float] = {}
+    violations: List[dict] = []
+    finished = retained = 0
+    slow_wall = 0.0
+    for b in bundles:
+        block = b.get("diaries")
+        if not isinstance(block, dict):
+            continue
+        role = str(b.get("role", "?"))
+        fname = os.path.basename(b.get("_path", ""))
+        finished += int(block.get("finished") or 0)
+        retained += int(block.get("retained") or 0)
+        wall = block.get("slow_wall_s")
+        if isinstance(wall, (int, float)):
+            slow_wall += float(wall)
+        for s, v in (block.get("attribution") or {}).items():
+            if isinstance(v, (int, float)):
+                attr[s] = attr.get(s, 0.0) + float(v)
+        for call in block.get("slow_calls") or []:
+            if not isinstance(call, dict):
+                continue
+            calls.append({**call, "role": role})
+            w = call.get("wall_s")
+            stages = call.get("stages")
+            if (isinstance(w, (int, float)) and w > 0
+                    and isinstance(stages, dict)):
+                total = sum(v for v in stages.values()
+                            if isinstance(v, (int, float)))
+                if abs(total - w) > max(ATTRIBUTION_TOL * w, 1e-5):
+                    violations.append({
+                        "file": fname,
+                        "problem": (
+                            f"diary {call.get('op', '?')} stages sum "
+                            f"{total:.6f}s != wall {w:.6f}s (>1%)"),
+                    })
+    if not calls and not attr:
+        return {}, violations
+    calls.sort(key=lambda c: float(c.get("wall_s") or 0.0), reverse=True)
+    named = {s: v for s, v in attr.items() if s != "other"}
+    pool = named or attr
+    dominant = max(sorted(pool), key=lambda s: pool[s]) if pool else None
+    summary = {
+        "finished": finished,
+        "retained": retained,
+        "slow_wall_s": round(slow_wall, 6),
+        "attribution": {s: round(v, 6) for s, v in sorted(attr.items())},
+        "dominant_stage": dominant,
+        "dominant_share": (
+            round(pool[dominant] / slow_wall, 4)
+            if dominant is not None and slow_wall > 0 else None),
+        "calls": calls,
+    }
+    return summary, violations
+
+
 # ---------------------------------------------------------------------- #
 # rendering
+
+
+def _waterfall(call: dict, width: int = 24) -> List[str]:
+    """One retained diary as an indented stage waterfall: each stage a
+    bar proportional to its share of the call's wall, largest first."""
+    wall = float(call.get("wall_s") or 0.0)
+    stages = call.get("stages")
+    if wall <= 0 or not isinstance(stages, dict):
+        return []
+    out: List[str] = []
+    for s, v in sorted(stages.items(), key=lambda kv: -float(kv[1] or 0)):
+        if not isinstance(v, (int, float)) or v <= 0:
+            continue
+        share = min(1.0, float(v) / wall)
+        bar = "#" * max(1, int(round(share * width)))
+        out.append(
+            f"    {s:<12s} {float(v) * 1e3:9.2f}ms  "
+            f"{bar:<{width}s} {share:.0%}"
+        )
+    return out
 
 
 def render_text(report: dict, max_entries: int = 200) -> str:
@@ -426,6 +520,29 @@ def render_text(report: dict, max_entries: int = 200) -> str:
                 f"  wasted[{reason}]: {ent.get('records', 0)} record(s) "
                 f"across {ent.get('events', 0)} event(s)"
             )
+    slow = report.get("slow_calls") or {}
+    if slow:
+        dom = slow.get("dominant_stage")
+        share = slow.get("dominant_share")
+        head = (
+            f"slow_calls: {slow.get('retained', 0)} retained of "
+            f"{slow.get('finished', 0)} finished, "
+            f"{slow.get('slow_wall_s', 0):g}s slow wall"
+        )
+        if dom:
+            head += f" — dominant stage {dom}"
+            if share is not None:
+                head += f" ({share:.0%} of the slow wall)"
+        lines.append(head)
+        for call in (slow.get("calls") or [])[:SLOW_CALLS_SHOWN]:
+            lines.append(
+                f"  {call.get('op', '?'):<10s} "
+                f"{float(call.get('wall_s') or 0.0) * 1e3:9.2f}ms "
+                f"{call.get('status', '?'):<8s} "
+                f"[{call.get('role', '?')}]"
+                + (f"  {call['detail']}" if call.get("detail") else "")
+            )
+            lines.extend(_waterfall(call))
     for snap in report.get("health") or ():
         # snapshot_age_s (ISSUE 11): how stale the rollup was when it
         # was served — the difference between "the fleet was fine" and

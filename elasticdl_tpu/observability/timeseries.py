@@ -502,6 +502,17 @@ def fleet_series(health_records: List[Dict],
         # reads this series). Absent when no worker runs a cache — the
         # rule sees "no data" and stays quiet, never a fake zero.
         out["edl_fleet_emb_cache_hit_rate"] = round(min(hit_rates), 4)
+    for key, series in (
+        # data-plane degradation shares (ISSUE 19): worst reporter, so
+        # one worker riding the degraded ladder (or falling back from
+        # its shm ring to gRPC fleet-wide) is visible even while the
+        # fleet average looks clean
+        ("emb_degraded_share", "edl_fleet_emb_degraded_share"),
+        ("emb_shm_fallback_share", "edl_fleet_emb_shm_fallback_share"),
+    ):
+        vals = [v for v in (num(r, key) for r in fresh) if v is not None]
+        if vals:
+            out[series] = round(max(vals), 4)
     return out
 
 

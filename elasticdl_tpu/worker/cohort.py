@@ -50,6 +50,7 @@ from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import flight as flight_lib
 from elasticdl_tpu.observability import goodput as goodput_lib
 from elasticdl_tpu.observability import profile as profile_lib
+from elasticdl_tpu.observability import reqtrace as reqtrace_lib
 from elasticdl_tpu.observability.health import (
     STATS_METADATA_KEY,
     WorkerStepStats,
@@ -427,6 +428,9 @@ class CohortWorker:
         # wall-clock attribution (followers' ledgers stay process-local;
         # their training phases ride the member-stats exchange)
         stats.update(goodput_lib.get_ledger().payload())
+        # request-diary ride-along (ISSUE 19): the leader's own tail
+        # attribution (rt_* keys) + degraded/shm-fallback shares
+        stats.update(reqtrace_lib.get_recorder().payload())
         # embedding-tier skew ride-along (ISSUE 11; see worker.py's
         # _stats_payload) — best-effort, never costs the heartbeat
         if self._tier is not None:

@@ -26,6 +26,7 @@ from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.data.reader import create_data_reader
 from elasticdl_tpu.observability import flight as flight_lib
 from elasticdl_tpu.observability import goodput as goodput_lib
+from elasticdl_tpu.observability import reqtrace as reqtrace_lib
 from elasticdl_tpu.observability import profile as profile_lib
 from elasticdl_tpu.observability import timeseries as timeseries_lib
 from elasticdl_tpu.observability import tracing
@@ -498,6 +499,10 @@ class Worker:
         # wall-clock attribution (gp_* keys) — the master's FleetGoodput
         # rollup totals these into the fleet goodput fraction
         stats.update(goodput_lib.get_ledger().payload())
+        # request-diary ride-along (ISSUE 19): compact tail-attribution
+        # rollup (rt_* keys) + degraded/shm-fallback shares — the
+        # master's FleetAttribution and fleet_series read these
+        stats.update(reqtrace_lib.get_recorder().payload())
         # embedding-tier skew ride-along (ISSUE 11): hot-id share, shard
         # imbalance, recent pull/push p99 — the fleet rollup's sensor for
         # the hot-row-cache decision. Best-effort like the rest of the

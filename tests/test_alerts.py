@@ -95,7 +95,8 @@ def test_default_rules_are_valid_and_unique():
     assert {"straggler", "dispatcher_backlog_per_worker",
             "fleet_data_wait_dominant", "embedding_pull_p99",
             "embedding_shard_imbalance", "embedding_cache_hit_collapse",
-            "goodput_burn", "wasted_work_ratio"} == set(names)
+            "goodput_burn", "wasted_work_ratio",
+            "emb_attr_dominant_shift"} == set(names)
     # page rules are the flight-dumping ones
     pages = {r.name for r in rules if r.severity == "page"}
     assert pages == {"embedding_pull_p99", "embedding_shard_imbalance"}

@@ -1402,7 +1402,7 @@ def test_cli_list_rules(capsys):
                 "EDL206", "EDL207", "EDL209", "EDL301", "EDL302", "EDL303",
                 "EDL304",
                 "EDL305", "EDL401", "EDL402", "EDL403", "EDL404", "EDL405",
-                "EDL406"):
+                "EDL406", "EDL407"):
         assert rid in out
 
 
@@ -1488,6 +1488,106 @@ def test_span_sink_suppressible_inline():
                     tracing.event("x")  # edl-lint: disable=EDL404
     """
     assert findings_for(src, select={"EDL404"}) == []
+
+
+# ------------------------------------------------------------------ #
+# EDL407 per-call-span-in-data-plane-hot-path
+
+
+EDL407_BAD = """
+    from elasticdl_tpu.observability import tracing
+
+    class Transportish:
+        def pull(self, owner, table, shard, ids):
+            tracing.event("emb.pull", owner=owner)      # per fused call
+            return self._call("pull", owner, ids)
+
+        def _hedged_race(self, owner, primary, hedge):
+            with tracing.span("emb.hedge", owner=owner):
+                return primary()
+"""
+
+EDL407_GOOD = """
+    from elasticdl_tpu.observability import reqtrace, tracing
+
+    class Transportish:
+        def pull(self, owner, table, shard, ids):
+            # per-call telemetry through the diary recorder: fine
+            reqtrace.event("retry", attempt=1)
+            with reqtrace.stage("wire"):
+                return self._call("pull", owner, ids)
+
+    def reshard_view(view):
+        # not a per-call function: reshard-granularity spans are the
+        # intended shape
+        with tracing.span("embedding.reshard", version=view.version):
+            return view
+"""
+
+
+def test_per_call_span_fires_in_data_plane_modules():
+    fs = findings_for(
+        EDL407_BAD, select={"EDL407"},
+        rel_path="elasticdl_tpu/embedding/data_plane.py")
+    assert rule_ids(fs) == ["EDL407"]
+    assert len(fs) == 2
+    assert all("request-diary recorder" in f.message for f in fs)
+    assert any("pull" in f.message for f in fs)
+
+
+def test_per_call_span_scoped_to_data_plane_modules():
+    # the same source OUTSIDE the data-plane module set is EDL407-quiet
+    # (EDL402/404 still own their shapes there)
+    assert findings_for(
+        EDL407_BAD, select={"EDL407"}, rel_path="fixture.py") == []
+    assert findings_for(
+        EDL407_BAD, select={"EDL407"},
+        rel_path="elasticdl_tpu/master/main.py") == []
+
+
+def test_per_call_span_quiet_on_diary_recorder_and_cold_paths():
+    assert findings_for(
+        EDL407_GOOD, select={"EDL407"},
+        rel_path="elasticdl_tpu/embedding/tier.py") == []
+
+
+def test_per_call_span_suppressible_inline():
+    src = """
+        from elasticdl_tpu.observability import tracing
+
+        class T:
+            def push(self, owner, rows):
+                # reviewed: fires once per heal, not per call
+                tracing.event("emb.drain")  # edl-lint: disable=EDL407
+                return self._call("push", owner, rows)
+    """
+    assert findings_for(
+        src, select={"EDL407"},
+        rel_path="elasticdl_tpu/embedding/data_plane.py") == []
+
+
+def test_data_plane_tree_is_edl407_clean():
+    # the real hot-path modules carry NO raw tracer emission — per-call
+    # telemetry went through reqtrace when ISSUE 19 instrumented them
+    from elasticdl_tpu.embedding import (
+        data_plane as _dp_mod, shm as _shm_mod, tier as _tier_mod,
+        transport as _tr_mod)
+
+    for mod, rel in (
+        (_dp_mod, "elasticdl_tpu/embedding/data_plane.py"),
+        (_tier_mod, "elasticdl_tpu/embedding/tier.py"),
+        (_shm_mod, "elasticdl_tpu/embedding/shm.py"),
+        (_tr_mod, "elasticdl_tpu/embedding/transport.py"),
+    ):
+        src = open(mod.__file__, encoding="utf-8").read()
+        ctx = ModuleContext(mod.__file__, src, rel)
+        from elasticdl_tpu.analysis.core import all_rules
+
+        fs = [
+            f for rule in all_rules() if rule.id == "EDL407"
+            for f in rule.check(ctx) if not ctx.suppressed(f)
+        ]
+        assert fs == [], [f.message for f in fs]
 
 
 # ------------------------------------------------------------------ #
