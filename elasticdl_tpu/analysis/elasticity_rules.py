@@ -23,6 +23,28 @@ EDL501 rescale-action-outside-policy
     the chaos/test hook — an in-place relaunch, not a resize — and is
     not flagged.
 
+EDL503 layout-mutation-outside-policy
+    A direct embedding-layout mutation on the shard-map owner —
+    `.update_replicas(...)`, `.set_hot_ids(...)`, `.begin_split()`, or
+    `.begin_merge()` — outside the sanctioned modules: the layout
+    policy engine (master/layout_controller.py) and the owner
+    implementation itself (embedding/sharding.py). ISSUE 20 made every
+    layout decision cost-gated (blocked-read-seconds), per-kind
+    cooldown-bounded, and journal-replayed (`layout` records); an
+    ad-hoc call site bypasses all three — it can flap against the
+    controller's own actions, double-fire after a master takeover
+    (nothing journaled the DECISION, only the map transition), and
+    stall the read path with a migration the cost model never priced.
+    Route the mutation through `LayoutController`/its target adapters,
+    or carry a reviewed `# edl-lint: disable=EDL503` with
+    justification. (`begin_resharding` — the worker-death re-plan — is
+    NOT a layout action and stays unflagged.)
+
+    Receiver gating mirrors EDL501: the receiver must be owner-ish — a
+    name (or attribute) matching `owner`/`embedding`/`shard_map` — or a
+    local name assigned from a `ShardMapOwner(...)` construction in the
+    same module.
+
 EDL502 sleep-in-simulated-time
     A bare `time.sleep(...)` (or `sleep(...)` imported from `time`)
     inside `elasticdl_tpu/fleetsim/`. The fleet simulator runs on a
@@ -143,6 +165,83 @@ class RescaleActionOutsidePolicyRule(Rule):
         names: Set[str] = set()
         for node in ast.walk(ctx.tree):
             if isinstance(node, ast.Assign) and _is_manager_construction(
+                node.value
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        names.add(t.id)
+                    elif isinstance(t, ast.Attribute):
+                        names.add(t.attr)
+        return names
+
+
+#: the four journaled layout transitions on ShardMapOwner — the whole
+#: mutation surface the layout controller owns (begin_resharding is the
+#: worker-death re-plan, not a layout action)
+_LAYOUT_METHODS = {
+    "update_replicas", "set_hot_ids", "begin_split", "begin_merge",
+}
+
+#: modules where direct layout calls are the sanctioned path
+_LAYOUT_ALLOWED_SUFFIXES = (
+    "master/layout_controller.py",
+    "embedding/sharding.py",
+)
+
+_OWNERISH = re.compile(r"(owner|embedding|shard_map)", re.IGNORECASE)
+
+
+def _is_owner_construction(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else "")
+    return name == "ShardMapOwner"
+
+
+@register
+class LayoutMutationOutsidePolicyRule(Rule):
+    id = "EDL503"
+    name = "layout-mutation-outside-policy"
+    doc = (
+        "direct shard-map layout mutation outside the layout policy "
+        "engine — bypasses the cost gate, per-kind cooldown, and "
+        "journaled decision history"
+    )
+
+    def check(self, ctx: ModuleContext) -> Iterator[Finding]:
+        if ctx.rel_path.endswith(_LAYOUT_ALLOWED_SUFFIXES):
+            return
+        tracked = self._constructed_owners(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _LAYOUT_METHODS
+            ):
+                continue
+            recv = _receiver_name(node.func.value)
+            if not (
+                recv in tracked
+                or _OWNERISH.search(recv)
+                or _is_owner_construction(node.func.value)
+            ):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"direct {node.func.attr}() on the shard-map owner "
+                "bypasses the layout controller's cost gate, per-kind "
+                "cooldown, and journaled decision history; route the "
+                "mutation through master/layout_controller.py (or carry "
+                "a reviewed disable)",
+            )
+
+    @staticmethod
+    def _constructed_owners(ctx: ModuleContext) -> Set[str]:
+        names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Assign) and _is_owner_construction(
                 node.value
             ):
                 for t in node.targets:

@@ -200,6 +200,40 @@ class JobConfig:
     # action suppresses with reason `reversal_hold`. 0 = off.
     autoscale_reversal_hold_s: float = 0.0
 
+    # --- closed-loop LAYOUT controller (master/layout_controller.py;
+    # ISSUE 20 — the embedding-tier sibling of the autoscaler above) ---
+    # false (default) = the embedding layout stays human-operated; true
+    # = skew signals (shard imbalance, cache-hit collapse, the sketch's
+    # hot-id share) drive journaled, cost-gated layout actions: per-
+    # shard replica fan-out, shard split/merge through the two-phase
+    # reshard fence, and hot-id promotion into a worker-replicated set.
+    layout_autoscale: bool = False
+    # shard-count bounds for split/merge. max 0 = splitting DISABLED
+    # (replica fan-out and hot-id actions still run); merge never folds
+    # below the bootstrap shard count regardless of min.
+    layout_max_shards: int = 0
+    layout_min_shards: int = 1
+    # per-shard read-replica cap for replica_fanout
+    layout_max_replicas: int = 2
+    # ultra-hot set size (worker-replicated sketch head); 0 disables
+    # hot promotion
+    layout_hot_k: int = 16
+    # PER-KIND cooldown between applied actions of the same kind (a
+    # replica fan-out must not cool down a pending split); inherited
+    # across master restarts via the journal's `layout` records
+    layout_cooldown_s: float = 60.0
+    # hysteresis: a skew signal must persist this long before action
+    layout_hold_s: float = 15.0
+    # per-job layout action budget (blast-radius cap)
+    layout_actions_max: int = 16
+    # cost-model seed: projected blocked-read-seconds per shard touched
+    # by a migration. Seed it from YOUR deployment's measured `bench.py
+    # embedding_tier` reshard `recovery_s` (bench-baselines/
+    # bench-embedding-tier.json); EWMA-updated from real migrations.
+    layout_migrate_cost_s: float = 0.16
+    # horizon the projected read-stall relief accrues over
+    layout_horizon_s: float = 120.0
+
     # --- cluster shape / elasticity ---
     # Who owns worker lifecycles: "" = the launcher (local subprocess
     # manager, or the k8s StatefulSet's own self-healing); "k8s" = the MASTER
@@ -508,6 +542,45 @@ class JobConfig:
                     "autoscale requires checkpoint_dir: decisions are "
                     "journaled under <checkpoint_dir>/control/ and "
                     "replayed at master takeover"
+                )
+        if self.layout_autoscale:
+            if self.layout_max_shards < 0:
+                raise ValueError(
+                    "layout_max_shards must be >= 0 (0 disables splits)")
+            if self.layout_min_shards < 1:
+                raise ValueError("layout_min_shards must be >= 1")
+            if (self.layout_max_shards
+                    and self.layout_max_shards < self.layout_min_shards):
+                raise ValueError(
+                    "layout_max_shards must be 0 (splits disabled) or >= "
+                    "layout_min_shards")
+            if self.layout_max_replicas < 0:
+                raise ValueError("layout_max_replicas must be >= 0")
+            if self.layout_hot_k < 0:
+                raise ValueError(
+                    "layout_hot_k must be >= 0 (0 disables hot promotion)")
+            if self.layout_cooldown_s < 0:
+                raise ValueError("layout_cooldown_s must be >= 0")
+            if self.layout_hold_s < 0:
+                raise ValueError("layout_hold_s must be >= 0")
+            if self.layout_actions_max < 1:
+                raise ValueError(
+                    "layout_actions_max must be >= 1 (use "
+                    "--layout_autoscale false to disable the loop)")
+            if self.layout_migrate_cost_s <= 0:
+                raise ValueError(
+                    "layout_migrate_cost_s must be > 0 (seed it from the "
+                    "bench embedding_tier reshard recovery_s)")
+            if self.layout_horizon_s <= 0:
+                raise ValueError("layout_horizon_s must be > 0")
+            if not self.checkpoint_dir:
+                # same contract as autoscale: decisions are journaled
+                # `layout` records replayed at master takeover; without
+                # a journal a restarted master would re-fire them
+                raise ValueError(
+                    "layout_autoscale requires checkpoint_dir: layout "
+                    "decisions are journaled under <checkpoint_dir>/"
+                    "control/ and replayed at master takeover"
                 )
         if self.master_restarts > 0 and not self.checkpoint_dir:
             # a journal-less successor rebuilds the dispatcher from scratch

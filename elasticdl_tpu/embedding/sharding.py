@@ -115,6 +115,12 @@ class ShardMapView:
     # adopted by GrpcTransport.update_addresses at every client refresh.
     # Empty for local-transport deployments.
     addrs: Tuple[Tuple[int, str], ...] = ()
+    # ultra-hot id set (ISSUE 20): sketch-head ids the layout controller
+    # promoted to worker-replicated status. Clients PIN these rows in
+    # their hot-row cache (refreshed through the same watermark fence as
+    # any cached row); demotion shrinks the tuple. Journaled beside the
+    # map so a successor master replays the same promotion state.
+    hot_ids: Tuple[int, ...] = ()
 
     def owner_of(self, shard: int) -> int:
         return self.owners[shard]
@@ -134,18 +140,41 @@ class ShardMove:
     """One planned migration: shard `shard` leaves `src` for `dst`.
     `src < 0` means the donor is DEAD — the recipient restores the shard
     from the tier checkpoint (or re-materializes from the table seed if
-    no checkpoint exists) instead of a live transfer."""
+    no checkpoint exists) instead of a live transfer.
+
+    `kind` (ISSUE 20) widens the move vocabulary for layout actions:
+
+    - ``"move"``  — the classic cross-owner migration above;
+    - ``"split"`` — `shard` is a CHILD id under the DOUBLED shard count;
+      `parent` names the parent shard whose resident rows the owner
+      re-interleaves locally (store.split_resident) — no cross-owner
+      transfer, but the recipient still confirms through the same
+      two-phase handshake so a crash mid-split rolls back;
+    - ``"merge"`` — `shard` is a PARENT id under the HALVED count; the
+      owner folds its two co-resident children back together.
+
+    Defaulted fields keep `from_wire` compatible with pre-split journal
+    records."""
 
     shard: int
     src: int
     dst: int
+    kind: str = "move"
+    parent: int = -1
 
     def to_wire(self) -> Dict[str, int]:
-        return {"shard": self.shard, "src": self.src, "dst": self.dst}
+        out = {"shard": self.shard, "src": self.src, "dst": self.dst}
+        if self.kind != "move":
+            out["kind"] = self.kind
+            out["parent"] = self.parent
+        return out
 
     @staticmethod
     def from_wire(d: Dict[str, Any]) -> "ShardMove":
-        return ShardMove(int(d["shard"]), int(d["src"]), int(d["dst"]))
+        return ShardMove(
+            int(d["shard"]), int(d["src"]), int(d["dst"]),
+            kind=str(d.get("kind", "move")), parent=int(d.get("parent", -1)),
+        )
 
 
 def assign_round_robin(num_shards: int, owners: Sequence[int]) -> List[int]:
@@ -222,7 +251,8 @@ def plan_moves(
 
 
 def assign_replicas(
-    owners: Sequence[int], pool: Sequence[int], replica_count: int,
+    owners: Sequence[int], pool: Sequence[int],
+    replica_count: Any,
     current: Sequence[Sequence[int]] = (),
 ) -> List[List[int]]:
     """Per-shard read-replica assignment: up to `replica_count` workers
@@ -230,12 +260,26 @@ def assign_replicas(
     deterministic (sorted pool, shard-rotated) so every process planning
     from the same inputs lands the same map. Replicas already holding
     the shard (`current`, the pre-transition assignment) are kept when
-    still eligible — a synced copy is worth more than a balanced one."""
+    still eligible — a synced copy is worth more than a balanced one.
+
+    `replica_count` is an int (uniform fan-out, the PR 13 contract) or a
+    per-shard sequence of ints (ISSUE 20: the layout controller's
+    skew-adaptive fan-out — hot shards get more read copies, cold
+    shards drop to primary-only)."""
     pool = sorted(set(pool))
+    if isinstance(replica_count, (list, tuple)):
+        per_shard = [int(c) for c in replica_count]
+        if len(per_shard) != len(owners):
+            raise ValueError(
+                f"per-shard replica counts ({len(per_shard)}) must match "
+                f"num_shards ({len(owners)})"
+            )
+    else:
+        per_shard = [int(replica_count)] * len(owners)
     out: List[List[int]] = []
     for s, p in enumerate(owners):
         cands = [o for o in pool if o != p]
-        rc = min(replica_count, len(cands))
+        rc = min(per_shard[s], len(cands))
         if rc <= 0:
             out.append([])
             continue
@@ -296,7 +340,11 @@ class ShardMapOwner:
         self._tables: Dict[str, TableSpec] = {}  # guarded_by: _lock
         self._owners: List[int] = []             # guarded_by: _lock
         self._replicas: List[List[int]] = []     # guarded_by: _lock
+        self._hot_ids: List[int] = []            # guarded_by: _lock
         self._version = 0                        # guarded_by: _lock
+        # per-shard replica targets, set ONLY by the layout controller
+        # (None = uniform self.replica_count everywhere)
+        self._replica_counts: Optional[List[int]] = None  # guarded_by: _lock
         self._pending: Optional[Dict[str, Any]] = None  # guarded_by: _lock
         self._interrupted = False                # guarded_by: _lock
         self._listeners: List[Callable[[ShardMapView], None]] = []
@@ -316,6 +364,13 @@ class ShardMapOwner:
             self._replicas = [
                 list(r) for r in getattr(state, "replicas", [])
             ]
+            self._hot_ids = [
+                int(i) for i in getattr(state, "hot_ids", [])
+            ]
+            counts = getattr(state, "replica_counts", None)
+            self._replica_counts = (
+                [int(c) for c in counts] if counts else None
+            )
             self._version = state.version
             self._tables = {
                 t["name"]: TableSpec.from_wire(t) for t in state.tables
@@ -417,7 +472,9 @@ class ShardMapOwner:
             version = self._version + 1
             new_assignment = apply_moves_to_assignment(self._owners, moves)
             new_replicas = assign_replicas(
-                new_assignment, sorted(alive), self.replica_count,
+                new_assignment, sorted(alive),
+                (self._replica_counts if self._replica_counts is not None
+                 else self.replica_count),
                 current=self._replicas,
             )
             self._pending = {
@@ -433,6 +490,7 @@ class ShardMapOwner:
             if self._journal is not None:
                 commit = self._journal.append(
                     "emb_reshard_begin", version=version,
+                    num_shards=self.num_shards,
                     owners=list(self._owners),
                     replicas=[list(r) for r in self._replicas],
                     moves=[m.to_wire() for m in moves],
@@ -499,6 +557,211 @@ class ShardMapOwner:
             return list(self._pending["moves"]) if self._pending else []
 
     # -------------------------------------------------------------- #
+    # layout actions — driven by master/layout_controller.py (ISSUE 20).
+    # edl-lint EDL503 flags calls to these from anywhere else: ad-hoc
+    # layout mutation bypasses the cost gate, the cooldowns, and the
+    # journaled decision history a master takeover replays.
+
+    def update_replicas(
+        self, replica_counts: Sequence[int], pool: Sequence[int],
+    ) -> ShardMapView:
+        """Re-fan replica assignments to per-shard targets (single
+        phase: replicas are pull-only, so no exactly-once fence is
+        needed — the version bump routes clients, and a pull landing on
+        a not-yet-installed replica falls back to the primary through
+        the existing degraded ladder). Journaled as `emb_replica_map`;
+        the targets stick across later reshardings until the controller
+        changes them again."""
+        commit = None
+        with self._lock:
+            if not self._owners:
+                raise RuntimeError("update_replicas before bootstrap")
+            if self._pending is not None:
+                raise RuntimeError(
+                    "update_replicas during in-flight resharding"
+                )
+            counts = [max(0, int(c)) for c in replica_counts]
+            if len(counts) != self.num_shards:
+                raise ValueError(
+                    f"replica_counts has {len(counts)} entries for "
+                    f"{self.num_shards} shards"
+                )
+            self._replica_counts = counts
+            self._replicas = assign_replicas(
+                self._owners, sorted(set(pool)), counts,
+                current=self._replicas,
+            )
+            self._version += 1
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_replica_map", version=self._version,
+                    replicas=[list(r) for r in self._replicas],
+                    replica_counts=list(counts),
+                )
+            view = self._view_locked()
+        if commit is not None:
+            commit.wait()
+        _MAP_VERSION.set(view.version)
+        tracing.event("embedding.replica_map", version=view.version)
+        self._notify(view)
+        return view
+
+    def set_hot_ids(self, ids: Sequence[int]) -> ShardMapView:
+        """Publish the ultra-hot id set (promotion/demotion is the
+        controller's call; this just makes it durable and visible).
+        Single phase for the same reason as `update_replicas`: hot-id
+        pinning only changes what clients CACHE, never where writes
+        land."""
+        commit = None
+        with self._lock:
+            if self._pending is not None:
+                raise RuntimeError("set_hot_ids during in-flight resharding")
+            hot = sorted({int(i) for i in ids})
+            if hot == self._hot_ids:
+                return self._view_locked()
+            self._hot_ids = hot
+            self._version += 1
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_hot_ids", version=self._version,
+                    hot_ids=list(hot),
+                )
+            view = self._view_locked()
+        if commit is not None:
+            commit.wait()
+        _MAP_VERSION.set(view.version)
+        self._notify(view)
+        return view
+
+    def begin_split(self) -> Tuple[ShardMapView, List[ShardMove]]:
+        """Double the shard count: every parent shard s splits in place
+        into children s and s + old_n on the SAME owner (id g lands in
+        shard g % 2n, which is s or s + n for every g that was in s —
+        no rows change hosts, so the 'move' is a local re-key). Runs
+        through the ordinary two-phase begin→confirm→commit fence:
+        owners confirm the child ids once `store.split_resident` has
+        re-keyed rows, watermarks, and delta logs. Replicas are dropped
+        (their keyspace just changed); the controller re-fans them out
+        as a separate, cost-gated action."""
+        commit = None
+        with self._lock:
+            if not self._owners:
+                raise RuntimeError("begin_split before bootstrap")
+            if self._pending is not None:
+                raise RuntimeError("split during in-flight resharding")
+            old_n = self.num_shards
+            new_n = old_n * 2
+            version = self._version + 1
+            new_owners = list(self._owners) * 2
+            moves = []
+            for s, o in enumerate(self._owners):
+                moves.append(ShardMove(s, o, o, kind="split", parent=s))
+                moves.append(
+                    ShardMove(s + old_n, o, o, kind="split", parent=s))
+            self._pending = {
+                "version": version,
+                "moves": moves,
+                "confirmed": set(),
+                "prior_owners": list(self._owners),
+                "prior_replicas": [list(r) for r in self._replicas],
+                "prior_num_shards": old_n,
+            }
+            self.num_shards = new_n
+            self._owners = new_owners
+            self._replicas = [[] for _ in range(new_n)]
+            self._replica_counts = None
+            self._version = version
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_reshard_begin", version=version,
+                    num_shards=new_n,
+                    owners=list(self._owners),
+                    replicas=[list(r) for r in self._replicas],
+                    moves=[m.to_wire() for m in moves],
+                )
+            view = self._view_locked()
+        if commit is not None:
+            commit.wait()
+        tracing.event(
+            "embedding.split_begin", version=view.version,
+            num_shards=view.num_shards,
+        )
+        logger.warning(
+            "embedding shard SPLIT v%d: %d -> %d shards",
+            view.version, view.num_shards // 2, view.num_shards,
+        )
+        self._notify(view)
+        return view, moves
+
+    def begin_merge(self) -> Tuple[ShardMapView, List[ShardMove]]:
+        """Halve the shard count: children s and s + new_n fold back
+        into parent s. Only legal when every child pair is co-owned
+        (the inverse of a split that never re-homed a child) — the
+        merge is then a local interleave with no cross-host copy; the
+        controller suppresses the action otherwise rather than paying
+        a migration it can't cost-model. Child delta logs are cleared
+        by `store.merge_resident` (entry keys don't compose across the
+        fold), so replicas full-resync — which is why replicas are
+        dropped here too."""
+        commit = None
+        with self._lock:
+            if not self._owners:
+                raise RuntimeError("begin_merge before bootstrap")
+            if self._pending is not None:
+                raise RuntimeError("merge during in-flight resharding")
+            old_n = self.num_shards
+            if old_n % 2 != 0 or old_n < 2:
+                raise ValueError(f"cannot merge {old_n} shards")
+            new_n = old_n // 2
+            for s in range(new_n):
+                if self._owners[s] != self._owners[s + new_n]:
+                    raise ValueError(
+                        f"children {s} and {s + new_n} live on different "
+                        "owners; merge requires co-owned pairs"
+                    )
+            version = self._version + 1
+            new_owners = self._owners[:new_n]
+            moves = [
+                ShardMove(s, new_owners[s], new_owners[s],
+                          kind="merge", parent=s)
+                for s in range(new_n)
+            ]
+            self._pending = {
+                "version": version,
+                "moves": moves,
+                "confirmed": set(),
+                "prior_owners": list(self._owners),
+                "prior_replicas": [list(r) for r in self._replicas],
+                "prior_num_shards": old_n,
+            }
+            self.num_shards = new_n
+            self._owners = new_owners
+            self._replicas = [[] for _ in range(new_n)]
+            self._replica_counts = None
+            self._version = version
+            if self._journal is not None:
+                commit = self._journal.append(
+                    "emb_reshard_begin", version=version,
+                    num_shards=new_n,
+                    owners=list(self._owners),
+                    replicas=[list(r) for r in self._replicas],
+                    moves=[m.to_wire() for m in moves],
+                )
+            view = self._view_locked()
+        if commit is not None:
+            commit.wait()
+        tracing.event(
+            "embedding.merge_begin", version=view.version,
+            num_shards=view.num_shards,
+        )
+        logger.warning(
+            "embedding shard MERGE v%d: %d -> %d shards",
+            view.version, view.num_shards * 2, view.num_shards,
+        )
+        self._notify(view)
+        return view, moves
+
+    # -------------------------------------------------------------- #
 
     def view(self) -> ShardMapView:
         with self._lock:
@@ -512,6 +775,7 @@ class ShardMapOwner:
             tables=tuple(self._tables.values()),
             resharding=self._pending is not None or self._interrupted,
             replicas=tuple(tuple(r) for r in self._replicas),
+            hot_ids=tuple(self._hot_ids),
         )
 
     def _notify(self, view: ShardMapView) -> None:

@@ -158,9 +158,69 @@ class SpaceSaving:
             take = guaranteed[:n] if n else guaranteed
             return max(0.0, min(1.0, sum(take) / self.total))
 
+    def decay(self, factor: float) -> None:
+        """Scale every tracked count (and error bound, and the stream
+        total) by ``factor`` in [0, 1) — the exponential-decay variant a
+        POPULARITY FLIP needs (ISSUE 20): a job-lifetime cumulative
+        sketch lets yesterday's head dominate `hot_share` for hours
+        after the distribution moved, and a layout controller chasing
+        that ghost would replicate cold shards. Halving preserves both
+        guarantees on the decayed stream: counts and errors scale
+        together, so `count - error` stays a lower bound on the decayed
+        true count, and the share ratio is scale-invariant. Entries
+        decayed to zero are dropped (they carry no information and would
+        pin heap slots)."""
+        with self._lock:
+            self._decay_locked(min(0.999, max(0.0, float(factor))))
+
+    def _decay_locked(self, factor: float) -> None:  # holds: _lock
+        dead = []
+        for key, c in self._counts.items():
+            nc = int(c * factor)
+            if nc <= 0:
+                dead.append(key)
+            else:
+                self._counts[key] = nc
+                self._errors[key] = int(self._errors[key] * factor)
+        for key in dead:
+            del self._counts[key]
+            del self._errors[key]
+        # every heap bound went stale at once: rebuild instead of paying
+        # k lazy repairs on the next k evictions
+        self._heap = [(c, i) for i, c in self._counts.items()]
+        heapq.heapify(self._heap)
+        self.total = int(self.total * factor)
+
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
             self._errors.clear()
             self._heap = []
             self.total = 0
+
+
+class DecayingSpaceSaving(SpaceSaving):
+    """A SpaceSaving sketch that halves itself every `window` stream
+    weight — the RECENT view of the hot set (ISSUE 20). With the stream
+    total capped near ``2 * window``, an id that stops appearing loses
+    half its tracked weight per window of new traffic: after a
+    popularity flip the new head overtakes the old one within a couple
+    of windows instead of hours (pinned by the flip-then-converge test).
+    The decayed sketch keeps the Space-Saving guarantees relative to the
+    decayed stream, so `hot_share()` stays a conservative cache-sizing
+    bound — now of recent traffic rather than the job's whole life."""
+
+    def __init__(self, k: int = K_DEFAULT, window: int = 1 << 16):
+        super().__init__(k)
+        self.window = max(1, int(window))
+
+    def _update_locked(self, key: int, inc: int) -> None:
+        super()._update_locked(key, inc)
+        if self.total > 2 * self.window:
+            self._decay_locked(0.5)
+
+    def update_batch(self, ids, counts=None) -> None:
+        super().update_batch(ids, counts)
+        with self._lock:
+            if self.total > 2 * self.window:
+                self._decay_locked(0.5)

@@ -517,7 +517,128 @@ class EmbeddingShardStore:
             _SHARDS.set(len(self._shards))
 
     # -------------------------------------------------------------- #
-    # read replicas (ISSUE 13): pull-only copies + watermark delta sync
+    # shard split / merge (ISSUE 20): local re-key, no cross-host copy
+
+    def split_resident(self, view: sharding.ShardMapView) -> List[int]:
+        """Re-key every resident shard for a DOUBLED shard count: parent
+        s's row j (global id s + j*n) lands in child s when j is even,
+        child s + n when j is odd, at child-local row j // 2 — a pure
+        interleave, no id changes hosts. The exactly-once fence must
+        survive the re-key, so each child inherits a full COPY of the
+        parent's per-client seq watermarks (a push retried across the
+        split dedupes at whichever child its ids now route to) and the
+        parent's push watermark; the delta log is re-keyed per child
+        with one entry per parent entry — possibly with zero rows — so
+        watermark contiguity holds and a replica syncing across the
+        split never sees a gap. Replica copies are dropped (their
+        keyspace just changed); the controller re-fans them out.
+        Returns the child shard ids now resident (confirm_moves
+        payload)."""
+        created: List[int] = []
+        with self._lock:
+            old_n = self._num_shards
+            if view.num_shards != old_n * 2:
+                raise ValueError(
+                    f"split view has {view.num_shards} shards; store at "
+                    f"{old_n}"
+                )
+            for spec in view.tables:
+                self._tables[spec.name] = spec
+            for (table, s), sh in sorted(self._shards.items()):
+                spec = self._tables[table]
+                child_rows = sharding.shard_row_count(
+                    spec.vocab, view.num_shards)
+                with sh.lock:
+                    rows = np.array(sh.rows, np.float32, copy=True)
+                    applied = dict(sh.applied)
+                    wm = int(sh.wm)
+                    deltas = list(sh.deltas)
+                for child, parity in ((s, 0), (s + old_n, 1)):
+                    out = np.zeros((child_rows, rows.shape[1]), np.float32)
+                    part = rows[parity::2]
+                    out[: part.shape[0]] = part
+                    csh = _Shard(self._place(out), dict(applied), wm=wm)
+                    for d in deltas:
+                        mask = (d["ids"] % 2) == parity
+                        csh.deltas.append(dict(
+                            d, ids=(d["ids"][mask] // 2).astype(np.int32),
+                            rows=d["rows"][mask].copy(),
+                        ))
+                    self._shards[(table, child)] = csh
+                    if child != s:
+                        created.append(child)
+                if s not in created:
+                    created.append(s)
+            self._replicas.clear()
+            self._num_shards = view.num_shards
+            self._map_version = view.version
+            self._log_deltas = any(
+                view.replicas_of(s2) for s2 in range(view.num_shards))
+            _SHARDS.set(len(self._shards))
+        return sorted(set(created))
+
+    def merge_resident(self, view: sharding.ShardMapView) -> List[int]:
+        """Inverse of `split_resident` for a HALVED shard count: children
+        s and s + new_n interleave back into parent s (legal only when
+        both are resident here — the owner enforces co-ownership before
+        planning the merge). The parent's exactly-once fence is the
+        per-client MAX over both children and its push watermark the max
+        of theirs; the delta log is CLEARED — child entry watermarks
+        don't compose into one parent sequence, so replicas full-resync
+        (they were dropped by the layout transition anyway). Returns the
+        parent shard ids now resident."""
+        created: List[int] = []
+        with self._lock:
+            old_n = self._num_shards
+            new_n = view.num_shards
+            if old_n != new_n * 2:
+                raise ValueError(
+                    f"merge view has {new_n} shards; store at {old_n}"
+                )
+            for spec in view.tables:
+                self._tables[spec.name] = spec
+            parents = sorted({
+                (t, s if s < new_n else s - new_n)
+                for (t, s) in self._shards
+            })
+            for table, s in parents:
+                ev = self._shards.pop((table, s), None)
+                od = self._shards.pop((table, s + new_n), None)
+                if ev is None or od is None:
+                    raise StaleShardMapError(
+                        f"merge of {table}/{s}: both children must be "
+                        f"resident on owner {self.owner}"
+                    )
+                spec = self._tables[table]
+                p_cnt = sharding.shard_row_count(spec.vocab, new_n)
+                with ev.lock:
+                    ev_rows = np.array(ev.rows, np.float32, copy=True)
+                    ev_applied = dict(ev.applied)
+                    ev_wm = int(ev.wm)
+                with od.lock:
+                    od_rows = np.array(od.rows, np.float32, copy=True)
+                    od_applied = dict(od.applied)
+                    od_wm = int(od.wm)
+                out = np.zeros((p_cnt, ev_rows.shape[1]), np.float32)
+                out[0::2] = ev_rows[: (p_cnt + 1) // 2]
+                out[1::2] = od_rows[: p_cnt // 2]
+                applied = dict(ev_applied)
+                for cid, seq in od_applied.items():
+                    applied[cid] = max(applied.get(cid, -1), seq)
+                self._shards[(table, s)] = _Shard(
+                    self._place(out), applied, wm=max(ev_wm, od_wm))
+                if s not in created:
+                    created.append(s)
+            self._replicas.clear()
+            self._num_shards = new_n
+            self._map_version = view.version
+            self._log_deltas = any(
+                view.replicas_of(s2) for s2 in range(view.num_shards))
+            _SHARDS.set(len(self._shards))
+        return sorted(created)
+
+    # -------------------------------------------------------------- #
+    # read replicas (ISSUE 13): pull-only copies + delta sync
 
     def install_replica(self, table: str, shard: int,
                         payload: Dict[str, Any]) -> None:

@@ -62,7 +62,7 @@ from elasticdl_tpu.common import faults
 from elasticdl_tpu.common.log_utils import default_logger
 from elasticdl_tpu.embedding import sharding
 from elasticdl_tpu.embedding.cache import HotRowCache
-from elasticdl_tpu.embedding.sketch import SpaceSaving
+from elasticdl_tpu.embedding.sketch import DecayingSpaceSaving, SpaceSaving
 from elasticdl_tpu.embedding.store import StaleShardMapError
 from elasticdl_tpu.embedding.transport import (
     DEGRADED_READS,
@@ -237,6 +237,7 @@ class EmbeddingTierClient:
         fanout_workers: int = 0,
         sketch_k: int = 0,
         sketch_every: int = 1,
+        sketch_window: int = 0,
         cache_rows: int = 0,
         cache_staleness: int = 1,
         read_replicas: bool = False,
@@ -267,7 +268,16 @@ class EmbeddingTierClient:
         # windows back the heartbeat payload's p99s (appends AND the
         # tier_stats sort both take _lock: iterating a deque while
         # another thread appends raises "mutated during iteration")
-        self.sketch = SpaceSaving(sketch_k if sketch_k > 0 else 128)
+        # sketch_window > 0 switches to the exponential-decay variant
+        # (ISSUE 20): the sketch halves itself every `window` stream
+        # weight, so hot_share and the exported head track RECENT
+        # traffic — after a popularity flip the new head overtakes the
+        # old one within a couple of windows instead of letting a job-
+        # lifetime cumulative count chase yesterday's distribution. The
+        # layout controller's promotion/demotion both read this head.
+        k = sketch_k if sketch_k > 0 else 128
+        self.sketch = (DecayingSpaceSaving(k, window=sketch_window)
+                       if sketch_window > 0 else SpaceSaving(k))
         # sketch feed sampling (ISSUE 13): the Space-Saving update is
         # per-unique-id PYTHON heap work — at serving rates it becomes
         # the pull's dominant cost (profiled ~75% of a cached pull) and,
@@ -368,7 +378,29 @@ class EmbeddingTierClient:
                 self._target_loads.clear()
         if invalidate and self.cache is not None:
             self.cache.invalidate_all()
+        # ultra-hot promotion (ISSUE 20): a NEW hot set on the map is
+        # the layout controller telling every worker "these ids carry
+        # the head of the traffic — hold them locally". Warm them
+        # through the normal pull path (cache write-through + staleness
+        # fences apply; they stay resident by being genuinely hot).
+        # Best-effort: a failed prefetch is just a later cache miss.
+        if (self.cache is not None and view.hot_ids
+                and (old is None or tuple(old.hot_ids)
+                     != tuple(view.hot_ids))):
+            self._prefetch_hot(view)
         return view
+
+    def _prefetch_hot(self, view: sharding.ShardMapView) -> None:
+        hot = np.asarray(view.hot_ids, np.int64)
+        for spec in view.tables:
+            ids = hot[(hot >= 0) & (hot < spec.vocab)]
+            if not ids.size:
+                continue
+            try:
+                self.pull(spec.name, ids)
+            except Exception:
+                logger.debug("hot-set prefetch failed for %r (ignored)",
+                             spec.name, exc_info=True)
 
     def _owner_wm_locked(self, table: str, num_shards: int) -> np.ndarray:
         arr = self._owner_wm.get(table)
@@ -1140,12 +1172,15 @@ class EmbeddingTierClient:
             if int(self._shard_loads.sum()) > (1 << 20):
                 self._shard_loads //= 2
 
-    def tier_stats(self) -> Dict[str, float]:
+    def tier_stats(self) -> Dict[str, object]:
         """The compact skew row that rides the heartbeat stats payload
-        (observability/health.py budget: few keys, scalars only) so the
-        master's fleet rollup sees tier skew without scraping workers:
-        hot-id traffic share, shard load imbalance, and RECENT pull/push
-        p99s (a bounded window, not the job-lifetime histogram — a fresh
+        (observability/health.py budget: few keys, scalars only — plus
+        the two ≤64-char STRING vectors below) so the master's fleet
+        rollup sees tier skew without scraping workers: hot-id traffic
+        share, shard load imbalance, per-shard load shares + the sketch
+        head (`emb_shard_loads` / `emb_hot_ids`, the layout
+        controller's inputs — ISSUE 20), and RECENT pull/push p99s (a
+        bounded window, not the job-lifetime histogram — a fresh
         owner-loss spike must not be diluted by a quiet past). Also the
         ONE place the skew gauges refresh — heartbeat/scrape cadence,
         never per pull (the sketch's hot_share sorts its counters).
@@ -1166,7 +1201,7 @@ class EmbeddingTierClient:
             pipe_depth = self._pipeline_depth
         hot_share = round(self.sketch.hot_share(), 4)
         _HOT_SHARE.set(hot_share)
-        out: Dict[str, float] = {"emb_hot_id_share": hot_share}
+        out: Dict[str, object] = {"emb_hot_id_share": hot_share}
         if loads is not None and int(loads.sum()):
             total = int(loads.sum())
             imbalance = round(
@@ -1177,6 +1212,31 @@ class EmbeddingTierClient:
                 # per-shard labels are bounded by --embedding_shards (a
                 # config constant, not data): edl-lint: disable=EDL405
                 _SHARD_LOAD.set(float(loads[s]), shard=str(s))
+            # layout-controller telemetry (ISSUE 20): per-shard load
+            # shares ride the heartbeat as ONE compact string — integer
+            # percents, comma-joined — because decode_stats drops
+            # nested containers and truncates strings at 64 chars. The
+            # key is emitted only when the full vector fits: a
+            # truncated vector would parse as the wrong shard count and
+            # the controller treats that worker as non-reporting (no
+            # data = hold), which is the safe failure mode.
+            shares = ",".join(
+                str(int(round(100.0 * float(c) / total))) for c in loads)
+            if len(shares) <= 64:
+                out["emb_shard_loads"] = shares
+        # the sketch head (hottest first) rides the same way: as many
+        # whole ids as fit the 64-char string budget — the layout
+        # controller aggregates these into a fleet-quorum ultra-hot set
+        head = [str(i) for i, _c, _e in self.sketch.top(16)]
+        if head:
+            ids = ""
+            for tok in head:
+                cand = tok if not ids else ids + "," + tok
+                if len(cand) > 64:
+                    break
+                ids = cand
+            if ids:
+                out["emb_hot_ids"] = ids
         if pulls:
             out["emb_pull_p99_ms"] = round(
                 1e3 * quantile_sorted(pulls, 0.99), 3)
@@ -1498,6 +1558,9 @@ def view_from_response(resp) -> Optional[sharding.ShardMapView]:
         resharding=bool(resp.resharding),
         replicas=replicas,
         addrs=addrs,
+        # ultra-hot set (ISSUE 20): old masters never set it — empty
+        hot_ids=tuple(
+            int(i) for i in (getattr(resp, "hot_ids", ()) or ())),
     )
 
 

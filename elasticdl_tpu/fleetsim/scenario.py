@@ -39,6 +39,11 @@ a laptop. Schema (see docs/soak.md for the annotated example):
     "actions_max", "rescale_cost_s", "horizon_s",
     "damping", "reversal_hold_s"
   },
+  "layout": null | {                 // omit/null = layout loop off
+    "num_shards", "max_shards", "min_shards", "max_replicas",
+    "hot_k", "cooldown_s", "hold_s", "actions_max",
+    "migrate_cost_s", "horizon_s"
+  },
   "events": [ {"at_s": 120, "action": "kill_rack", "rack": 3}, ... ]
 }
 ```
@@ -57,9 +62,14 @@ Event actions (each validated against REQUIRED_EVENT_FIELDS):
   `factor`× slower for a while (honest step quantiles follow).
 - ``set_data_wait {frac, count?}`` — flip (part of) the fleet's
   input-blocked fraction; drives the shrink alert.
-- ``popularity_flip {hot_share, pull_p99_ms, count?}`` — embedding hot
-  set migrates: payloads carry the new hot-id share / pull p99 so the
-  embedding alert rules see it.
+- ``popularity_flip {hot_share, pull_p99_ms, count?, hot_shard?}`` —
+  embedding hot set migrates: payloads carry the new hot-id share /
+  pull p99 so the embedding alert rules see it. With a ``layout``
+  block, payloads additionally carry the per-shard load shares and
+  sketch head (``emb_shard_loads`` / ``emb_hot_ids``) the layout
+  controller aggregates — concentrated on ``hot_shard`` (default 0) —
+  and the modelled imbalance/p99/hit-rate RECOVER as the controller's
+  fan-out/split actions take effect, closing the loop.
 - ``inject_tasks {count}`` — burst of evaluation tasks into the real
   dispatcher (the backlog / grow-alert driver). Each task carries
   ``eval_task_records`` records, so burst-drain time is tunable
@@ -102,6 +112,11 @@ _AUTOSCALE_KEYS = {
     "rescale_cost_s", "horizon_s", "damping", "reversal_hold_s",
 }
 
+_LAYOUT_KEYS = {
+    "num_shards", "max_shards", "min_shards", "max_replicas", "hot_k",
+    "cooldown_s", "hold_s", "actions_max", "migrate_cost_s", "horizon_s",
+}
+
 
 @dataclass
 class Scenario:
@@ -128,6 +143,7 @@ class Scenario:
     wait_backoff_s: float = 2.0
     alert_window_scale: float = 1.0
     autoscale: Optional[Dict[str, float]] = None
+    layout: Optional[Dict[str, float]] = None
     events: List[Dict[str, Any]] = field(default_factory=list)
 
     def override(self, **kw) -> "Scenario":
@@ -144,6 +160,11 @@ class Scenario:
             base = dict(self.autoscale)
             base.update(merged["autoscale"])
             merged["autoscale"] = base
+        if "layout" in merged and self.layout is not None \
+                and merged["layout"] is not None:
+            base = dict(self.layout)
+            base.update(merged["layout"])
+            merged["layout"] = base
         out = dataclasses.replace(self, **merged)
         return validate_scenario(dataclasses.asdict(out))
 
@@ -197,6 +218,14 @@ def validate_scenario(raw: Dict[str, Any]) -> Scenario:
         bad = set(sc.autoscale) - _AUTOSCALE_KEYS
         if bad:
             raise _fail(name, f"unknown autoscale key(s) {sorted(bad)}")
+    if sc.layout is not None:
+        if not isinstance(sc.layout, dict):
+            raise _fail(name, "layout must be an object or null")
+        bad = set(sc.layout) - _LAYOUT_KEYS
+        if bad:
+            raise _fail(name, f"unknown layout key(s) {sorted(bad)}")
+        if int(sc.layout.get("num_shards", 8)) < 1:
+            raise _fail(name, "layout.num_shards must be >= 1")
     for i, ev in enumerate(sc.events):
         if not isinstance(ev, dict):
             raise _fail(name, f"events[{i}] must be an object")

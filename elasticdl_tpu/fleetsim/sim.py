@@ -318,6 +318,11 @@ class SimWorker:
         for k, v in self.gp.items():
             payload[k] = round(v, 3)
         payload.update(self.emb)
+        if self.emb and self.fleet.layout_ctl is not None:
+            # layout runs: the flipped workers' embedding telemetry is
+            # recomputed against the CURRENT shard map every beat, so
+            # the controller's own actions show up in the next sample
+            payload.update(self.fleet.layout_emb_stats())
         return payload
 
     def _cut_ledger(self) -> None:
@@ -563,6 +568,9 @@ class FleetSim:
         self._poll_active = False
         self._alert_onsets: List[Dict] = []
         self._as_totals = {"reversals": 0, "actions": {}, "suppressed": {}}
+        self._ly_totals: Dict[str, Any] = {"actions": {}, "records": 0}
+        self._flip: Optional[Dict[str, Any]] = None
+        self._flip_count = 0
         self._phase_wall: Dict[str, List[float]] = {}
         self.stat = {
             k: 0 for k in (
@@ -589,6 +597,13 @@ class FleetSim:
         self.timeseries = None
         self.alerts = None
         self.autoscaler = None
+        # embedding layout loop (ISSUE 20): the owner map + controller
+        # rebuild on master restart (journal-restored); the stores stand
+        # in for worker-side shard state and survive restarts like the
+        # workers do
+        self.emb_owner = None
+        self.layout_ctl = None
+        self.emb_stores: Dict[int, Any] = {}
         self.generation = 0
 
     # -- master build / kill / restart --------------------------------- #
@@ -624,6 +639,8 @@ class FleetSim:
         sc = self.scenario
         if self.autoscaler is not None:
             self._harvest_autoscaler()
+        if self.layout_ctl is not None:
+            self._harvest_layout()
         self.journal = ControlPlaneJournal(
             self.workdir, group_commit_ms=sc.group_commit_ms)
         eval_shards = (
@@ -686,6 +703,10 @@ class FleetSim:
             )
             self.autoscaler.subscribe(health=self.health, alerts=self.alerts)
             self.autoscaler.bind_target(SimScaleTarget(self))
+        self.emb_owner = None
+        self.layout_ctl = None
+        if sc.layout:
+            self._build_layout(dict(sc.layout))
         from elasticdl_tpu.analysis.lockorder import instrument_master
 
         instrument_master(
@@ -708,6 +729,119 @@ class FleetSim:
             self._as_totals["actions"] = {
                 k: int(v) for k, v in snap["by_kind"].items()
             }
+
+    def _build_layout(self, ly: Dict[str, Any]) -> None:
+        """The REAL layout stack on the virtual clock: a journaled
+        ShardMapOwner, in-process stores standing in for the workers'
+        shard state, and the layout controller subscribed to the same
+        alert engine the flips drive. A master restart rebuilds owner
+        and controller FROM THE JOURNAL (the takeover path under test);
+        the stores persist like workers do."""
+        from elasticdl_tpu.embedding.sharding import ShardMapOwner, TableSpec
+        from elasticdl_tpu.embedding.store import EmbeddingShardStore
+        from elasticdl_tpu.master import layout_controller as layout_lib
+
+        n0 = int(ly.get("num_shards", 8))
+        hosts = list(range(min(4, self.scenario.workers)))
+        self.emb_owner = ShardMapOwner(n0, journal=self.journal)
+        self.emb_owner.register_table(
+            TableSpec("emb", vocab=max(256, 4 * n0), dim=8))
+        restored = self.journal.embedding_snapshot()
+        if restored is not None and restored.version > 0:
+            self.emb_owner.restore_from_replay(restored)
+        else:
+            self.emb_owner.bootstrap(hosts)
+        if not self.emb_stores:
+            self.emb_stores = {h: EmbeddingShardStore(h) for h in hosts}
+            for st in self.emb_stores.values():
+                st.attach(self.emb_owner.view(), "")
+        self.layout_ctl = layout_lib.LayoutController(
+            journal=self.journal,
+            cost_model=layout_lib.LayoutCostModel(
+                migrate_cost_s=float(ly.get("migrate_cost_s", 0.05)),
+                horizon_s=float(ly.get("horizon_s", 120.0)),
+            ),
+            max_shards=int(ly.get("max_shards", 4 * n0)),
+            min_shards=int(ly.get("min_shards", 1)),
+            max_replicas=int(ly.get("max_replicas", 2)),
+            hot_k=int(ly.get("hot_k", 16)),
+            cooldown_s=float(ly.get("cooldown_s", 20.0)),
+            hold_s=float(ly.get("hold_s", 5.0)),
+            action_budget=int(ly.get("actions_max", 16)),
+            clock=self.vclock.now,
+        )
+        self.layout_ctl.subscribe(alerts=self.alerts)
+        self.layout_ctl.bind_target(layout_lib.StoreLayoutTarget(
+            self.emb_owner, self.emb_stores))
+
+    def _harvest_layout(self) -> None:
+        """Layout decision totals across master restarts. Both counters
+        are journal-durable (replayed into the successor), so the
+        latest instance's snapshot IS the running total."""
+        snap = self.layout_ctl.snapshot()
+        if snap.get("by_kind"):
+            self._ly_totals["actions"] = {
+                k: int(v) for k, v in snap["by_kind"].items()
+            }
+        self._ly_totals["records"] = max(
+            int(self._ly_totals["records"]),
+            int(snap.get("decision_records", 0)))
+
+    def layout_emb_stats(self) -> Dict[str, Any]:
+        """The flipped fleet's embedding telemetry, CLOSED-LOOP: load
+        concentrates on the flip's hot shard, and the modelled
+        imbalance / pull p99 / cache hit rate recover as the layout
+        controller's own actions (fan-out, split, hot promotion) land
+        on the live shard map — so the alert rules that armed the
+        controller also clear because of it."""
+        if self.emb_owner is None or self._flip is None:
+            return {}
+        f = self._flip
+        v = self.emb_owner.view()
+        n = v.num_shards
+        hs = float(f["hot_share"])
+        hot = int(f.get("hot_shard", 0)) % n
+        # relief already won: each replica of the hot shard absorbs an
+        # equal cut of its reads; a split spreads the hot id set over
+        # the children
+        fan = 1 + len(v.replicas_of(hot))
+        spread = max(1.0, float(n) / float(f["base_shards"]))
+        head = [int(f["ids_base"]) + i for i in range(8)]
+        # promoted: the ultra-hot head is worker-replicated — most of
+        # its reads never reach the owner shard again
+        promoted = set(head) <= {int(i) for i in v.hot_ids}
+        eff_hot = hs / (fan * spread)
+        if promoted:
+            eff_hot *= 0.3
+        cold = (1.0 - hs) / n
+        shares = [cold + (hs - eff_hot) / n for _ in range(n)]
+        shares[hot] = cold + eff_hot + (hs - eff_hot) / n
+        total = sum(shares) or 1.0
+        imb = max(shares) * n / total
+        raw_imb = (hs + cold) * n  # the no-relief skew, for scaling p99
+        p99 = max(25.0, float(f["pull_p99_ms"]) * imb / max(raw_imb, 1e-9))
+        hit = max(0.05, 1.0 - hs)
+        if promoted:
+            hit = min(0.95, hit + 0.6)
+        stats: Dict[str, Any] = {
+            "emb_hot_id_share": round(hs, 3),
+            "emb_pull_p99_ms": round(p99, 1),
+            "emb_cache_hit_rate": round(hit, 3),
+            "emb_shard_imbalance": round(imb, 3),
+        }
+        loads = ",".join(
+            str(int(round(100.0 * s / total))) for s in shares)
+        if len(loads) <= 64:
+            stats["emb_shard_loads"] = loads
+        ids = ""
+        for i in head:
+            nxt = f"{ids},{i}" if ids else str(i)
+            if len(nxt) > 64:
+                break
+            ids = nxt
+        if ids:
+            stats["emb_hot_ids"] = ids
+        return stats
 
     def _on_alert_onset(self, info: Dict) -> None:
         self._alert_onsets.append({
@@ -794,6 +928,12 @@ class FleetSim:
             if self.autoscaler is not None:
                 self._timed_phase(
                     "autoscaler", lambda: self.autoscaler.evaluate(now=now))
+            if self.layout_ctl is not None:
+                self._timed_phase(
+                    "layout",
+                    lambda: self.layout_ctl.evaluate(
+                        now=now,
+                        workers=self.membership.health_snapshot()))
         if self.vclock.offset + sc.poll_s <= self.scenario.duration_s \
                 and not self.dispatcher.finished():
             self.sched.after(sc.poll_s, self._poll)
@@ -907,6 +1047,19 @@ class FleetSim:
                 "emb_cache_hit_rate": max(
                     0.05, 1.0 - float(ev["hot_share"])),
             }
+        if self.emb_owner is not None:
+            # a NEW hot set every flip: fresh sketch head ids, load
+            # re-concentrated on the flip's hot shard — whatever relief
+            # the controller won for the LAST head is now mis-aimed,
+            # which is exactly the adapt-or-page scenario under test
+            self._flip_count += 1
+            self._flip = {
+                "hot_share": float(ev["hot_share"]),
+                "pull_p99_ms": float(ev["pull_p99_ms"]),
+                "hot_shard": int(ev.get("hot_shard", 0)),
+                "ids_base": 1000 * self._flip_count,
+                "base_shards": self.emb_owner.view().num_shards,
+            }
 
     def _ev_inject_tasks(self, ev) -> None:
         if self.master_down:
@@ -975,6 +1128,8 @@ class FleetSim:
         finished = self.dispatcher.finished()
         if self.autoscaler is not None:
             self._harvest_autoscaler()
+        if self.layout_ctl is not None:
+            self._harvest_layout()
 
         # journal saturation: a post-run direct probe measures
         # enqueue-to-durable latency in this group-commit mode, plus the
@@ -1050,6 +1205,17 @@ class FleetSim:
                 "reversals": self._as_totals["reversals"],
                 "actions_by_kind": dict(self._as_totals["actions"]),
             },
+            "layout": {
+                "enabled": self.layout_ctl is not None,
+                "actions_by_kind": dict(self._ly_totals["actions"]),
+                "decision_records": int(self._ly_totals["records"]),
+                "final_num_shards": (
+                    self.emb_owner.view().num_shards
+                    if self.emb_owner is not None else 0),
+                "final_imbalance": (
+                    self.layout_emb_stats().get("emb_shard_imbalance")
+                    if self._flip is not None else None),
+            },
             "lock_order": {
                 "edges": [[a, b] for a, b in lock_edges],
                 "violations": len(self.lock_recorder.violations()),
@@ -1094,10 +1260,34 @@ class FleetSim:
             "records_completed": d.records_completed if d else 0,
             "wasted_records": d.wasted_records if d else 0,
         }
+        layout_replay = None
+        if self.layout_ctl is not None:
+            # the layout proof: re-reading the journal rebuilds the FULL
+            # decision history (applied + suppressed, per-kind counters)
+            # the live controller carries — the takeover never forgets
+            # or double-counts a decision
+            ly_live = {
+                "by_kind": {k: int(v) for k, v
+                            in self._ly_totals["actions"].items()},
+                "records": int(self._ly_totals["records"]),
+            }
+            lyr = rr.layout
+            ly_replayed = {
+                "by_kind": ({k: int(v) for k, v in lyr.by_kind.items()}
+                            if lyr else {}),
+                "records": lyr.records if lyr else 0,
+            }
+            layout_replay = {
+                "identical": ly_live == ly_replayed,
+                "live": ly_live,
+                "replayed": ly_replayed,
+            }
         return {
-            "identical": live == replayed,
+            "identical": live == replayed and (
+                layout_replay is None or layout_replay["identical"]),
             "live": live,
             "replayed": replayed,
+            **({"layout": layout_replay} if layout_replay else {}),
             "journal_records": rr.records,
             "dropped_lines": rr.dropped_lines,
         }

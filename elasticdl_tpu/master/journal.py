@@ -38,6 +38,17 @@ mutation order):
                                          a begin without its commit rolls
                                          back at replay — see
                                          EmbeddingState.reshard_interrupted)
+    emb_replica_map / emb_hot_ids      — single-phase layout transitions
+                                         (per-shard replica fan-out and the
+                                         ultra-hot id set; pull-only effects,
+                                         so no begin/commit fence)
+    layout                             — every layout-controller decision
+                                         (master/layout_controller.py),
+                                         APPLIED and SUPPRESSED alike;
+                                         applied actions replay into
+                                         LayoutState so a restarted master
+                                         inherits cooldowns and never
+                                         double-fires a layout change
 
 Durability contract: a transition the master *acted on* (a lease granted,
 a report accepted) is on disk before the effect is observable — a crash
@@ -192,6 +203,12 @@ class EmbeddingState:
     # the primaries in the same records, replayed with the same
     # begin-without-commit rollback semantics
     replicas: List[List[int]] = field(default_factory=list)
+    # per-shard replica TARGETS set by the layout controller (empty =
+    # uniform config default) — distinct from `replicas`, which is the
+    # current assignment; targets persist across later reshardings
+    replica_counts: List[int] = field(default_factory=list)
+    # the worker-replicated ultra-hot id set (ISSUE 20)
+    hot_ids: List[int] = field(default_factory=list)
     tables: List[Dict[str, Any]] = field(default_factory=list)
     reshard_interrupted: bool = False
 
@@ -214,6 +231,22 @@ class AutoscaleState:
 
 
 @dataclass
+class LayoutState:
+    """Replayed layout-controller state (master/layout_controller.py
+    restores from this) — same invariant as AutoscaleState, but with
+    per-KIND cooldown clocks: a replica fan-out five minutes ago must
+    not cool down a pending split, and vice versa. `last_action_ts` is
+    the overall max (budget accounting); `last_ts_by_kind` is what the
+    cooldown gate actually reads."""
+
+    actions_applied: int = 0
+    last_action_ts: float = 0.0
+    by_kind: Dict[str, int] = field(default_factory=dict)
+    last_ts_by_kind: Dict[str, float] = field(default_factory=dict)
+    records: int = 0
+
+
+@dataclass
 class ReplayResult:
     prior_generation: int = 0
     records: int = 0
@@ -223,6 +256,7 @@ class ReplayResult:
     world_version: int = 0
     embedding: Optional[EmbeddingState] = None
     autoscale: Optional[AutoscaleState] = None
+    layout: Optional[LayoutState] = None
 
 
 def _replay_dispatcher(
@@ -317,6 +351,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     membership: Optional[MembershipState] = None
     embedding: Optional[EmbeddingState] = None
     autoscale: Optional[AutoscaleState] = None
+    layout: Optional[LayoutState] = None
     # an emb_reshard_begin whose commit has not replayed yet:
     # {"version": v, "owners": [...]} — promoted to the committed map by
     # emb_reshard_commit, rolled back (reshard_interrupted) at the end
@@ -332,7 +367,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
 
     def apply(rec: Dict[str, Any]) -> None:
         nonlocal dispatcher, membership, embedding, pending_reshard
-        nonlocal autoscale
+        nonlocal autoscale, layout
         rtype = rec["t"]
         result.records += 1
         if rtype == "header":
@@ -346,6 +381,8 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                 embedding = EmbeddingState(**rec["embedding"])
             if rec.get("autoscale") is not None:
                 autoscale = AutoscaleState(**rec["autoscale"])
+            if rec.get("layout") is not None:
+                layout = LayoutState(**rec["layout"])
             result.world_version = int(rec.get("world_version", 0))
         elif rtype in _DISPATCHER_RECORDS:
             if dispatcher is None:
@@ -390,6 +427,18 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                 )
                 kind = str(rec.get("kind", "?"))
                 autoscale.by_kind[kind] = autoscale.by_kind.get(kind, 0) + 1
+        elif rtype == "layout":
+            if layout is None:
+                layout = LayoutState()
+            layout.records += 1
+            if rec.get("decision") == "applied":
+                layout.actions_applied += 1
+                ts = float(rec.get("ts") or 0.0)
+                layout.last_action_ts = max(layout.last_action_ts, ts)
+                kind = str(rec.get("kind", "?"))
+                layout.by_kind[kind] = layout.by_kind.get(kind, 0) + 1
+                layout.last_ts_by_kind[kind] = max(
+                    layout.last_ts_by_kind.get(kind, 0.0), ts)
         elif rtype == "emb_table":
             e = emb()
             if not any(t["name"] == rec["name"] for t in e.tables):
@@ -407,9 +456,24 @@ def replay_lines(lines: List[str]) -> ReplayResult:
                           for r in rec.get("replicas", [])]
             e.reshard_interrupted = False
             pending_reshard = None
+        elif rtype == "emb_replica_map":
+            e = emb()
+            e.version = int(rec["version"])
+            e.replicas = [[int(o) for o in r]
+                          for r in rec.get("replicas", [])]
+            e.replica_counts = [int(c)
+                                for c in rec.get("replica_counts", [])]
+        elif rtype == "emb_hot_ids":
+            e = emb()
+            e.version = int(rec["version"])
+            e.hot_ids = [int(i) for i in rec.get("hot_ids", [])]
         elif rtype == "emb_reshard_begin":
             pending_reshard = {
                 "version": int(rec["version"]),
+                # splits/merges ride the same begin→commit fence and
+                # change the shard COUNT; a plain reshard journals the
+                # unchanged count (older journals omit the field)
+                "num_shards": int(rec.get("num_shards", 0)),
                 "owners": [int(o) for o in rec["owners"]],
                 "replicas": [[int(o) for o in r]
                              for r in rec.get("replicas", [])],
@@ -419,6 +483,14 @@ def replay_lines(lines: List[str]) -> ReplayResult:
             if (pending_reshard is not None
                     and pending_reshard["version"] == int(rec["version"])):
                 e.version = pending_reshard["version"]
+                if pending_reshard["num_shards"]:
+                    if pending_reshard["num_shards"] != e.num_shards:
+                        # a committed split/merge drops replica targets
+                        # and the hot set's SHARD routing is unaffected
+                        # (hot ids are global); targets re-derive from
+                        # the controller's next pass
+                        e.replica_counts = []
+                    e.num_shards = pending_reshard["num_shards"]
                 e.owners = pending_reshard["owners"]
                 e.replicas = pending_reshard["replicas"]
                 e.reshard_interrupted = False
@@ -515,6 +587,7 @@ def replay_lines(lines: List[str]) -> ReplayResult:
     result.membership = membership
     result.embedding = embedding
     result.autoscale = autoscale
+    result.layout = layout
     return result
 
 
@@ -768,6 +841,7 @@ class ControlPlaneJournal:
                 or self.replay.membership is not None
                 or self.replay.embedding is not None
                 or self.replay.autoscale is not None
+                or self.replay.layout is not None
                 or self.replay.world_version
             ):
                 f.write(json.dumps({
@@ -787,6 +861,10 @@ class ControlPlaneJournal:
                     "autoscale": (
                         asdict(self.replay.autoscale)
                         if self.replay.autoscale is not None else None
+                    ),
+                    "layout": (
+                        asdict(self.replay.layout)
+                        if self.replay.layout is not None else None
                     ),
                     "world_version": self.replay.world_version,
                 }) + "\n")
@@ -821,6 +899,11 @@ class ControlPlaneJournal:
         if self.replay is None:
             return None
         return self.replay.autoscale
+
+    def layout_snapshot(self) -> Optional[LayoutState]:
+        if self.replay is None:
+            return None
+        return self.replay.layout
 
     @property
     def world_version(self) -> int:

@@ -240,6 +240,27 @@ class Master:
                 )
             self.membership.add_death_callback(self._embedding_on_death)
 
+        # Closed-loop LAYOUT controller (ISSUE 20,
+        # master/layout_controller.py): the embedding-tier sibling of
+        # the autoscaler above — skew signals (shard imbalance, cache-
+        # hit collapse, hot-id share) become journaled, cost-gated
+        # layout actions (replica fan-out, split/merge, hot-id
+        # promotion), evaluated on the same wait poll. None when
+        # --layout_autoscale is off (the default). On the distributed
+        # path the target is the owner map only — workers adopt the new
+        # layout at their next map refresh — so split/merge suppress as
+        # `unsupported`; the in-process StoreLayoutTarget (bench,
+        # fleetsim, tests) supports all five kinds.
+        self.layout = None
+        if self.embedding is not None:
+            from elasticdl_tpu.master import layout_controller as layout_lib
+
+            self.layout = layout_lib.from_config(cfg, journal=self.journal)
+            if self.layout is not None:
+                self.layout.subscribe(alerts=self.alerts)
+                self.layout.bind_target(layout_lib.OwnerLayoutTarget(
+                    self.embedding, membership=self.membership))
+
         metrics = None
         callbacks = []
         if eval_shards or cfg.model_def:
@@ -428,6 +449,12 @@ class Master:
                 {"autoscale": self.autoscaler.snapshot()}
                 if self.autoscaler is not None else {}
             ),
+            # the closed-loop layout policy's state (budget, per-kind
+            # cooldowns, last decision); absent key = controller off
+            **(
+                {"layout": self.layout.snapshot()}
+                if self.layout is not None else {}
+            ),
         }
 
     def _fleet_series(self) -> dict:
@@ -501,6 +528,14 @@ class Master:
                 # cooldown-bounded rescale action. Never raises.
                 with poll_phase("autoscaler"):
                     self.autoscaler.evaluate()
+            if self.layout is not None:
+                # the layout decision pass (ISSUE 20): skew signals +
+                # the fleet's per-shard load / hot-id telemetry (riding
+                # the same heartbeat stats records) -> at most one
+                # journaled, cost-gated layout action. Never raises.
+                with poll_phase("layout"):
+                    self.layout.evaluate(
+                        workers=self.membership.health_snapshot())
             if self.summary is not None:
                 # control-plane metrics ride the summary stream (rate-
                 # limited inside; never raises)

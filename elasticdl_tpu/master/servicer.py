@@ -334,6 +334,11 @@ class MasterServicer:
                 name=t.name, vocab=t.vocab, dim=t.dim, seed=t.seed,
                 init_scale=t.init_scale,
             )
+        # the layout controller's ultra-hot set (ISSUE 20) rides the
+        # same response; workers pin these rows and keep them fresh
+        # through the delta-sync lane
+        if view.hot_ids:
+            resp.hot_ids.extend(view.hot_ids)
         # owner address book (ISSUE 15): every alive worker's embedding
         # data-plane endpoint rides the map response — GrpcTransport
         # clients adopt it on every refresh, so a relaunched owner's new

@@ -184,6 +184,16 @@ def _load_journal(paths: Iterable[str], tail: int) -> Optional[dict]:
         out["world_version"] = max(
             out.get("world_version", 0), result.world_version
         )
+        if result.layout is not None:
+            # layout-controller decision history (ISSUE 20): rotation
+            # snapshots carry the totals forward, so the latest file's
+            # replayed state IS the cumulative history
+            out["layout"] = {
+                "actions_applied": result.layout.actions_applied,
+                "by_kind": dict(result.layout.by_kind),
+                "decision_records": result.layout.records,
+                "last_action_ts": result.layout.last_action_ts,
+            }
     out["generations"] = sorted(set(out["generations"]))
     return out
 
@@ -491,6 +501,15 @@ def render_text(report: dict, max_entries: int = 200) -> str:
             f"{journal['dropped_lines']} dropped line(s), "
             f"tail of {len(journal['tail'])} kept"
         )
+        ly = journal.get("layout")
+        if ly:
+            by = "  ".join(
+                f"{k}={v}" for k, v in sorted(ly["by_kind"].items()))
+            lines.append(
+                f"layout: {ly['actions_applied']} applied action(s) of "
+                f"{ly['decision_records']} journaled decision(s)"
+                + (f"  [{by}]" if by else "")
+            )
     goodput = report.get("goodput") or {}
     if goodput:
         # the headline bill, in one sentence a capacity owner can read

@@ -196,6 +196,15 @@ def apply_patches(fd: descriptor_pb2.FileDescriptorProto) -> int:
         msgs["GetEmbeddingShardMapResponse"], "addrs", 9, "string",
         repeated=True)
 
+    # Skew-adaptive layout (ISSUE 20, master/layout_controller.py): the
+    # controller's worker-replicated ultra-hot id set rides the same map
+    # response — GLOBAL ids (int64, same width as the pull path's id
+    # space), sorted. Old workers skip the unknown field and keep
+    # serving the plain sharded layout.
+    changed += _add_field(
+        msgs["GetEmbeddingShardMapResponse"], "hot_ids", 10, "int64",
+        repeated=True)
+
     # Data-plane RPC payloads. Id vectors travel as raw little-endian
     # int32 bytes and row matrices as raw float32 bytes + a dim field
     # (one memcpy each way — repeated scalar varint packing would cost

@@ -1865,6 +1865,90 @@ def test_rescale_action_allowlists_policy_and_entry_points():
 
 
 # ------------------------------------------------------------------ #
+# EDL503 layout-mutation-outside-policy
+
+
+EDL503_BAD = """
+    def react_to_skew(owner):
+        owner.update_replicas([2, 0], [0, 1])     # BAD: ad-hoc fan-out
+        owner.set_hot_ids([1, 5])                 # BAD: ad-hoc promote
+        owner.begin_split()                       # BAD: ad-hoc split
+        owner.begin_merge()                       # BAD: ad-hoc merge
+"""
+
+EDL503_TRACKED = """
+    from elasticdl_tpu.embedding.sharding import ShardMapOwner
+
+    sm = ShardMapOwner(8)
+
+    def hack(cfg):
+        sm.begin_split()                          # BAD: tracked receiver
+"""
+
+EDL503_GOOD = """
+    def death_replan(owner, alive, dead):
+        # the worker-death re-plan is NOT a layout action
+        owner.begin_resharding(alive, dead=dead)
+
+    def unrelated(tree):
+        # receiver is not owner-ish and not a tracked construction
+        tree.begin_split()
+
+    def reviewed(owner):
+        # operator escape hatch under review:
+        # edl-lint: disable=EDL503
+        owner.set_hot_ids([])
+"""
+
+
+def test_layout_mutation_outside_policy_fires_on_adhoc_calls():
+    fs = findings_for(EDL503_BAD, select={"EDL503"},
+                      rel_path="elasticdl_tpu/worker/hacks.py")
+    assert rule_ids(fs) == ["EDL503"]
+    assert len(fs) == 4
+    assert all("cost gate" in f.message for f in fs)
+
+
+def test_layout_mutation_tracks_owner_constructions():
+    fs = findings_for(EDL503_TRACKED, select={"EDL503"},
+                      rel_path="elasticdl_tpu/client/zoo.py")
+    assert rule_ids(fs) == ["EDL503"]
+    assert len(fs) == 1
+
+
+def test_layout_mutation_quiet_on_replan_unrelated_and_disabled():
+    assert findings_for(EDL503_GOOD, select={"EDL503"},
+                        rel_path="elasticdl_tpu/worker/hacks.py") == []
+
+
+def test_layout_mutation_allowlists_policy_and_owner():
+    for allowed in (
+        "elasticdl_tpu/master/layout_controller.py",
+        "elasticdl_tpu/embedding/sharding.py",
+    ):
+        assert findings_for(EDL503_BAD, select={"EDL503"},
+                            rel_path=allowed) == []
+
+
+def test_tree_is_layout_mutation_clean():
+    # the whole package routes layout changes through the controller:
+    # no undisabled EDL503 finding anywhere outside the allowlist
+    import glob
+    import os
+
+    from elasticdl_tpu.analysis.core import ModuleContext, all_rules
+
+    root = os.path.join(os.path.dirname(__file__), "..", "elasticdl_tpu")
+    rule = next(r for r in all_rules() if r.id == "EDL503")
+    for path in glob.glob(os.path.join(root, "**", "*.py"), recursive=True):
+        rel = "elasticdl_tpu/" + os.path.relpath(
+            path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            ctx = ModuleContext(path, f.read(), rel)
+        assert list(rule.check(ctx)) == [], rel
+
+
+# ------------------------------------------------------------------ #
 # EDL502 sleep-in-simulated-time
 
 

@@ -115,9 +115,18 @@ def test_tier_stats_populated_by_pulls_and_pushes():
     assert stats["emb_shard_imbalance"] >= 1.0
     assert stats["emb_pull_p99_ms"] > 0.0
     assert stats["emb_push_p99_ms"] > 0.0
-    # scalars only — the payload codec drops anything else
-    for v in stats.values():
-        assert isinstance(v, (int, float))
+    # scalars, plus the two ≤64-char string vectors the layout
+    # controller parses (ISSUE 20) — the payload codec carries short
+    # strings and drops anything else
+    for k, v in stats.items():
+        if k in ("emb_shard_loads", "emb_hot_ids"):
+            assert isinstance(v, str) and len(v) <= 64, (k, v)
+            assert all(tok.lstrip("-").isdigit()
+                       for tok in v.split(",")), (k, v)
+        else:
+            assert isinstance(v, (int, float)), (k, v)
+    # the per-shard load shares parse to the view's shard count
+    assert len(stats["emb_shard_loads"].split(",")) == 4
 
 
 def test_tier_sketch_sees_occurrence_weights_not_unique_streams():
